@@ -1,0 +1,28 @@
+"""gofr_tpu — a TPU-native application & inference-serving framework.
+
+A brand-new framework with the application-surface of the reference (GoFr,
+/root/reference: handlers, DI container, observability-by-default, HTTP/gRPC/
+Pub-Sub/cron transports, datasources, migrations, auth) re-designed TPU-first:
+JAX/XLA/Pallas compute, jax.sharding device meshes for TP/DP/PP/SP/EP,
+a continuous-batching serving engine with a paged KV cache, and token
+streaming over HTTP chunked / SSE / gRPC / WebSocket.
+
+Public API mirrors the reference's ergonomics::
+
+    import gofr_tpu
+
+    app = gofr_tpu.App()
+
+    def hello(ctx):
+        return {"message": "hello"}
+
+    app.get("/hello", hello)
+    app.run()
+"""
+
+from gofr_tpu.app import App, new_app, new_cmd
+from gofr_tpu.context import AuthInfo, Context
+from gofr_tpu.handler import Handler
+from gofr_tpu.version import FRAMEWORK as __version__
+
+__all__ = ["App", "new_app", "new_cmd", "Context", "AuthInfo", "Handler", "__version__"]

@@ -1,0 +1,378 @@
+"""The App: public API, wiring, lifecycle.
+
+Reference parity: pkg/gofr/gofr.go:31-50 (App struct), factory.go:17-95
+(New/NewCMD with default routes, swagger/static autodetect, port defaults
+HTTP=8000/gRPC=9000/metrics=2121 default.go:3-7), run.go:15-95 (Run: signal
+hook, on-start hooks, all servers started concurrently), gofr.go:76-101
+(Shutdown with SHUTDOWN_GRACE_PERIOD then force-close), rest.go:9-31 (route
+verbs), gofr.go:233 (Subscribe), gofr.go:271 (AddCronJob), gofr.go:220
+(Migrate), gofr.go:343 (OnStart), auth.go:16-104 (Enable*Auth).
+
+TPU additions: ``register_model`` / ``serve_generation`` attach compiled
+executables and the continuous-batching engine to the container so handlers
+reach them as ``ctx.tpu`` / ``ctx.serving``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+from typing import Any, Callable
+
+from gofr_tpu.config import Config, EnvConfig
+from gofr_tpu.container.container import Container
+from gofr_tpu.context import Context
+from gofr_tpu.cron import Crontab
+from gofr_tpu.handler import Handler, alive_handler, health_handler
+from gofr_tpu.http.dispatch import Dispatcher
+from gofr_tpu.http.middleware import (
+    api_key_auth_middleware,
+    basic_auth_middleware,
+    chain,
+    cors_middleware,
+    logging_middleware,
+    metrics_middleware,
+    oauth_middleware,
+    tracing_middleware,
+)
+from gofr_tpu.http.middleware.auth import auth_middleware
+from gofr_tpu.http.middleware.core import CORSConfig
+from gofr_tpu.http.router import Router
+from gofr_tpu.http.server import HTTPServer
+from gofr_tpu.metrics.server import MetricsHandler
+from gofr_tpu.subscriber import SubscriptionManager
+
+DEFAULT_HTTP_PORT = 8000
+DEFAULT_GRPC_PORT = 9000
+DEFAULT_METRICS_PORT = 2121
+DEFAULT_SHUTDOWN_GRACE_SECONDS = 30.0
+
+
+class App:
+    """gofr.New() analogue. Construct, register routes/jobs/services, then
+    ``run()``."""
+
+    def __init__(self, config: Config | None = None, *, is_cmd: bool = False) -> None:
+        if config is None:
+            config = EnvConfig(os.environ.get("GOFR_CONFIGS_DIR", "./configs"))
+        self.config = config
+        self.container = Container(config)
+        self.logger = self.container.logger
+        self.router = Router()
+        self.is_cmd = is_cmd
+        self._middlewares: list[Any] = []
+        self._user_middlewares: list[Any] = []
+        self.subscription_manager = SubscriptionManager(self.container)
+        self.crontab = Crontab(self.container)
+        self._on_start_hooks: list[Callable] = []
+        self._on_shutdown_hooks: list[Callable] = []
+        self._grpc_server: Any = None
+        self._ws_registry: dict[str, Handler] = {}
+        self._cmd_routes: list[tuple[str, Handler, str]] = []
+        self._migrations: dict[int, Any] = {}
+        self._shutdown_event: asyncio.Event | None = None
+        self._servers: list[Any] = []
+
+        self.http_port = int(self.config.get_or_default("HTTP_PORT", str(DEFAULT_HTTP_PORT)))
+        self.grpc_port = int(self.config.get_or_default("GRPC_PORT", str(DEFAULT_GRPC_PORT)))
+        self.metrics_port = int(self.config.get_or_default("METRICS_PORT", str(DEFAULT_METRICS_PORT)))
+
+        if not is_cmd:
+            self._register_defaults()
+
+    # ------------------------------------------------------------------ routes
+    def get(self, pattern: str, handler: Handler) -> None:
+        self.add_route("GET", pattern, handler)
+
+    def post(self, pattern: str, handler: Handler) -> None:
+        self.add_route("POST", pattern, handler)
+
+    def put(self, pattern: str, handler: Handler) -> None:
+        self.add_route("PUT", pattern, handler)
+
+    def patch(self, pattern: str, handler: Handler) -> None:
+        self.add_route("PATCH", pattern, handler)
+
+    def delete(self, pattern: str, handler: Handler) -> None:
+        self.add_route("DELETE", pattern, handler)
+
+    def options(self, pattern: str, handler: Handler) -> None:
+        self.add_route("OPTIONS", pattern, handler)
+
+    def add_route(self, method: str, pattern: str, handler: Handler) -> None:
+        self.router.add(method, pattern, handler)
+
+    def add_static_files(self, url_prefix: str, fs_dir: str) -> None:
+        self.router.add_static_files(url_prefix, fs_dir)
+
+    def use_middleware(self, *mws: Any) -> None:
+        """App-level custom middleware (http/router.go:29)."""
+        self._user_middlewares.extend(mws)
+
+    def _register_defaults(self) -> None:
+        """factory.go:48-78: health routes, favicon, swagger + static
+        autodetect."""
+        self.router.add("GET", "/.well-known/health", health_handler)
+        self.router.add("GET", "/.well-known/alive", alive_handler)
+        if os.path.isdir("./static"):
+            self.add_static_files("/static", "./static")
+            if os.path.isfile("./static/openapi.json"):
+                self._register_swagger("./static/openapi.json")
+
+    def _register_swagger(self, spec_path: str) -> None:
+        from gofr_tpu.http.swagger import swagger_handlers
+
+        spec_handler, ui_handler = swagger_handlers(spec_path)
+        self.router.add("GET", "/.well-known/openapi.json", spec_handler)
+        self.router.add("GET", "/.well-known/swagger", ui_handler)
+
+    # ----------------------------------------------------------------- auth
+    def enable_basic_auth(self, users: dict[str, str]) -> None:
+        self._middlewares.append(basic_auth_middleware(users=users))
+
+    def enable_basic_auth_with_validator(self, validate: Callable[[Any, str, str], bool]) -> None:
+        self._middlewares.append(
+            basic_auth_middleware(validate_with_container=validate, container=self.container)
+        )
+
+    def enable_api_key_auth(self, *keys: str) -> None:
+        self._middlewares.append(api_key_auth_middleware(keys=list(keys)))
+
+    def enable_api_key_auth_with_validator(self, validate: Callable[[Any, str], bool]) -> None:
+        self._middlewares.append(
+            api_key_auth_middleware(validate_with_container=validate, container=self.container)
+        )
+
+    def enable_oauth(self, jwks_url: str, refresh_interval: float = 3600.0, **kw: Any) -> None:
+        self._middlewares.append(
+            oauth_middleware(jwks_url=jwks_url, refresh_interval=refresh_interval, **kw)
+        )
+
+    def enable_auth_provider(self, provider: Any) -> None:
+        self._middlewares.append(auth_middleware(provider))
+
+    # ------------------------------------------------------- services & stores
+    def add_http_service(self, name: str, address: str, *options: Any) -> None:
+        """RegisterService for outbound HTTP (container.Services,
+        service/new.go:78-87)."""
+        from gofr_tpu.service import new_http_service
+
+        self.container.services[name] = new_http_service(
+            address,
+            self.container.logger,
+            self.container.metrics_manager,
+            self.container.tracer,
+            *options,
+        )
+
+    def add_datasource(self, name: str, ds: Any) -> None:
+        """external_db.go Add* analogue for any provider-pattern
+        datasource."""
+        self.container.register_datasource(name, ds)
+
+    def add_tpu(self, tpu: Any) -> None:
+        self.container.register_datasource("tpu", tpu)
+
+    # ------------------------------------------------------------ async + cron
+    def subscribe(self, topic: str, handler: Handler) -> None:
+        """gofr.go:233-249."""
+        self.subscription_manager.register(topic, handler)
+
+    def add_cron_job(self, schedule: str, name: str, handler: Handler) -> None:
+        """gofr.go:271-287."""
+        self.crontab.add(schedule, name, handler)
+
+    # ---------------------------------------------------------------- lifecycle
+    def on_start(self, hook: Callable) -> None:
+        """gofr.go:343-349: ordered hooks run before servers; failure aborts
+        startup."""
+        self._on_start_hooks.append(hook)
+
+    def on_shutdown(self, hook: Callable) -> None:
+        self._on_shutdown_hooks.append(hook)
+
+    def migrate(self, migrations: dict[int, Any]) -> None:
+        """gofr.go:220-227 — runs immediately, like the reference."""
+        from gofr_tpu.migration import run_migrations
+
+        run_migrations(migrations, self.container)
+
+    # -- gRPC ------------------------------------------------------------------
+    def register_grpc_service(self, servicer: Any, adder: Callable | None = None) -> None:
+        """grpc.go:200-269: register an implementation; the container is
+        injected into a ``container`` attribute when present."""
+        from gofr_tpu.grpcx.server import GRPCServer
+
+        if self._grpc_server is None:
+            self._grpc_server = GRPCServer(self.container, self.grpc_port, self.config)
+        self._grpc_server.register(servicer, adder)
+
+    @property
+    def grpc_server(self) -> Any:
+        from gofr_tpu.grpcx.server import GRPCServer
+
+        if self._grpc_server is None:
+            self._grpc_server = GRPCServer(self.container, self.grpc_port, self.config)
+        return self._grpc_server
+
+    # -- WebSocket -------------------------------------------------------------
+    def websocket(self, pattern: str, handler: Handler) -> None:
+        """websocket.go:30-49: per-message handler loop on an upgraded
+        connection."""
+        self._ws_registry[pattern] = handler
+
+    def add_ws_service(self, name: str, url: str, *, reconnect: bool = True) -> None:
+        from gofr_tpu.websocket import WSManager
+
+        if self.container.ws_manager is None:
+            self.container.ws_manager = WSManager(self.logger)
+        self.container.ws_manager.add_service(name, url, reconnect=reconnect)
+
+    # -- CMD -------------------------------------------------------------------
+    def sub_command(self, pattern: str, handler: Handler, description: str = "") -> None:
+        self._cmd_routes.append((pattern, handler, description))
+
+    # ---------------------------------------------------------------- running
+    def _build_http_handler(self) -> Any:
+        timeout_s = self.config.get("REQUEST_TIMEOUT")
+        timeout = float(timeout_s) if timeout_s else None
+        dispatcher = Dispatcher(self.router, self.container, timeout)
+        middlewares = [
+            tracing_middleware(self.container.tracer),
+            logging_middleware(self.logger, config=self.config),
+            cors_middleware(CORSConfig(self.config), self.router),
+            metrics_middleware(self.container.metrics_manager, self.router),
+        ]
+        middlewares.extend(self._middlewares)  # auth
+        middlewares.extend(self._user_middlewares)
+        return chain(dispatcher, middlewares)
+
+    async def _start_servers(self) -> None:
+        handler = self._build_http_handler()
+        ws_upgrader = None
+        if self._ws_registry:
+            from gofr_tpu.websocket import WSUpgrader, WSManager
+
+            if self.container.ws_manager is None:
+                self.container.ws_manager = WSManager(self.logger)
+            ws_upgrader = WSUpgrader(self._ws_registry, self.container)
+
+        http_server = HTTPServer(
+            handler,
+            self.http_port,
+            logger=self.logger,
+            cert_file=self.config.get("CERT_FILE"),
+            key_file=self.config.get("KEY_FILE"),
+            ws_upgrader=ws_upgrader,
+        )
+        metrics_server = HTTPServer(
+            MetricsHandler(self.container), self.metrics_port, logger=self.logger
+        )
+        self._servers = [metrics_server, http_server]
+        await metrics_server.start()
+        await http_server.start()
+        if self._grpc_server is not None:
+            await self._grpc_server.start()
+        await self.subscription_manager.start()
+        await self.crontab.start()
+
+    async def _run_on_start_hooks(self) -> None:
+        """run.go:39-53: ordered, abort on first error."""
+        for hook in self._on_start_hooks:
+            ctx = Context(_hook_request(), self.container)
+            result = hook(ctx)
+            if asyncio.iscoroutine(result):
+                await result
+
+    async def run_async(self) -> None:
+        """App.Run (run.go:15-36) on the current event loop."""
+        self._shutdown_event = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        self._loop = loop
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, self._shutdown_event.set)
+            except (NotImplementedError, RuntimeError, ValueError):
+                pass  # not on the main thread (tests) or unsupported platform
+        try:
+            await self._run_on_start_hooks()
+        except Exception as exc:
+            self.logger.error(f"error in OnStart hook, aborting startup: {exc}")
+            self.container.close()
+            return
+        await self._start_servers()
+        self.logger.info(
+            f"{self.container.app_name} started: "
+            f"http=:{self.http_port} metrics=:{self.metrics_port}"
+            + (f" grpc=:{self.grpc_port}" if self._grpc_server else "")
+        )
+        await self._shutdown_event.wait()
+        await self.shutdown()
+
+    def run(self) -> None:
+        if self.is_cmd:
+            self._run_cmd()
+            return
+        try:
+            asyncio.run(self.run_async())
+        except KeyboardInterrupt:
+            pass
+
+    def stop(self) -> None:
+        """Request shutdown from any thread."""
+        ev = self._shutdown_event
+        loop = getattr(self, "_loop", None)
+        if ev is None or loop is None:
+            return
+        try:
+            loop.call_soon_threadsafe(ev.set)
+        except RuntimeError:
+            pass  # loop already closed
+
+    async def shutdown(self) -> None:
+        """gofr.go:76-101 + shutdown.go:14-48: grace period then force."""
+        grace = float(self.config.get_or_default("SHUTDOWN_GRACE_PERIOD", str(DEFAULT_SHUTDOWN_GRACE_SECONDS)))
+        self.logger.info("shutting down gracefully...")
+        for hook in self._on_shutdown_hooks:
+            try:
+                result = hook()
+                if asyncio.iscoroutine(result):
+                    await result
+            except Exception as exc:
+                self.logger.error(f"error in shutdown hook: {exc}")
+        try:
+            await asyncio.wait_for(self._shutdown_servers(), timeout=grace)
+        except asyncio.TimeoutError:
+            self.logger.error("graceful shutdown timed out; forcing close")
+        self.container.close()
+        self.logger.info("shutdown complete")
+
+    async def _shutdown_servers(self) -> None:
+        await self.subscription_manager.stop()
+        await self.crontab.stop()
+        if self._grpc_server is not None:
+            await self._grpc_server.shutdown()
+        for server in self._servers:
+            await server.shutdown()
+
+    # -- CMD execution (cmd.go:35-164) ----------------------------------------
+    def _run_cmd(self) -> None:
+        from gofr_tpu.cli import run_cmd
+
+        run_cmd(self)
+
+
+def _hook_request() -> Any:
+    from gofr_tpu.cron import _NoopRequest
+
+    return _NoopRequest()
+
+
+def new_app(config: Config | None = None) -> App:
+    return App(config)
+
+
+def new_cmd(config: Config | None = None) -> App:
+    """NewCMD (factory.go:81-95): no servers, subcommand routing."""
+    return App(config, is_cmd=True)

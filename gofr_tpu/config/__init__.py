@@ -1,0 +1,15 @@
+"""Configuration: env-var config with file layering.
+
+Mirrors the reference's config subsystem (pkg/gofr/config/config.go,
+pkg/gofr/config/godotenv.go:36-91): a two-method interface (``get`` /
+``get_or_default``), backed by ``./configs/.env`` with ``.local.env`` or
+``.{APP_ENV}.env`` overrides, where real process env vars always win.
+
+TPU-build addition: the ``TPU_*`` namespace (``TPU_MESH``, ``TPU_TOPOLOGY``,
+``TPU_BATCH_MAX_TOKENS``, ...) is parsed by the tpu datasource, not here —
+config stays schema-free exactly like the reference.
+"""
+
+from gofr_tpu.config.config import Config, EnvConfig, MapConfig, load_env_file
+
+__all__ = ["Config", "EnvConfig", "MapConfig", "load_env_file"]

@@ -1,0 +1,7 @@
+"""Container: the DI hub (reference: pkg/gofr/container/)."""
+
+from gofr_tpu.container.container import Container, new_container
+from gofr_tpu.container.health import aggregate_health
+from gofr_tpu.container import datasources
+
+__all__ = ["Container", "new_container", "aggregate_health", "datasources"]

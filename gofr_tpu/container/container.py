@@ -1,0 +1,194 @@
+"""The dependency-injection Container.
+
+Reference parity: pkg/gofr/container/container.go:43-177 — owns Logger,
+Metrics, tracer, Services (inter-service HTTP clients), PubSub, Redis, SQL,
+KVStore, File, WSManager; builds them from Config (PUBSUB_BACKEND selection
+:132-172, remote logger :101-113); registers framework metrics (:252-284);
+``close()`` tears everything down (:179-199). Health aggregation lives in
+health.py (container/health.go:8-98).
+
+TPU-build addition: the container owns the ``tpu`` datasource and the serving
+engine reaches every datasource through it, so ``ctx.tpu.execute(...)`` works
+inside ordinary handlers (BASELINE.json north_star).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any
+
+from gofr_tpu.config import Config, EnvConfig
+from gofr_tpu.container.datasources import wire_provider
+from gofr_tpu.logging import Level, Logger, new_logger, start_remote_level_poller
+from gofr_tpu.logging.level import parse_level
+from gofr_tpu.metrics import Manager, new_metrics_manager
+from gofr_tpu.tracing import BatchSpanProcessor, Tracer, build_exporter, new_tracer
+from gofr_tpu import version
+
+
+class Container:
+    """Holds every cross-cutting dependency handlers may use."""
+
+    def __init__(self, config: Config | None = None, logger: Logger | None = None) -> None:
+        self.config: Config = config if config is not None else EnvConfig()
+        self.app_name = self.config.get_or_default("APP_NAME", "gofr-app")
+        self.app_version = self.config.get_or_default("APP_VERSION", "dev")
+
+        if logger is not None:
+            self.logger = logger
+        else:
+            level = parse_level(self.config.get_or_default("LOG_LEVEL", "INFO"))
+            self.logger = new_logger(level)
+            remote_url = self.config.get("REMOTE_LOG_URL")
+            if remote_url:
+                interval = float(
+                    self.config.get_or_default("REMOTE_LOG_FETCH_INTERVAL", "15")
+                )
+                self._remote_log_thread = start_remote_level_poller(
+                    self.logger, remote_url, interval
+                )
+
+        self.metrics_manager: Manager = new_metrics_manager(self.logger)
+        self.tracer: Tracer = self._build_tracer()
+
+        # datasources (nil until wired by App.add_* / configure)
+        self.tpu: Any = None
+        self.sql: Any = None
+        self.redis: Any = None
+        self.pubsub: Any = None
+        self.kv_store: Any = None
+        self.file: Any = None
+        self.cache: Any = None
+        self.services: dict[str, Any] = {}
+        self.ws_manager: Any = None
+        self.extra_datasources: dict[str, Any] = {}
+        self.serving: Any = None  # continuous-batching engine (serving/)
+
+        self._closed = False
+        self._lock = threading.Lock()
+
+        self.register_framework_metrics()
+
+    # -- construction helpers -------------------------------------------------
+    def _build_tracer(self) -> Tracer:
+        exporter = build_exporter(self.config, self.logger)
+        processor = BatchSpanProcessor(exporter) if exporter is not None else None
+        ratio = float(self.config.get_or_default("TRACER_RATIO", "1"))
+        return new_tracer(self.app_name, processor, ratio)
+
+    def register_framework_metrics(self) -> None:
+        """Framework metric registration (container/container.go:252-284),
+        with the TPU-serving additions from SURVEY §5.5."""
+        m = self.metrics_manager
+        m.new_gauge("app_info", "Info for app_name and app_version")
+        m.set_gauge("app_info", 1, app_name=self.app_name, app_version=self.app_version,
+                    framework_version=version.FRAMEWORK)
+        m.new_gauge("app_go_routines", "Number of live threads (goroutine analogue)")
+        m.new_gauge("app_sys_memory_alloc", "Resident memory of the process in bytes")
+        gauge = m.get("app_go_routines")
+        if gauge is not None:
+            gauge.observe_with(lambda: {(): float(threading.active_count())})
+        mem_gauge = m.get("app_sys_memory_alloc")
+        if mem_gauge is not None:
+            mem_gauge.observe_with(lambda: {(): float(_rss_bytes())})
+        m.new_histogram("app_http_response", "Response time of HTTP requests in seconds")
+        m.new_histogram("app_http_service_response", "Response time of HTTP service requests in seconds")
+        m.new_histogram("app_sql_stats", "Response time of SQL queries in milliseconds")
+        m.new_gauge("app_sql_open_connections", "Number of open SQL connections")
+        m.new_gauge("app_sql_inuse_connections", "Number of inuse SQL connections")
+        m.new_histogram("app_redis_stats", "Response time of Redis commands in milliseconds")
+        m.new_counter("app_pubsub_publish_total_count", "Number of total publish operations")
+        m.new_counter("app_pubsub_publish_success_count", "Number of successful publish operations")
+        m.new_counter("app_pubsub_subscribe_total_count", "Number of total subscribe operations")
+        m.new_counter("app_pubsub_subscribe_success_count", "Number of successful subscribe operations")
+        # TPU serving metrics (SURVEY §5.5)
+        m.new_gauge("app_tpu_hbm_used_bytes", "HBM bytes in use per device")
+        m.new_gauge("app_tpu_hbm_limit_bytes", "HBM capacity per device")
+        m.new_gauge("app_tpu_duty_cycle", "Fraction of wall time the TPU executed in the last window")
+        m.new_gauge("app_batch_queue_depth", "Requests waiting for batch admission")
+        m.new_gauge("app_batch_occupancy", "Fraction of batch slots occupied")
+        m.new_gauge("app_kv_cache_pages_used", "Paged KV-cache pages in use")
+        m.new_histogram("app_ttft_seconds", "Time to first token")
+        m.new_histogram(
+            "app_tpot_seconds", "Time per output token",
+            buckets=(0.001, 0.0025, 0.005, 0.0075, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5),
+        )
+
+    # -- accessors mirroring the reference's API ------------------------------
+    @property
+    def metrics(self) -> Manager:
+        return self.metrics_manager
+
+    def get_http_service(self, name: str) -> Any:
+        """container.GetHTTPService (container/container.go:286-292)."""
+        return self.services.get(name)
+
+    def get_publisher(self) -> Any:
+        """container/container.go:294-300."""
+        return self.pubsub
+
+    def get_subscriber(self) -> Any:
+        return self.pubsub
+
+    def register_datasource(self, name: str, ds: Any) -> None:
+        """Wire + connect any provider-pattern datasource (external_db.go
+        Add* analogue)."""
+        wire_provider(ds, self.logger, self.metrics_manager, self.tracer)
+        if name in ("tpu", "sql", "redis", "pubsub", "kv_store", "file", "cache"):
+            setattr(self, name, ds)
+        else:
+            self.extra_datasources[name] = ds
+
+    def datasource_pairs(self) -> list[tuple[str, Any]]:
+        pairs = [
+            ("tpu", self.tpu),
+            ("sql", self.sql),
+            ("redis", self.redis),
+            ("pubsub", self.pubsub),
+            ("kv_store", self.kv_store),
+            ("file", self.file),
+            ("cache", self.cache),
+        ]
+        pairs.extend(self.extra_datasources.items())
+        return pairs
+
+    def health(self) -> dict[str, Any]:
+        from gofr_tpu.container.health import aggregate_health
+
+        return aggregate_health(self)
+
+    def close(self) -> None:
+        """container/container.go:179-199."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        for name, ds in self.datasource_pairs():
+            closer = getattr(ds, "close", None)
+            if callable(closer):
+                try:
+                    closer()
+                except Exception as exc:
+                    self.logger.debug(f"error closing {name}: {exc}")
+        if self.serving is not None and hasattr(self.serving, "stop"):
+            try:
+                self.serving.stop()
+            except Exception:
+                pass
+        self.tracer.shutdown()
+        thread = getattr(self, "_remote_log_thread", None)
+        if thread is not None:
+            thread._gofr_stop.set()
+
+
+def _rss_bytes() -> int:
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def new_container(config: Config | None = None, **kw: Any) -> Container:
+    return Container(config, **kw)

@@ -1,0 +1,44 @@
+"""Aggregated health (reference: pkg/gofr/container/health.go:8-98).
+
+Walks every datasource and registered downstream service; overall status is
+UP when all report UP, DEGRADED otherwise. Served at
+``/.well-known/health``. The TPU datasource contributes per-device state
+(HBM, duty cycle) per SURVEY §5.3.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from gofr_tpu.container.datasources import iter_health_checkers
+
+
+def aggregate_health(container: Any) -> dict[str, Any]:
+    details: dict[str, Any] = iter_health_checkers(container.datasource_pairs())
+
+    services: dict[str, Any] = {}
+    for name, svc in container.services.items():
+        check = getattr(svc, "health_check", None)
+        if callable(check):
+            try:
+                services[name] = check()
+            except Exception as exc:
+                services[name] = {"status": "DOWN", "error": str(exc)}
+    if services:
+        details["services"] = services
+
+    def _is_up(node: Any) -> bool:
+        if isinstance(node, dict):
+            status = node.get("status")
+            if status is not None and str(status).upper() not in ("UP", "OK", "HEALTHY"):
+                return False
+            return all(_is_up(v) for k, v in node.items() if k != "status")
+        return True
+
+    overall = "UP" if all(_is_up(v) for v in details.values()) else "DEGRADED"
+    return {
+        "status": overall,
+        "name": container.app_name,
+        "version": container.app_version,
+        "details": details,
+    }

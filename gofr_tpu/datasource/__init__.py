@@ -1,0 +1,7 @@
+"""Datasources (reference: pkg/gofr/datasource/).
+
+In-tree: sql (sqlite dialect of the reference's sql package), redis (RESP
+socket client + in-memory fake), kv (in-memory/file-backed), file (local FS
+abstraction), pubsub (broker interfaces + in-memory broker), and tpu — the
+native core of this build.
+"""

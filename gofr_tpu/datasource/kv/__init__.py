@@ -1,0 +1,10 @@
+"""Key-value stores.
+
+Reference parity: pkg/gofr/datasource/kv-store/ — badger (embedded, 240 LoC)
+maps to FileKVStore (embedded, persistent); dynamodb/nats-kv map to the same
+KVStore contract (container/datasources.go:366-378) as pluggable drivers.
+"""
+
+from gofr_tpu.datasource.kv.store import FileKVStore, InMemoryKVStore
+
+__all__ = ["InMemoryKVStore", "FileKVStore"]

@@ -1,0 +1,87 @@
+"""Embedded KV stores implementing the KVStore contract
+(container/datasources.go:366-378): get/set/delete + health."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any
+
+
+class KVError(KeyError):
+    pass
+
+
+class InMemoryKVStore:
+    def __init__(self) -> None:
+        self._data: dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def use_logger(self, logger: Any) -> None:
+        pass
+
+    def use_metrics(self, metrics: Any) -> None:
+        pass
+
+    def use_tracer(self, tracer: Any) -> None:
+        pass
+
+    def connect(self) -> None:
+        pass
+
+    def get(self, key: str) -> str:
+        with self._lock:
+            if key not in self._data:
+                raise KVError(key)
+            return self._data[key]
+
+    def set(self, key: str, value: str) -> None:
+        with self._lock:
+            self._data[key] = value
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+
+    def close(self) -> None:
+        pass
+
+    def health_check(self) -> dict[str, Any]:
+        return {"status": "UP", "details": {"backend": "memory", "keys": len(self._data)}}
+
+
+class FileKVStore(InMemoryKVStore):
+    """Persistent embedded store (badger analogue, kv-store/badger): an
+    append-free JSON snapshot flushed on every write — small-state durability
+    (weight-cache bookkeeping, migration versions), not a log-structured DB."""
+
+    def __init__(self, path: str = "./kv_store.json") -> None:
+        super().__init__()
+        self.path = path
+
+    def connect(self) -> None:
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                self._data = {str(k): str(v) for k, v in json.load(f).items()}
+        except (OSError, json.JSONDecodeError):
+            self._data = {}
+
+    def _flush(self) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self._data, f)
+        os.replace(tmp, self.path)
+
+    def set(self, key: str, value: str) -> None:
+        with self._lock:
+            self._data[key] = value
+            self._flush()
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+            self._flush()
+
+    def health_check(self) -> dict[str, Any]:
+        return {"status": "UP", "details": {"backend": "file", "path": self.path, "keys": len(self._data)}}

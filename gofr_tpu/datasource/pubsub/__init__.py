@@ -1,0 +1,15 @@
+"""Pub/Sub abstraction + in-memory broker.
+
+Reference parity: pkg/gofr/datasource/pubsub/ — Publisher/Subscriber/Client
+interfaces + Committer (interface.go:11-33), ``Message`` implementing the
+Request contract so subscription handlers get a normal Context
+(message.go:13-115). The in-tree brokers (kafka/google/mqtt) require
+networked services absent from this image; the in-memory broker implements
+the full contract (consumer groups, commits, backlog) and external drivers
+plug in behind the same interface.
+"""
+
+from gofr_tpu.datasource.pubsub.message import Message
+from gofr_tpu.datasource.pubsub.memory import InMemoryBroker
+
+__all__ = ["Message", "InMemoryBroker"]

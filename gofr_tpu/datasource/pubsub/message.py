@@ -1,0 +1,82 @@
+"""Pub/Sub Message — implements the Request contract.
+
+Reference parity: datasource/pubsub/message.go:13-115 — a broker message
+binds into str/int/float/bool/struct and exposes topic metadata through the
+Request accessors, so the same Handler signature serves HTTP and async
+consumers (SURVEY §3.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Callable
+
+
+class Message:
+    def __init__(
+        self,
+        topic: str,
+        value: bytes,
+        metadata: dict[str, str] | None = None,
+        committer: Callable[[], None] | None = None,
+    ) -> None:
+        self.topic = topic
+        self.value = value if isinstance(value, bytes) else str(value).encode()
+        self.metadata = metadata or {}
+        self._committer = committer
+        self.committed = False
+
+    # -- Request contract ------------------------------------------------------
+    def param(self, key: str) -> str:
+        if key == "topic":
+            return self.topic
+        return self.metadata.get(key, "")
+
+    def params(self, key: str) -> list[str]:
+        v = self.param(key)
+        return [v] if v else []
+
+    def path_param(self, key: str) -> str:
+        return self.param(key)
+
+    def header(self, key: str) -> str:
+        return self.metadata.get(key.lower(), "")
+
+    def host_name(self) -> str:
+        return ""
+
+    def bind(self, target: Any) -> Any:
+        """message.go:45-115: bind payload to primitives or structs."""
+        text = self.value.decode("utf-8", "replace")
+        if target is None or target is str:
+            return text
+        if target is bytes:
+            return self.value
+        if target is int:
+            return int(text)
+        if target is float:
+            return float(text)
+        if target is bool:
+            return text.strip().lower() in ("1", "true", "yes")
+        data = json.loads(text)
+        if target is dict:
+            return data
+        if isinstance(target, dict):
+            target.clear()
+            target.update(data)
+            return target
+        cls = target if isinstance(target, type) else type(target)
+        if dataclasses.is_dataclass(cls):
+            names = {f.name for f in dataclasses.fields(cls)}
+            return cls(**{k: v for k, v in data.items() if k in names})
+        obj = target if not isinstance(target, type) else cls()
+        for k, v in data.items():
+            setattr(obj, k, v)
+        return obj
+
+    # -- Committer (interface.go Committer) ------------------------------------
+    def commit(self) -> None:
+        self.committed = True
+        if self._committer is not None:
+            self._committer()

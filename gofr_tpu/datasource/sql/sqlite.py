@@ -1,0 +1,186 @@
+"""SQLite-backed DB implementing the DB contract.
+
+Reference parity: datasource/sql/db.go — every operation logs a QUERY line
+and records ``app_sql_stats`` (db.go:47-66); ``select`` fills dataclasses or
+dicts by column name (db.go:214-334); ``begin`` returns a Tx (db.go:124-185);
+health_check reports dialect + reachability (sql/health.go). The reference's
+MySQL/Postgres/Supabase/CockroachDB dialects (sql.go:212-237) map to this
+contract; sqlite ships in-tree because the image has no DB servers — the
+dialect hook (``DB_DIALECT``) keeps the seam.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import sqlite3
+import threading
+import time
+import typing
+from typing import Any
+
+
+class SQLLog:
+    """Pretty-printable query log (db.go QueryLog)."""
+
+    def __init__(self, query: str, duration_us: int) -> None:
+        self.query = query
+        self.duration = duration_us
+
+    def pretty_print(self, writer: io.TextIOBase) -> None:
+        writer.write(f"\x1b[38;5;8mSQL\x1b[0m {self.duration:>8}µs {self.query}")
+
+    def __str__(self) -> str:
+        return f"SQL {self.duration}µs {self.query}"
+
+
+class Tx:
+    def __init__(self, db: "SQLite") -> None:
+        self._db = db
+        self._conn = db._conn
+        self._conn.execute("BEGIN")
+
+    def query(self, sql: str, *args: Any) -> list[dict[str, Any]]:
+        return self._db._rows(self._conn.execute(sql, args))
+
+    def query_row(self, sql: str, *args: Any) -> dict[str, Any] | None:
+        rows = self.query(sql, *args)
+        return rows[0] if rows else None
+
+    def exec(self, sql: str, *args: Any) -> Any:
+        return self._conn.execute(sql, args)
+
+    def commit(self) -> None:
+        self._conn.commit()
+
+    def rollback(self) -> None:
+        self._conn.rollback()
+
+
+class SQLite:
+    """The in-tree SQL driver (provider pattern + DB contract)."""
+
+    dialect = "sqlite"
+
+    def __init__(self, database: str = "./app.db") -> None:
+        self.database = database
+        self._logger: Any = None
+        self._metrics: Any = None
+        self._tracer: Any = None
+        self._conn: sqlite3.Connection | None = None
+        self._lock = threading.RLock()
+
+    @classmethod
+    def from_config(cls, config: Any) -> "SQLite":
+        return cls(config.get_or_default("DB_NAME", "./app.db"))
+
+    # -- provider pattern ------------------------------------------------------
+    def use_logger(self, logger: Any) -> None:
+        self._logger = logger
+
+    def use_metrics(self, metrics: Any) -> None:
+        self._metrics = metrics
+
+    def use_tracer(self, tracer: Any) -> None:
+        self._tracer = tracer
+
+    def connect(self) -> None:
+        self._conn = sqlite3.connect(self.database, check_same_thread=False)
+        self._conn.row_factory = sqlite3.Row
+        self._conn.isolation_level = None  # explicit transactions
+        if self._logger:
+            self._logger.debug(f"connected to sqlite database {self.database}")
+
+    # -- DB contract -----------------------------------------------------------
+    def _observe(self, query: str, start: float) -> None:
+        duration_us = int((time.perf_counter() - start) * 1e6)
+        if self._logger:
+            self._logger.debug(SQLLog(query, duration_us))
+        if self._metrics:
+            self._metrics.record_histogram(
+                "app_sql_stats", duration_us / 1000.0, hostname=self.database, database=self.dialect,
+            )
+
+    def _span(self, op: str):
+        if self._tracer is not None:
+            return self._tracer.start_span(f"sql {op}", kind="client")
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def _rows(self, cursor: sqlite3.Cursor) -> list[dict[str, Any]]:
+        return [dict(row) for row in cursor.fetchall()]
+
+    def query(self, sql: str, *args: Any) -> list[dict[str, Any]]:
+        start = time.perf_counter()
+        with self._span("query"), self._lock:
+            cursor = self._conn.execute(sql, args)
+            rows = self._rows(cursor)
+        self._observe(sql, start)
+        return rows
+
+    def query_row(self, sql: str, *args: Any) -> dict[str, Any] | None:
+        rows = self.query(sql, *args)
+        return rows[0] if rows else None
+
+    def exec(self, sql: str, *args: Any) -> Any:
+        start = time.perf_counter()
+        with self._span("exec"), self._lock:
+            cursor = self._conn.execute(sql, args)
+            self._conn.commit()
+        self._observe(sql, start)
+        return cursor
+
+    def select(self, target: Any, sql: str, *args: Any) -> Any:
+        """db.go:214-334 — bind rows into a list of dataclasses/dicts."""
+        rows = self.query(sql, *args)
+        if target is None or target is dict:
+            return rows
+        if isinstance(target, type) and dataclasses.is_dataclass(target):
+            hints = typing.get_type_hints(target)
+            names = {f.name for f in dataclasses.fields(target)}
+            out = []
+            for row in rows:
+                kwargs = {}
+                for col, val in row.items():
+                    key = col if col in names else col.lower()
+                    if key in names:
+                        hint = hints.get(key)
+                        if hint in (int, float, str, bool) and val is not None:
+                            val = hint(val)
+                        kwargs[key] = val
+                out.append(target(**kwargs))
+            return out
+        raise TypeError("select target must be dict or a dataclass type")
+
+    def begin(self) -> Tx:
+        self._lock.acquire()
+        try:
+            return Tx(self)
+        finally:
+            self._lock.release()
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+    def health_check(self) -> dict[str, Any]:
+        try:
+            with self._lock:
+                self._conn.execute("SELECT 1")
+            return {"status": "UP", "details": {"database": self.database, "dialect": self.dialect}}
+        except Exception as exc:
+            return {"status": "DOWN", "details": {"database": self.database, "error": str(exc)}}
+
+
+def new_sql(config: Any) -> SQLite:
+    """Dialect dispatch (sql.go:212-237). Only sqlite is in-image; other
+    dialects raise with a clear message so apps fail fast."""
+    dialect = config.get_or_default("DB_DIALECT", "sqlite").lower()
+    if dialect != "sqlite":
+        raise ValueError(
+            f"DB_DIALECT={dialect} requires an external driver module; "
+            "in-tree support is sqlite"
+        )
+    return SQLite.from_config(config)

@@ -1,0 +1,37 @@
+"""HTTP plumbing (reference: pkg/gofr/http/)."""
+
+from gofr_tpu.http.errors import (
+    ErrorClientClosedRequest,
+    ErrorEntityAlreadyExist,
+    ErrorEntityNotFound,
+    ErrorInvalidParam,
+    ErrorInvalidRoute,
+    ErrorMissingParam,
+    ErrorPanicRecovery,
+    ErrorRequestTimeout,
+    ErrorServiceUnavailable,
+    ErrorTooManyRequests,
+    HTTPError,
+)
+from gofr_tpu.http.request import Request, UploadedFile
+from gofr_tpu.http.responder import Responder, WireResponse
+from gofr_tpu.http.router import Router
+
+__all__ = [
+    "HTTPError",
+    "ErrorInvalidRoute",
+    "ErrorEntityNotFound",
+    "ErrorEntityAlreadyExist",
+    "ErrorInvalidParam",
+    "ErrorMissingParam",
+    "ErrorRequestTimeout",
+    "ErrorClientClosedRequest",
+    "ErrorPanicRecovery",
+    "ErrorServiceUnavailable",
+    "ErrorTooManyRequests",
+    "Request",
+    "UploadedFile",
+    "Responder",
+    "WireResponse",
+    "Router",
+]

@@ -1,0 +1,162 @@
+"""Typed HTTP errors with status codes and log levels.
+
+Reference parity: pkg/gofr/http/errors.go (187 LoC) — ErrorInvalidRoute (404),
+ErrorRequestTimeout (408), ErrorPanicRecovery (500), ErrorClientClosedRequest
+(499), ErrorMissingParam, ErrorInvalidParam, ErrorEntityNotFound,
+ErrorEntityAlreadyExist; errors carry both an HTTP status and the level they
+log at (logging/logger.go:262-270).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from gofr_tpu.logging.level import Level
+
+
+class HTTPError(Exception):
+    """Base for framework errors: carries status_code and log level."""
+
+    status_code: int = 500
+    level: Level = Level.ERROR
+
+    def __init__(self, message: str = "") -> None:
+        super().__init__(message or self.__class__.default_message())
+        self.message = message or self.__class__.default_message()
+
+    @classmethod
+    def default_message(cls) -> str:
+        return "internal server error"
+
+    def log_level(self) -> Level:
+        return self.level
+
+    def response_fields(self) -> dict[str, Any] | None:
+        """Custom error payload fields (ResponseMarshaller analogue,
+        http/responder.go:163-183). Override to add fields."""
+        return None
+
+
+class ErrorInvalidRoute(HTTPError):
+    status_code = 404
+    level = Level.INFO
+
+    @classmethod
+    def default_message(cls) -> str:
+        return "route not registered"
+
+
+class ErrorEntityNotFound(HTTPError):
+    status_code = 404
+    level = Level.INFO
+
+    def __init__(self, name: str = "entity", value: str = "") -> None:
+        self.name, self.value = name, value
+        super().__init__(f"No entity found with {name}: {value}")
+
+
+class ErrorEntityAlreadyExist(HTTPError):
+    status_code = 409
+    level = Level.WARN
+
+    @classmethod
+    def default_message(cls) -> str:
+        return "entity already exists"
+
+
+class ErrorInvalidParam(HTTPError):
+    status_code = 400
+    level = Level.INFO
+
+    def __init__(self, *params: str) -> None:
+        self.params = list(params)
+        count = len(self.params)
+        super().__init__(f"'{count}' invalid parameter(s): {', '.join(self.params)}")
+
+
+class ErrorMissingParam(HTTPError):
+    status_code = 400
+    level = Level.INFO
+
+    def __init__(self, *params: str) -> None:
+        self.params = list(params)
+        count = len(self.params)
+        super().__init__(f"'{count}' missing parameter(s): {', '.join(self.params)}")
+
+
+class ErrorValidation(HTTPError):
+    status_code = 400
+    level = Level.INFO
+
+    def __init__(self, *errors: str) -> None:
+        self.errors = list(errors)
+        super().__init__("validation failed: " + "; ".join(self.errors))
+
+
+class ErrorRequestTimeout(HTTPError):
+    status_code = 408
+    level = Level.INFO
+
+    @classmethod
+    def default_message(cls) -> str:
+        return "request timed out"
+
+
+class ErrorClientClosedRequest(HTTPError):
+    status_code = 499
+    level = Level.INFO
+
+    @classmethod
+    def default_message(cls) -> str:
+        return "client closed request"
+
+
+class ErrorPanicRecovery(HTTPError):
+    status_code = 500
+    level = Level.ERROR
+
+    @classmethod
+    def default_message(cls) -> str:
+        return "some unexpected error has occurred"
+
+
+class ErrorServiceUnavailable(HTTPError):
+    status_code = 503
+    level = Level.WARN
+
+    @classmethod
+    def default_message(cls) -> str:
+        return "service unavailable"
+
+
+class ErrorTooManyRequests(HTTPError):
+    """TPU-build addition: admission control rejection when the batch queue is
+    saturated (continuous-batching backpressure)."""
+
+    status_code = 429
+    level = Level.WARN
+
+    @classmethod
+    def default_message(cls) -> str:
+        return "server overloaded, retry later"
+
+
+def status_from_error(err: BaseException | None, method: str, has_data: bool) -> int:
+    """Map (error, method) -> HTTP status (http/responder.go:102-159):
+    no error: GET/PUT/PATCH→200, POST→201 (202 when partial), DELETE→204;
+    typed errors use their own status; unknown errors → 500; data+error →
+    206 partial content."""
+    if err is None:
+        if method == "POST":
+            return 201
+        if method == "DELETE":
+            return 204
+        return 200
+    if has_data:
+        return 206
+    if isinstance(err, HTTPError):
+        return err.status_code
+    status = getattr(err, "status_code", None)
+    if isinstance(status, int) and 100 <= status <= 599:
+        return status
+    return 500

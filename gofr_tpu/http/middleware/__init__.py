@@ -1,0 +1,37 @@
+"""HTTP middleware chain.
+
+Reference parity: pkg/gofr/http/middleware/ — registered in the order
+Tracer → Logging → CORS → Metrics (http_server.go:36-41), then optional auth
+and the WebSocket upgrade. A middleware here is
+``Callable[[next_handler], handler]`` over async wire handlers.
+"""
+
+from gofr_tpu.http.middleware.core import (
+    Middleware,
+    WireHandler,
+    chain,
+    cors_middleware,
+    logging_middleware,
+    metrics_middleware,
+    tracing_middleware,
+)
+from gofr_tpu.http.middleware.auth import (
+    AuthProvider,
+    api_key_auth_middleware,
+    basic_auth_middleware,
+    oauth_middleware,
+)
+
+__all__ = [
+    "Middleware",
+    "WireHandler",
+    "chain",
+    "tracing_middleware",
+    "logging_middleware",
+    "cors_middleware",
+    "metrics_middleware",
+    "AuthProvider",
+    "basic_auth_middleware",
+    "api_key_auth_middleware",
+    "oauth_middleware",
+]

@@ -1,0 +1,22 @@
+"""Leveled, structured, trace-aware logging.
+
+Reference parity: pkg/gofr/logging/ — ``Logger`` interface (logger.go:26-42),
+levels DEBUG..FATAL (level.go:12-19), JSON-or-pretty selection by TTY
+(logger.go:88-92,234-246), error-defined log level (logger.go:262-270),
+trace-id-injecting ContextLogger (ctx_logger.go:14-67), and the
+remote-log-level poller (remotelogger/dynamic_level_logger.go:141-277).
+"""
+
+from gofr_tpu.logging.level import Level
+from gofr_tpu.logging.logger import ContextLogger, Logger, PrettyPrint, new_logger
+from gofr_tpu.logging.remote import RemoteLevelService, start_remote_level_poller
+
+__all__ = [
+    "Level",
+    "Logger",
+    "ContextLogger",
+    "PrettyPrint",
+    "new_logger",
+    "RemoteLevelService",
+    "start_remote_level_poller",
+]

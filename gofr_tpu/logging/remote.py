@@ -1,0 +1,72 @@
+"""Remote log-level management.
+
+Reference parity: pkg/gofr/logging/remotelogger/dynamic_level_logger.go:141-277
+— a background poller fetches ``{"data":[{"serviceName":..., "logLevel":...}]}``
+from ``REMOTE_LOG_URL`` every ``REMOTE_LOG_FETCH_INTERVAL`` seconds (default
+15) and applies the level via ``change_level`` on the live logger. Wired as
+the default logger path by the Container when the URL is configured
+(container/container.go:101-113).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from typing import Any
+
+from gofr_tpu.logging.level import Level, parse_level
+
+DEFAULT_FETCH_INTERVAL_SECONDS = 15.0
+
+
+class RemoteLevelService:
+    """Fetches the desired log level from a remote endpoint."""
+
+    def __init__(self, url: str, timeout: float = 5.0) -> None:
+        self.url = url
+        self.timeout = timeout
+
+    def fetch_level(self) -> Level | None:
+        try:
+            with urllib.request.urlopen(self.url, timeout=self.timeout) as resp:
+                body = json.loads(resp.read().decode("utf-8"))
+        except Exception:
+            return None
+        data: Any = body.get("data")
+        if isinstance(data, dict):
+            data = [data]
+        if not isinstance(data, list):
+            return None
+        for item in data:
+            lvl = item.get("logLevel") or item.get("LOG_LEVEL")
+            if isinstance(lvl, dict):
+                lvl = lvl.get("LOG_LEVEL")
+            if lvl:
+                return parse_level(str(lvl))
+        return None
+
+
+def start_remote_level_poller(
+    logger: Any,
+    url: str,
+    interval: float = DEFAULT_FETCH_INTERVAL_SECONDS,
+    stop_event: threading.Event | None = None,
+) -> threading.Thread:
+    """Spawn the level-poll daemon thread (dynamic_level_logger.go:141-166)."""
+    svc = RemoteLevelService(url)
+    stop = stop_event or threading.Event()
+
+    def loop() -> None:
+        while not stop.wait(interval):
+            level = svc.fetch_level()
+            if level is not None and level != logger.level:
+                logger.info(
+                    "LOG_LEVEL updated from %s to %s" % (logger.level.name, level.name)
+                )
+                logger.change_level(level)
+
+    t = threading.Thread(target=loop, name="remote-log-level", daemon=True)
+    t._gofr_stop = stop  # type: ignore[attr-defined]
+    t.start()
+    return t

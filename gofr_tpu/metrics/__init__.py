@@ -1,0 +1,18 @@
+"""Metrics: instrument registry + Prometheus exposition.
+
+Reference parity: pkg/gofr/metrics/ — ``Manager`` with new_counter /
+new_updown_counter / new_histogram / new_gauge and set/delete for gauges
+(register.go:16-277), a name->instrument store (store.go), served in
+Prometheus text format on the metrics port (handler.go:13-52,
+exporters/exporter.go:15-32).
+
+TPU additions registered by the tpu datasource: ``app_tpu_hbm_used_bytes``,
+``app_tpu_hbm_free_bytes``, ``app_tpu_duty_cycle``, ``app_batch_queue_depth``,
+``app_batch_occupancy``, ``app_ttft_seconds``, ``app_tpot_seconds`` (SURVEY
+§5.5).
+"""
+
+from gofr_tpu.metrics.register import Manager, new_metrics_manager
+from gofr_tpu.metrics.store import MetricsError
+
+__all__ = ["Manager", "new_metrics_manager", "MetricsError"]

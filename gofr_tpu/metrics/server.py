@@ -1,0 +1,61 @@
+"""The metrics port: /metrics + diagnostics.
+
+Reference parity: pkg/gofr/metrics/handler.go:13-52 + metrics_server.go —
+Prometheus exposition on :2121/metrics, plus the pprof-style debug surface
+(/debug/pprof/* in the reference; here /debug/threads, /debug/gc,
+/debug/vars — Python's runtime diagnostics) and health/alive.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import sys
+import threading
+import traceback
+from typing import Any
+
+from gofr_tpu.http.responder import WireResponse
+
+
+class MetricsHandler:
+    def __init__(self, container: Any) -> None:
+        self.container = container
+
+    async def __call__(self, req: Any) -> WireResponse:
+        path = req.path
+        if path == "/metrics":
+            body = self.container.metrics_manager.expose_prometheus().encode()
+            return WireResponse(headers={"Content-Type": "text/plain; version=0.0.4"}, body=body)
+        if path == "/.well-known/alive":
+            return _json({"status": "UP"})
+        if path == "/.well-known/health":
+            return _json(self.container.health())
+        if path == "/debug/threads" or path == "/debug/pprof/goroutine":
+            lines = []
+            frames = sys._current_frames()
+            for t in threading.enumerate():
+                lines.append(f"--- {t.name} (daemon={t.daemon}) ---")
+                frame = frames.get(t.ident or -1)
+                if frame:
+                    lines.extend(l.rstrip() for l in traceback.format_stack(frame))
+            return WireResponse(headers={"Content-Type": "text/plain"}, body="\n".join(lines).encode())
+        if path == "/debug/gc" or path == "/debug/pprof/heap":
+            stats = {"gc_stats": gc.get_stats(), "objects": len(gc.get_objects())}
+            return _json(stats)
+        if path == "/debug/vars":
+            return _json(
+                {
+                    "threads": threading.active_count(),
+                    "app": self.container.app_name,
+                    "version": self.container.app_version,
+                }
+            )
+        return WireResponse(status=404, body=b"404 not found")
+
+
+def _json(obj: Any) -> WireResponse:
+    return WireResponse(
+        headers={"Content-Type": "application/json"},
+        body=json.dumps(obj, default=str).encode(),
+    )

@@ -1,0 +1,106 @@
+"""Pub/Sub subscription manager.
+
+Reference parity: pkg/gofr/subscriber.go — one task per topic
+(run.go:140-151, gofr.go:152-168), an infinite poll loop with 2 s backoff on
+error (subscriber.go:27-44), per-message Context built from the Message
+(which implements the Request contract), panic recovery, and commit-on-
+success at-least-once semantics (subscriber.go:46-81).
+
+This loop is also the blueprint for the async inference worker: a Whisper
+ASR subscriber binds audio jobs and feeds the same continuous-batching queue
+(SURVEY §3.4).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Awaitable, Callable
+
+from gofr_tpu.context import Context
+
+ERROR_BACKOFF_SECONDS = 2.0
+
+SubscribeFunc = Callable[[Context], Any]
+
+
+class SubscriptionManager:
+    def __init__(self, container: Any) -> None:
+        self.container = container
+        self.subscriptions: dict[str, SubscribeFunc] = {}
+        self._tasks: list[asyncio.Task] = []
+        self._stopping = False
+
+    def register(self, topic: str, handler: SubscribeFunc) -> None:
+        self.subscriptions[topic] = handler
+
+    async def start(self) -> None:
+        if not self.subscriptions:
+            return
+        if self.container.get_subscriber() is None:
+            self.container.logger.error(
+                "subscriptions registered but no PubSub configured; skipping"
+            )
+            return
+        for topic, handler in self.subscriptions.items():
+            self._tasks.append(
+                asyncio.create_task(self._loop(topic, handler), name=f"subscriber-{topic}")
+            )
+
+    async def stop(self) -> None:
+        self._stopping = True
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+
+    async def _loop(self, topic: str, handler: SubscribeFunc) -> None:
+        """subscriber.go:27-44."""
+        logger = self.container.logger
+        subscriber = self.container.get_subscriber()
+        while not self._stopping:
+            try:
+                msg = await _maybe_await(subscriber.subscribe(topic))
+            except asyncio.CancelledError:
+                return
+            except Exception as exc:
+                logger.error(f"error subscribing to topic {topic}: {exc}")
+                await asyncio.sleep(ERROR_BACKOFF_SECONDS)
+                continue
+            if msg is None:
+                await asyncio.sleep(0)  # driver returned nothing; yield
+                continue
+            await self._handle(topic, msg, handler)
+
+    async def _handle(self, topic: str, msg: Any, handler: SubscribeFunc) -> None:
+        """subscriber.go:46-81: context from message, panic recovery,
+        commit-on-success."""
+        container = self.container
+        metrics = container.metrics_manager
+        metrics.increment_counter("app_pubsub_subscribe_total_count", topic=topic)
+        span = container.tracer.start_span(f"subscribe {topic}", kind="consumer")
+        try:
+            with span:
+                ctx = Context(msg, container)
+                try:
+                    result = handler(ctx)
+                    if asyncio.iscoroutine(result):
+                        result = await result
+                except Exception as exc:
+                    container.logger.error(
+                        f"error in subscriber handler for topic {topic}: {exc}"
+                    )
+                    return
+                metrics.increment_counter("app_pubsub_subscribe_success_count", topic=topic)
+                commit = getattr(msg, "commit", None)
+                if callable(commit):
+                    await _maybe_await(commit())
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:
+            container.logger.error(f"subscriber loop error for {topic}: {exc}")
+
+
+async def _maybe_await(value: Any) -> Any:
+    if isinstance(value, Awaitable):
+        return await value
+    return value

@@ -1,0 +1,20 @@
+"""Test utilities.
+
+Reference parity: pkg/gofr/testutil/ — free-port allocation (port.go:14-27),
+server-config env setup (port.go:50-70), stdout/stderr capture
+(os.go:8-36). Plus the mock container (container/mock_container.go:96) — the
+central fake backend for handler tests.
+"""
+
+from gofr_tpu.testutil.ports import get_free_port, new_server_configs
+from gofr_tpu.testutil.capture import stdout_output_for_func, stderr_output_for_func
+from gofr_tpu.testutil.mock_container import MockContainer, new_mock_container
+
+__all__ = [
+    "get_free_port",
+    "new_server_configs",
+    "stdout_output_for_func",
+    "stderr_output_for_func",
+    "MockContainer",
+    "new_mock_container",
+]

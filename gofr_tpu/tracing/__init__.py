@@ -1,0 +1,45 @@
+"""Distributed tracing: spans, W3C tracecontext propagation, exporters.
+
+Reference parity: the reference wires the OTel SDK end-to-end (pkg/gofr/
+otel.go:20-55: global TracerProvider, ratio sampler ``TRACER_RATIO``, batch
+span processor; exporter selection by ``TRACE_EXPORTER`` = otlp/jaeger/
+zipkin/gofr, otel.go:81-144 + exporter.go:49-125). This package provides the
+same surface natively: contextvar-propagated spans, W3C ``traceparent``
+parse/inject, a ratio sampler, a batching export pipeline, and zipkin-JSON /
+console exporters. Trace ids surface in every log line and in the
+``X-Correlation-ID`` response header, as in the reference
+(ctx_logger.go:36-42, middleware/logger.go:101).
+
+TPU addition (SURVEY §5.1): device-side events — XLA compile/execute spans
+emitted by the tpu datasource attach to the same trace tree.
+"""
+
+from gofr_tpu.tracing.trace import (
+    Span,
+    Tracer,
+    current_span,
+    extract_traceparent,
+    format_traceparent,
+    new_tracer,
+)
+from gofr_tpu.tracing.export import (
+    BatchSpanProcessor,
+    ConsoleExporter,
+    InMemoryExporter,
+    ZipkinJSONExporter,
+    build_exporter,
+)
+
+__all__ = [
+    "Span",
+    "Tracer",
+    "current_span",
+    "extract_traceparent",
+    "format_traceparent",
+    "new_tracer",
+    "BatchSpanProcessor",
+    "ConsoleExporter",
+    "InMemoryExporter",
+    "ZipkinJSONExporter",
+    "build_exporter",
+]

@@ -1,0 +1,177 @@
+"""Span export pipeline: batch processor + exporters.
+
+Reference parity: batch span processor + exporter selection by
+``TRACE_EXPORTER`` env (otel.go:81-144); the "gofr" exporter posts
+zipkin-style JSON (exporter.go:23-125); console exporter for dev.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import urllib.request
+from typing import Any
+
+from gofr_tpu.tracing.trace import Span
+
+
+class InMemoryExporter:
+    """Collects spans for tests."""
+
+    def __init__(self) -> None:
+        self.spans: list[Span] = []
+        self._lock = threading.Lock()
+
+    def export(self, spans: list[Span]) -> None:
+        with self._lock:
+            self.spans.extend(spans)
+
+    def shutdown(self) -> None:
+        pass
+
+
+class ConsoleExporter:
+    def __init__(self, logger: Any = None) -> None:
+        self._logger = logger
+
+    def export(self, spans: list[Span]) -> None:
+        for s in spans:
+            line = f"span={s.name} trace={s.trace_id} id={s.span_id} dur_us={s.duration_us:.0f}"
+            if self._logger is not None:
+                self._logger.debug(line)
+            else:
+                print(line)
+
+    def shutdown(self) -> None:
+        pass
+
+
+class ZipkinJSONExporter:
+    """POSTs zipkin-v2 JSON batches, the wire shape of the reference's custom
+    "gofr" exporter (exporter.go:49-125)."""
+
+    def __init__(self, url: str, service_name: str = "gofr-app", timeout: float = 5.0, logger: Any = None) -> None:
+        self.url = url
+        self.service_name = service_name
+        self.timeout = timeout
+        self._logger = logger
+
+    def export(self, spans: list[Span]) -> None:
+        payload = [
+            {
+                "id": s.span_id,
+                "traceId": s.trace_id,
+                "parentId": s.parent_id,
+                "name": s.name,
+                "timestamp": s.start_ns // 1000,
+                "duration": max(1, int(s.duration_us)),
+                "kind": s.kind.upper(),
+                "localEndpoint": {"serviceName": self.service_name},
+                "tags": {str(k): str(v) for k, v in s.attributes.items()},
+                "annotations": [
+                    {"timestamp": ts // 1000, "value": name} for ts, name, _ in s.events
+                ],
+            }
+            for s in spans
+        ]
+        try:
+            req = urllib.request.Request(
+                self.url,
+                data=json.dumps(payload).encode("utf-8"),
+                headers={"Content-Type": "application/json"},
+            )
+            urllib.request.urlopen(req, timeout=self.timeout).close()
+        except Exception as exc:
+            if self._logger is not None:
+                self._logger.debug(f"span export failed: {exc}")
+
+    def shutdown(self) -> None:
+        pass
+
+
+class BatchSpanProcessor:
+    """Buffers finished spans and exports in batches from a daemon thread
+    (otel.go batch span processor semantics)."""
+
+    def __init__(self, exporter: Any, max_batch: int = 512, interval: float = 2.0, max_queue: int = 4096) -> None:
+        self._exporter = exporter
+        self._queue: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._max_batch = max_batch
+        self._interval = interval
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, name="span-export", daemon=True)
+        self._thread.start()
+
+    def on_end(self, span: Span) -> None:
+        try:
+            self._queue.put_nowait(span)
+        except queue.Full:
+            pass  # drop rather than block the hot path
+
+    def _drain(self) -> list[Span]:
+        batch: list[Span] = []
+        while len(batch) < self._max_batch:
+            try:
+                batch.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        return batch
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            batch = self._drain()
+            if batch:
+                self._exporter.export(batch)
+        # final flush
+        batch = self._drain()
+        if batch:
+            self._exporter.export(batch)
+
+    def force_flush(self) -> None:
+        batch = self._drain()
+        if batch:
+            self._exporter.export(batch)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._exporter.shutdown()
+
+
+class SimpleSpanProcessor:
+    """Synchronous export — used in tests."""
+
+    def __init__(self, exporter: Any) -> None:
+        self._exporter = exporter
+
+    def on_end(self, span: Span) -> None:
+        self._exporter.export([span])
+
+    def force_flush(self) -> None:
+        pass
+
+    def shutdown(self) -> None:
+        self._exporter.shutdown()
+
+
+def build_exporter(config: Any, logger: Any = None) -> Any | None:
+    """Exporter selection by TRACE_EXPORTER (otel.go:81-144): 'gofr'/'zipkin'
+    → zipkin JSON POST, 'console' → console, 'otlp'/'jaeger' → zipkin JSON to
+    TRACER_URL (native OTLP wire protocol is out of scope; the collector URL
+    shape is preserved), anything else → None (tracing disabled)."""
+    name = (config.get("TRACE_EXPORTER") or "").lower()
+    if not name:
+        return None
+    service = config.get_or_default("APP_NAME", "gofr-app")
+    if name == "console":
+        return ConsoleExporter(logger)
+    url = config.get("TRACER_URL")
+    if name in ("gofr",):
+        url = url or "https://tracer-api.gofr.dev/api/spans"
+        return ZipkinJSONExporter(url, service, logger=logger)
+    if name in ("zipkin", "otlp", "jaeger") and url:
+        return ZipkinJSONExporter(url, service, logger=logger)
+    if logger is not None:
+        logger.error(f"unsupported TRACE_EXPORTER: {name}")
+    return None

@@ -1,0 +1,3 @@
+"""Framework version (reference: pkg/gofr/version/version.go:3)."""
+
+FRAMEWORK = "dev"

@@ -1,0 +1,32 @@
+"""Test configuration.
+
+Per SURVEY §4's implication: CI never needs TPU hardware — JAX runs on CPU
+with 8 virtual devices so multi-chip sharding paths (TP/DP/SP meshes) are
+exercised for real, the way the reference tests multi-node behavior against
+single-node service containers (.github/workflows/go.yml:38-77).
+"""
+
+import os
+import sys
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import asyncio  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def run_async():
+    """Run a coroutine to completion on a fresh event loop."""
+
+    def runner(coro):
+        return asyncio.run(coro)
+
+    return runner

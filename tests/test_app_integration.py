@@ -1,0 +1,217 @@
+"""In-process integration tests: boot the real app on free ports and hit
+real HTTP endpoints (reference model: examples/http-server/main_test.go:35-84,
+SURVEY §4 tier 3)."""
+
+import asyncio
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import gofr_tpu
+from gofr_tpu.config import MapConfig
+from gofr_tpu.http.errors import ErrorEntityNotFound
+from gofr_tpu.testutil import get_free_port
+
+
+@pytest.fixture
+def app_client():
+    """Boot an App in a background thread; yields (app, base_url, fetch)."""
+    started: list = []
+
+    def build(register):
+        http_port = get_free_port()
+        metrics_port = get_free_port()
+        config = MapConfig(
+            {
+                "HTTP_PORT": str(http_port),
+                "METRICS_PORT": str(metrics_port),
+                "APP_NAME": "test-app",
+                "LOG_LEVEL": "ERROR",
+            },
+            use_env=False,
+        )
+        app = gofr_tpu.App(config)
+        register(app)
+        thread = threading.Thread(target=app.run, daemon=True)
+        thread.start()
+        base = f"http://127.0.0.1:{http_port}"
+        _wait_ready(base + "/.well-known/alive")
+        started.append((app, thread))
+        return app, base, f"http://127.0.0.1:{metrics_port}"
+
+    yield build
+    for app, thread in started:
+        app.stop()
+        thread.join(timeout=10)
+
+
+def _wait_ready(url, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=1):
+                return
+        except (urllib.error.URLError, ConnectionError, OSError):
+            time.sleep(0.05)
+    raise TimeoutError(f"server at {url} did not come up")
+
+
+def fetch(url, method="GET", body=None, headers=None):
+    req = urllib.request.Request(url, data=body, method=method, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def test_basic_routes_and_envelope(app_client):
+    def register(app):
+        app.get("/hello", lambda ctx: {"message": "hi"})
+        app.post("/items", lambda ctx: ctx.bind(dict))
+        app.get("/user/{id}", lambda ctx: {"id": ctx.path_param("id")})
+
+        def failing(ctx):
+            raise ErrorEntityNotFound("user", "9")
+
+        app.get("/missing", failing)
+
+    app, base, _ = app_client(register)
+
+    status, headers, body = fetch(base + "/hello")
+    assert status == 200
+    assert json.loads(body) == {"data": {"message": "hi"}}
+    assert "X-Correlation-ID" in headers  # trace id surfaced
+
+    status, _, body = fetch(
+        base + "/items", "POST", json.dumps({"a": 1}).encode(),
+        {"Content-Type": "application/json"},
+    )
+    assert status == 201  # POST → 201
+    assert json.loads(body)["data"] == {"a": 1}
+
+    status, _, body = fetch(base + "/user/77")
+    assert json.loads(body)["data"]["id"] == "77"
+
+    status, _, body = fetch(base + "/missing")
+    assert status == 404
+
+    status, _, body = fetch(base + "/not-registered")
+    assert status == 404
+    assert "route not registered" in json.loads(body)["error"]["message"]
+
+
+def test_panic_isolation_returns_500(app_client):
+    def register(app):
+        def exploding(ctx):
+            raise RuntimeError("kaboom")
+
+        app.get("/explode", exploding)
+
+    app, base, _ = app_client(register)
+    status, _, body = fetch(base + "/explode")
+    assert status == 500
+    assert json.loads(body)["error"]["message"] == "some unexpected error has occurred"
+
+
+def test_health_alive_metrics_endpoints(app_client):
+    app, base, metrics_base = app_client(lambda app: None)
+
+    status, _, body = fetch(base + "/.well-known/alive")
+    assert status == 200 and json.loads(body)["data"]["status"] == "UP"
+
+    status, _, body = fetch(base + "/.well-known/health")
+    health = json.loads(body)["data"]
+    assert health["status"] == "UP"
+    assert health["name"] == "test-app"
+
+    # metrics port exposes Prometheus text incl. framework metrics
+    status, _, body = fetch(metrics_base + "/metrics")
+    text = body.decode()
+    assert status == 200
+    assert "app_info" in text
+    assert "app_http_response" in text
+
+
+def test_http_metrics_recorded_with_route_template(app_client):
+    def register(app):
+        app.get("/user/{id}", lambda ctx: {"ok": True})
+
+    app, base, metrics_base = app_client(register)
+    fetch(base + "/user/1")
+    fetch(base + "/user/2")
+    _, _, body = fetch(metrics_base + "/metrics")
+    text = body.decode()
+    assert 'path="/user/{id}"' in text  # low-cardinality label
+
+
+def test_cors_headers_and_options(app_client):
+    def register(app):
+        app.get("/x", lambda ctx: "ok")
+        app.put("/x", lambda ctx: "ok")
+
+    app, base, _ = app_client(register)
+    status, headers, _ = fetch(base + "/x", "OPTIONS")
+    assert status == 200
+    assert headers["Access-Control-Allow-Origin"] == "*"
+    assert "GET" in headers["Access-Control-Allow-Methods"]
+    assert "PUT" in headers["Access-Control-Allow-Methods"]
+
+
+def test_basic_auth(app_client):
+    def register(app):
+        app.enable_basic_auth({"admin": "secret"})
+        app.get("/private", lambda ctx: {"user": ctx.get_auth_info().get_username()})
+
+    app, base, _ = app_client(register)
+    status, _, _ = fetch(base + "/private")
+    assert status == 401
+    import base64
+
+    creds = base64.b64encode(b"admin:secret").decode()
+    status, _, body = fetch(base + "/private", headers={"Authorization": f"Basic {creds}"})
+    assert status == 200
+    assert json.loads(body)["data"]["user"] == "admin"
+    # probe paths stay open (auth.go:38-57)
+    status, _, _ = fetch(base + "/.well-known/alive")
+    assert status == 200
+
+
+def test_request_timeout(app_client):
+    def register(app):
+        app.config._values["REQUEST_TIMEOUT"] = "1"
+
+        def slow(ctx):
+            time.sleep(5)
+            return "done"
+
+        app.get("/slow", slow)
+
+    app, base, _ = app_client(register)
+    start = time.time()
+    status, _, _ = fetch(base + "/slow")
+    assert status == 408
+    assert time.time() - start < 4
+
+
+def test_streaming_chunked_response(app_client):
+    def register(app):
+        from gofr_tpu.http.responder import WireResponse
+
+        async def stream(ctx):
+            async def gen():
+                for i in range(3):
+                    yield f"tok{i} ".encode()
+
+            return WireResponse(headers={"Content-Type": "text/plain"}, stream=gen())
+
+        app.get("/stream", stream)
+
+    app, base, _ = app_client(register)
+    status, headers, body = fetch(base + "/stream")
+    assert status == 200
+    assert body == b"tok0 tok1 tok2 "

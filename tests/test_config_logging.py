@@ -1,0 +1,108 @@
+"""Config layering + logger behavior (reference test model: config and
+logging package unit tests)."""
+
+import json
+import os
+
+from gofr_tpu.config import EnvConfig, MapConfig, load_env_file
+from gofr_tpu.logging import Level, new_logger
+from gofr_tpu.logging.logger import ContextLogger
+from gofr_tpu.testutil import stdout_output_for_func, stderr_output_for_func
+
+
+def test_env_file_layering(tmp_path, monkeypatch):
+    configs = tmp_path / "configs"
+    configs.mkdir()
+    (configs / ".env").write_text("APP_NAME=base\nHTTP_PORT=8000\nQUOTED=\"hello world\"\n")
+    (configs / ".local.env").write_text("APP_NAME=local\n")
+    monkeypatch.delenv("APP_ENV", raising=False)
+    monkeypatch.delenv("APP_NAME", raising=False)
+
+    cfg = EnvConfig(str(configs))
+    assert cfg.get("APP_NAME") == "local"  # override layer wins over base
+    assert cfg.get("HTTP_PORT") == "8000"
+    assert cfg.get("QUOTED") == "hello world"
+
+    # real env beats files (godotenv.go:36-91)
+    monkeypatch.setenv("APP_NAME", "from-env")
+    assert cfg.get("APP_NAME") == "from-env"
+    assert cfg.get_or_default("MISSING", "fallback") == "fallback"
+
+
+def test_app_env_selects_override_file(tmp_path, monkeypatch):
+    configs = tmp_path / "configs"
+    configs.mkdir()
+    (configs / ".env").write_text("X=base\n")
+    (configs / ".staging.env").write_text("X=staging\n")
+    monkeypatch.setenv("APP_ENV", "staging")
+    monkeypatch.delenv("X", raising=False)
+    assert EnvConfig(str(configs)).get("X") == "staging"
+
+
+def test_env_file_parsing_edge_cases(tmp_path):
+    f = tmp_path / ".env"
+    f.write_text("# comment\n\nexport KEY=val\nINLINE=v # comment\nBAD_LINE\nEMPTY=\n")
+    parsed = load_env_file(str(f))
+    assert parsed == {"KEY": "val", "INLINE": "v", "EMPTY": ""}
+
+
+def test_logger_json_output_and_levels():
+    def emit():
+        logger = new_logger(Level.INFO, exit_on_fatal=False)
+        logger.debug("hidden")
+        logger.info("visible %s", 42)
+
+    out = stdout_output_for_func(emit)
+    lines = [json.loads(l) for l in out.strip().splitlines()]
+    assert len(lines) == 1
+    assert lines[0]["message"] == "visible 42"
+    assert lines[0]["level"] == "INFO"
+
+
+def test_logger_error_goes_to_stderr():
+    def emit():
+        new_logger(Level.INFO, exit_on_fatal=False).error("boom")
+
+    err = stderr_output_for_func(emit)
+    assert "boom" in err
+
+
+def test_error_defined_log_level():
+    from gofr_tpu.http.errors import ErrorEntityNotFound
+
+    def emit():
+        logger = new_logger(Level.INFO, exit_on_fatal=False)
+        logger.log_error(ErrorEntityNotFound("id", "7"))
+
+    out = stdout_output_for_func(emit)  # INFO-level error logs to stdout
+    assert "No entity found with id: 7" in out
+
+
+def test_context_logger_injects_trace_id():
+    def emit():
+        base = new_logger(Level.INFO, exit_on_fatal=False)
+        ContextLogger(base, trace_id="abc123", span_id="def").info("hello")
+
+    out = stdout_output_for_func(emit)
+    entry = json.loads(out.strip())
+    assert entry["trace_id"] == "abc123"
+    assert entry["span_id"] == "def"
+
+
+def test_remote_level_service_parsing(monkeypatch):
+    from gofr_tpu.logging.remote import RemoteLevelService
+
+    svc = RemoteLevelService("http://example.invalid/level")
+
+    class FakeResp:
+        def read(self):
+            return json.dumps({"data": [{"serviceName": "app", "logLevel": "DEBUG"}]}).encode()
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            pass
+
+    monkeypatch.setattr("urllib.request.urlopen", lambda url, timeout: FakeResp())
+    assert svc.fetch_level() == Level.DEBUG

@@ -1,0 +1,167 @@
+"""Router, request binding, responder, errors — HTTP-core unit tests
+(reference model: pkg/gofr/http/*_test.go)."""
+
+import dataclasses
+import json
+
+import pytest
+
+from gofr_tpu.http.errors import (
+    ErrorEntityNotFound,
+    ErrorInvalidRoute,
+    ErrorPanicRecovery,
+    status_from_error,
+)
+from gofr_tpu.http.request import BindError, Request, UploadedFile
+from gofr_tpu.http.responder import Responder
+from gofr_tpu.http.response import File, Raw, Redirect, Response
+from gofr_tpu.http.router import Router
+
+
+def make_request(method="GET", path="/", body=b"", content_type=None, headers=None):
+    h = dict(headers or {})
+    if content_type:
+        h["Content-Type"] = content_type
+    return Request(method, path, {}, h, body)
+
+
+# ---------------------------------------------------------------- router
+def test_router_path_params():
+    r = Router()
+    r.add("GET", "/user/{id}", "h1")
+    r.add("POST", "/user", "h2")
+    handler, params = r.lookup("GET", "/user/42")
+    assert handler == "h1" and params == {"id": "42"}
+    assert r.lookup("GET", "/user") is None
+    assert r.lookup("POST", "/user")[0] == "h2"
+    assert r.lookup("DELETE", "/nope") is None
+
+
+def test_router_wildcard_and_template():
+    r = Router()
+    r.add("GET", "/files/{path...}", "h")
+    handler, params = r.lookup("GET", "/files/a/b/c.txt")
+    assert params == {"path": "a/b/c.txt"}
+    assert r.route_template("GET", "/files/a/b/c.txt") == "/files/{path...}"
+
+
+def test_router_registered_methods_for_cors():
+    r = Router()
+    r.add("GET", "/x", "h")
+    r.add("PUT", "/x", "h")
+    assert r.registered_methods() == ["GET", "PUT"]
+
+
+# ---------------------------------------------------------------- binding
+@dataclasses.dataclass
+class UserIn:
+    name: str = ""
+    age: int = 0
+    active: bool = False
+
+
+def test_bind_json_to_dataclass():
+    req = make_request(
+        "POST", "/u", json.dumps({"name": "ada", "age": 36, "ignored": 1}).encode(),
+        "application/json",
+    )
+    user = req.bind(UserIn)
+    assert user.name == "ada" and user.age == 36
+
+
+def test_bind_json_invalid_raises():
+    req = make_request("POST", "/u", b"{not json", "application/json")
+    with pytest.raises(BindError):
+        req.bind(dict)
+
+
+def test_bind_form_urlencoded_with_coercion():
+    req = make_request(
+        "POST", "/u", b"name=grace&age=45&active=true",
+        "application/x-www-form-urlencoded",
+    )
+    user = req.bind(UserIn)
+    assert user.age == 45 and user.active is True
+
+
+def test_bind_multipart_with_file():
+    boundary = "XX"
+    body = (
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; name="name"\r\n\r\n'
+        "linus\r\n"
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; name="upload"; filename="a.txt"\r\n'
+        "Content-Type: text/plain\r\n\r\n"
+        "file-content\r\n"
+        f"--{boundary}--\r\n"
+    ).encode()
+    req = make_request("POST", "/u", body, f"multipart/form-data; boundary={boundary}")
+    fields = req.bind(dict)
+    assert fields["name"] == "linus"
+    assert isinstance(fields["upload"], UploadedFile)
+    assert fields["upload"].read() == b"file-content"
+
+
+def test_bind_binary():
+    req = make_request("POST", "/u", b"\x00\x01", "application/octet-stream")
+    assert req.bind(bytes) == b"\x00\x01"
+
+
+def test_params_comma_split():
+    req = Request("GET", "/", {"tag": ["a,b", "c"]}, {}, b"")
+    assert req.params("tag") == ["a", "b", "c"]
+    assert req.param("tag") == "a,b"
+
+
+# ---------------------------------------------------------------- status mapping
+def test_status_mapping():
+    assert status_from_error(None, "GET", True) == 200
+    assert status_from_error(None, "POST", True) == 201
+    assert status_from_error(None, "DELETE", False) == 204
+    assert status_from_error(ErrorEntityNotFound(), "GET", False) == 404
+    assert status_from_error(ValueError("x"), "GET", False) == 500
+    assert status_from_error(ValueError("x"), "GET", True) == 206  # partial
+
+
+# ---------------------------------------------------------------- responder
+def test_responder_json_envelope():
+    resp = Responder().respond({"k": "v"}, None, "GET")
+    assert resp.status == 200
+    assert json.loads(resp.body) == {"data": {"k": "v"}}
+
+
+def test_responder_error_envelope():
+    resp = Responder().respond(None, ErrorEntityNotFound("id", "9"), "GET")
+    assert resp.status == 404
+    body = json.loads(resp.body)
+    assert "No entity found" in body["error"]["message"]
+
+
+def test_responder_special_types():
+    r = Responder()
+    raw = r.respond(Raw({"a": 1}), None, "GET")
+    assert json.loads(raw.body) == {"a": 1}  # no envelope
+    f = r.respond(File(b"bytes", "image/png"), None, "GET")
+    assert f.body == b"bytes" and f.headers["Content-Type"] == "image/png"
+    red = r.respond(Redirect("/login"), None, "GET")
+    assert red.status == 302 and red.headers["Location"] == "/login"
+
+
+def test_responder_response_envelope_with_metadata_and_headers():
+    resp = Responder().respond(
+        Response(data=[1], metadata={"count": 1}, headers={"X-Custom": "y"}), None, "GET"
+    )
+    body = json.loads(resp.body)
+    assert body["data"] == [1] and body["metadata"] == {"count": 1}
+    assert resp.headers["X-Custom"] == "y"
+
+
+def test_dataclass_result_serialization():
+    @dataclasses.dataclass
+    class Out:
+        name: str
+        tags: list
+
+    resp = Responder().respond(Out("x", ["a"]), None, "GET")
+    assert json.loads(resp.body)["data"] == {"name": "x", "tags": ["a"]}

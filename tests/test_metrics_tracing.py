@@ -1,0 +1,92 @@
+"""Metrics manager + tracing unit tests."""
+
+import math
+
+from gofr_tpu.metrics import new_metrics_manager
+from gofr_tpu.tracing import (
+    InMemoryExporter,
+    Tracer,
+    current_span,
+    extract_traceparent,
+    format_traceparent,
+)
+from gofr_tpu.tracing.export import SimpleSpanProcessor
+
+
+def test_counter_and_exposition():
+    m = new_metrics_manager()
+    m.new_counter("reqs", "requests")
+    m.increment_counter("reqs", method="GET")
+    m.increment_counter("reqs", method="GET")
+    m.increment_counter("reqs", method="POST")
+    text = m.expose_prometheus()
+    assert 'reqs{method="GET"} 2' in text
+    assert 'reqs{method="POST"} 1' in text
+    assert "# TYPE reqs counter" in text
+
+
+def test_histogram_buckets_and_percentile():
+    m = new_metrics_manager()
+    m.new_histogram("lat", "latency", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 0.05):
+        m.record_histogram("lat", v)
+    text = m.expose_prometheus()
+    assert 'lat_bucket{le="0.01"} 1' in text
+    assert 'lat_bucket{le="+Inf"} 4' in text
+    assert "lat_count 4" in text
+    inst = m.get("lat")
+    assert inst.percentile(0.5) == 0.1
+
+
+def test_gauge_set_delete():
+    m = new_metrics_manager()
+    m.new_gauge("g", "gauge")
+    m.set_gauge("g", 5, chip="0")
+    assert m.get("g").value({"chip": "0"}) == 5
+    m.delete_gauge("g", chip="0")
+    assert math.isnan(m.get("g").value({"chip": "0"}))
+
+
+def test_unknown_metric_does_not_raise():
+    m = new_metrics_manager()
+    m.increment_counter("nope")  # logged (no logger here), never raises
+
+
+def test_span_hierarchy_and_export():
+    exporter = InMemoryExporter()
+    tracer = Tracer("test", SimpleSpanProcessor(exporter))
+    with tracer.start_span("parent") as parent:
+        assert current_span() is parent
+        with tracer.start_span("child") as child:
+            assert child.trace_id == parent.trace_id
+            assert child.parent_id == parent.span_id
+    assert current_span() is None
+    names = [s.name for s in exporter.spans]
+    assert names == ["child", "parent"]
+
+
+def test_traceparent_roundtrip():
+    tracer = Tracer("test")
+    span = tracer.start_span("s", activate=False)
+    header = format_traceparent(span)
+    parsed = extract_traceparent(header)
+    assert parsed == (span.trace_id, span.span_id)
+    assert extract_traceparent("garbage") is None
+    assert extract_traceparent(None) is None
+
+
+def test_remote_parent_continues_trace():
+    tracer = Tracer("test")
+    span = tracer.start_span(
+        "s", remote_trace_id="a" * 32, remote_span_id="b" * 16, activate=False
+    )
+    assert span.trace_id == "a" * 32
+    assert span.parent_id == "b" * 16
+
+
+def test_ratio_sampler_deterministic():
+    tracer = Tracer("test", sample_ratio=0.0)
+    span = tracer.start_span("s", activate=False)
+    assert span.sampled is False
+    tracer2 = Tracer("test", sample_ratio=1.0)
+    assert tracer2.start_span("s", activate=False).sampled is True
