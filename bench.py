@@ -1,0 +1,92 @@
+"""Benchmark entry point (driver contract): prints ONE JSON line
+``{"metric", "value", "unit", "vs_baseline"}``.
+
+Round-1 benchmark: single-chip Llama-family batched decode throughput —
+the core of the north-star metric. BASELINE.json's target is >1,000 req/s
+aggregate on v5e-8 for Llama-3-8B /generate; with ~128 output tokens per
+request that is ~128k generated tok/s over 8 chips ⇒ **16k tok/s per chip**.
+``vs_baseline`` is measured tokens/s divided by that per-chip target (the
+reference itself publishes no numbers — BASELINE.md).
+
+Model under test: a 1.1B-param Llama-shape (d=2048, L=16, GQA 16/8,
+ff=8192) in bf16 — big enough to exercise MXU/HBM realistically, small
+enough to init on-chip in seconds. Batch 32, decode via the production
+``decode_step`` path (scan over layers, dense KV cache, donated buffers).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, "/root/repo")
+    from gofr_tpu.models import llama
+
+    platform = jax.devices()[0].platform
+
+    cfg = llama.LlamaConfig(
+        vocab_size=32128,
+        d_model=2048,
+        n_layers=16,
+        n_heads=16,
+        n_kv_heads=8,
+        d_ff=8192,
+        max_seq_len=2048,
+        dtype=jnp.bfloat16,
+    )
+    if platform not in ("tpu",):
+        # CPU fallback so the bench never crashes off-TPU; tiny shapes
+        cfg = llama.LlamaConfig.tiny(dtype=jnp.bfloat16)
+
+    batch = 32 if platform == "tpu" else 4
+    prompt_len = 128 if platform == "tpu" else 8
+    decode_steps = 64 if platform == "tpu" else 4
+    cache_len_max = prompt_len + decode_steps + 8
+
+    key = jax.random.PRNGKey(0)
+    params = llama.init_params(cfg, key)
+    params = jax.device_put(params)
+
+    tokens = jax.random.randint(key, (batch, prompt_len), 0, cfg.vocab_size)
+    seq_lens = jnp.full((batch,), prompt_len, jnp.int32)
+    cache = llama.KVCache.create(cfg, batch, max_len=cache_len_max)
+
+    # compile + warmup
+    last, cache = llama.prefill(cfg, params, tokens, cache, seq_lens)
+    next_tokens = jnp.argmax(last, axis=-1)
+    cache_len = seq_lens
+    cache_len = cache_len + 1
+    last, cache = llama.decode_step(cfg, params, next_tokens, cache, cache_len)
+    jax.block_until_ready(last)
+
+    # timed decode loop (async dispatch, one sync at the end)
+    start = time.perf_counter()
+    for _ in range(decode_steps):
+        cache_len = cache_len + 1
+        last, cache = llama.decode_step(cfg, params, next_tokens, cache, cache_len)
+        next_tokens = jnp.argmax(last, axis=-1)
+    jax.block_until_ready(next_tokens)
+    elapsed = time.perf_counter() - start
+
+    tokens_per_sec = batch * decode_steps / elapsed
+    per_chip_target = 16000.0  # derived from the 1k req/s north star, see module docstring
+    print(
+        json.dumps(
+            {
+                "metric": f"llama1b_decode_tokens_per_sec_bs{batch}_{platform}",
+                "value": round(tokens_per_sec, 2),
+                "unit": "tokens/s",
+                "vs_baseline": round(tokens_per_sec / per_chip_target, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

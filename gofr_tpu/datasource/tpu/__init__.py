@@ -1,0 +1,15 @@
+"""The ``tpu`` datasource — the native core of this build.
+
+BASELINE.json north star: ``ctx.tpu.execute(...)`` inside ordinary handlers.
+The reference has no accelerator; SURVEY §2.9 maps the requirement: device/
+topology discovery, executable compile-or-load cache, execution with device
+buffers, HBM stats into health/metrics, all behind the provider pattern so
+the Container wires it like any datasource.
+
+Backend: JAX's PJRT runtime (libtpu on TPU, CPU plugin for dev/CI —
+``TPU_PJRT_PLUGIN``/``JAX_PLATFORMS`` selects, SURVEY §7 phase 3).
+"""
+
+from gofr_tpu.datasource.tpu.client import TPUClient, new_tpu
+
+__all__ = ["TPUClient", "new_tpu"]

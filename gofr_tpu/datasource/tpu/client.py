@@ -1,0 +1,246 @@
+"""TPUClient: device mesh ownership + executable cache + execution.
+
+Design (SURVEY §7 phase 3):
+- ``connect`` discovers devices through PJRT (via JAX), builds the named
+  mesh from ``TPU_MESH`` (parallel/mesh.py), enables the persistent XLA
+  compilation cache (``TPU_COMPILE_CACHE_DIR``) — the "migration-style
+  version bookkeeping for compiled-executable caches" of SURVEY §5.4.
+- ``compile(name, fn, *abstract_args)`` lowers+compiles ahead-of-time and
+  stores the LoadedExecutable under ``name`` (keyed cache, compile-or-load).
+- ``execute(name, *args)`` runs it, wrapped in a span, recording duty-cycle
+  and HBM gauges.
+- ``health_check`` reports per-device state (SURVEY §5.3: a wedged device
+  must not take down the server — execution errors are caught and surface
+  as DEGRADED health + typed 503s upstream).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any
+
+import jax
+
+from gofr_tpu.parallel.mesh import MeshSpec, build_mesh
+
+
+class TPUError(Exception):
+    status_code = 503
+
+    def log_level(self):  # late import to avoid cycle
+        from gofr_tpu.logging.level import Level
+
+        return Level.ERROR
+
+
+class TPUClient:
+    def __init__(
+        self,
+        mesh_spec: str | MeshSpec | None = None,
+        platform: str | None = None,
+        compile_cache_dir: str | None = None,
+    ) -> None:
+        self.mesh_spec = mesh_spec
+        self.platform = platform
+        self.compile_cache_dir = compile_cache_dir
+        self._logger: Any = None
+        self._metrics: Any = None
+        self._tracer: Any = None
+        self._mesh: Any = None
+        self._devices: list = []
+        self._executables: dict[str, Any] = {}
+        self._exec_meta: dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._busy_ns = 0
+        self._window_start = time.monotonic()
+        self._last_error: str | None = None
+
+    @classmethod
+    def from_config(cls, config: Any) -> "TPUClient":
+        return cls(
+            mesh_spec=config.get("TPU_MESH"),
+            platform=config.get("TPU_PJRT_PLUGIN"),
+            compile_cache_dir=config.get("TPU_COMPILE_CACHE_DIR"),
+        )
+
+    # -- provider pattern ------------------------------------------------------
+    def use_logger(self, logger: Any) -> None:
+        self._logger = logger
+
+    def use_metrics(self, metrics: Any) -> None:
+        self._metrics = metrics
+
+    def use_tracer(self, tracer: Any) -> None:
+        self._tracer = tracer
+
+    def connect(self) -> None:
+        if self.compile_cache_dir:
+            jax.config.update("jax_compilation_cache_dir", self.compile_cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        self._devices = jax.devices(self.platform) if self.platform else jax.devices()
+        spec = self.mesh_spec
+        if isinstance(spec, str):
+            spec = MeshSpec.parse(spec)
+        self._mesh = build_mesh(spec, self._devices)
+        if self._logger:
+            kinds = {d.device_kind for d in self._devices}
+            self._logger.info(
+                f"tpu datasource connected: {len(self._devices)} device(s) "
+                f"({', '.join(sorted(kinds))}), mesh={dict(zip(self._mesh.axis_names, self._mesh.devices.shape))}"
+            )
+        self._publish_hbm_gauges()
+
+    # -- TPU contract ----------------------------------------------------------
+    def device_count(self) -> int:
+        return len(self._devices)
+
+    def mesh(self) -> Any:
+        return self._mesh
+
+    def compile(
+        self,
+        name: str,
+        fn: Any,
+        *abstract_args: Any,
+        in_shardings: Any = None,
+        out_shardings: Any = None,
+        donate_argnums: Any = (),
+        static_argnums: Any = (),
+        **jit_kw: Any,
+    ) -> Any:
+        """AOT compile ``fn`` for the given abstract args (ShapeDtypeStructs
+        or example arrays) and cache under ``name``."""
+        with self._span(f"tpu.compile {name}"):
+            start = time.perf_counter()
+            kw: dict[str, Any] = dict(jit_kw)
+            if in_shardings is not None:
+                kw["in_shardings"] = in_shardings
+            if out_shardings is not None:
+                kw["out_shardings"] = out_shardings
+            jitted = jax.jit(
+                fn, donate_argnums=donate_argnums, static_argnums=static_argnums, **kw
+            )
+            try:
+                lowered = jitted.lower(*abstract_args)
+                compiled = lowered.compile()
+            except Exception as exc:
+                self._last_error = f"compile {name}: {exc}"
+                raise TPUError(f"compilation of {name} failed: {exc}") from exc
+            elapsed = time.perf_counter() - start
+        with self._lock:
+            self._executables[name] = compiled
+            self._exec_meta[name] = {
+                "compile_seconds": elapsed,
+                "flops": _cost_value(compiled, "flops"),
+                "bytes_accessed": _cost_value(compiled, "bytes accessed"),
+            }
+        if self._logger:
+            self._logger.info(f"compiled executable {name} in {elapsed:.2f}s")
+        return compiled
+
+    def get_executable(self, name: str) -> Any:
+        with self._lock:
+            return self._executables.get(name)
+
+    def execute(self, name: str, *args: Any, block: bool = False) -> Any:
+        """Run a cached executable. Async by default (JAX dispatch);
+        ``block=True`` waits for completion (bench paths)."""
+        compiled = self.get_executable(name)
+        if compiled is None:
+            raise TPUError(f"executable {name} not compiled")
+        start = time.perf_counter_ns()
+        with self._span(f"tpu.execute {name}"):
+            try:
+                out = compiled(*args)
+                if block:
+                    jax.block_until_ready(out)
+            except Exception as exc:
+                self._last_error = f"execute {name}: {exc}"
+                raise TPUError(f"execution of {name} failed: {exc}") from exc
+        busy = time.perf_counter_ns() - start
+        self._observe_execution(name, busy)
+        return out
+
+    def _observe_execution(self, name: str, busy_ns: int) -> None:
+        with self._lock:
+            self._busy_ns += busy_ns
+            window = time.monotonic() - self._window_start
+            if window >= 10.0:
+                duty = min(1.0, self._busy_ns / 1e9 / window)
+                if self._metrics:
+                    self._metrics.set_gauge("app_tpu_duty_cycle", duty)
+                self._busy_ns = 0
+                self._window_start = time.monotonic()
+        if self._metrics:
+            self._metrics.record_histogram(
+                "app_http_service_response", busy_ns / 1e9,
+                type="tpu_execute", executable=name,
+            )
+
+    # -- memory / health -------------------------------------------------------
+    def hbm_stats(self) -> dict[str, Any]:
+        per_device = []
+        for d in self._devices:
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:
+                stats = {}
+            per_device.append(
+                {
+                    "device": str(d.id),
+                    "kind": getattr(d, "device_kind", "unknown"),
+                    "bytes_in_use": stats.get("bytes_in_use", 0),
+                    "bytes_limit": stats.get("bytes_limit", 0),
+                    "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
+                }
+            )
+        return {"devices": per_device}
+
+    def _publish_hbm_gauges(self) -> None:
+        if not self._metrics:
+            return
+        for dev in self.hbm_stats()["devices"]:
+            self._metrics.set_gauge("app_tpu_hbm_used_bytes", dev["bytes_in_use"], device=dev["device"])
+            self._metrics.set_gauge("app_tpu_hbm_limit_bytes", dev["bytes_limit"], device=dev["device"])
+
+    def health_check(self) -> dict[str, Any]:
+        if not self._devices:
+            return {"status": "DOWN", "details": {"error": "not connected"}}
+        self._publish_hbm_gauges()
+        details: dict[str, Any] = {
+            "platform": self._devices[0].platform,
+            "device_count": len(self._devices),
+            "mesh": dict(zip(self._mesh.axis_names, self._mesh.devices.shape)) if self._mesh else None,
+            "executables": sorted(self._executables),
+            "hbm": self.hbm_stats()["devices"],
+        }
+        if self._last_error:
+            details["last_error"] = self._last_error
+            return {"status": "DEGRADED", "details": details}
+        return {"status": "UP", "details": details}
+
+    def close(self) -> None:
+        with self._lock:
+            self._executables.clear()
+
+    # -- helpers ---------------------------------------------------------------
+    def _span(self, name: str):
+        if self._tracer is not None:
+            return self._tracer.start_span(name, kind="client")
+        return contextlib.nullcontext()
+
+
+def _cost_value(compiled: Any, key: str) -> float | None:
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, list):
+            analysis = analysis[0] if analysis else {}
+        return float(analysis.get(key)) if analysis and key in analysis else None
+    except Exception:
+        return None
+
+
+def new_tpu(config: Any) -> TPUClient:
+    return TPUClient.from_config(config)

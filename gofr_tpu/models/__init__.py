@@ -1,0 +1,16 @@
+"""Model families.
+
+The serving framework's model zoo (BASELINE.json configs):
+- llama: decoder-only LLM family (Llama-3 shapes; flagship)
+- bert: encoder embedder (/embed endpoint)
+- whisper: encoder-decoder ASR (async Pub/Sub path)
+
+All models are pure-functional JAX: a config dataclass, an ``init`` returning
+a params pytree, and jit-compiled apply functions. Layers are stacked and
+scanned (lax.scan) so compile time is flat in depth; weights are bf16 by
+default with f32 accumulation inside ops.
+"""
+
+from gofr_tpu.models import llama, bert
+
+__all__ = ["llama", "bert"]

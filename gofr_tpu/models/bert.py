@@ -1,0 +1,138 @@
+"""BERT-style encoder for the /embed endpoint (BASELINE.json configs[1]).
+
+Pure-functional JAX, stacked layers + lax.scan like the llama module.
+BERT-base shape: 12L/12H/768d/3072ff/30522V.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from gofr_tpu.ops.attention import attention
+from gofr_tpu.ops.norms import layer_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 512
+    n_types: int = 2
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def base(cls, **kw: Any) -> "BertConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw: Any) -> "BertConfig":
+        defaults = dict(
+            vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+            max_seq_len=64, dtype=jnp.float32,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+def init_params(cfg: BertConfig, key: jax.Array) -> dict:
+    ks = jax.random.split(key, 12)
+    L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
+
+    def winit(key: jax.Array, shape: tuple, fan_in: int) -> jnp.ndarray:
+        return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(cfg.dtype)
+
+    return {
+        "embedding": winit(ks[0], (cfg.vocab_size, D), D),
+        "pos_embedding": winit(ks[1], (cfg.max_seq_len, D), D),
+        "type_embedding": winit(ks[2], (cfg.n_types, D), D),
+        "embed_norm_scale": jnp.ones((D,), jnp.float32),
+        "embed_norm_bias": jnp.zeros((D,), jnp.float32),
+        "layers": {
+            "wq": winit(ks[3], (L, D, D), D),
+            "wk": winit(ks[4], (L, D, D), D),
+            "wv": winit(ks[5], (L, D, D), D),
+            "wo": winit(ks[6], (L, D, D), D),
+            "w_inter": winit(ks[7], (L, D, F), D),
+            "w_out": winit(ks[8], (L, F, D), F),
+            "attn_norm_scale": jnp.ones((L, D), jnp.float32),
+            "attn_norm_bias": jnp.zeros((L, D), jnp.float32),
+            "mlp_norm_scale": jnp.ones((L, D), jnp.float32),
+            "mlp_norm_bias": jnp.zeros((L, D), jnp.float32),
+            "bq": jnp.zeros((L, D), jnp.float32),
+            "bk": jnp.zeros((L, D), jnp.float32),
+            "bv": jnp.zeros((L, D), jnp.float32),
+            "bo": jnp.zeros((L, D), jnp.float32),
+            "b_inter": jnp.zeros((L, F), jnp.float32),
+            "b_out": jnp.zeros((L, D), jnp.float32),
+        },
+        "pooler_w": winit(ks[9], (D, D), D),
+        "pooler_b": jnp.zeros((D,), jnp.float32),
+    }
+
+
+def _layer(cfg: BertConfig, x: jnp.ndarray, lp: dict, mask_len: jnp.ndarray) -> jnp.ndarray:
+    B, S, D = x.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+    q = (x @ lp["wq"] + lp["bq"].astype(x.dtype)).reshape(B, S, H, Dh)
+    k = (x @ lp["wk"] + lp["bk"].astype(x.dtype)).reshape(B, S, H, Dh)
+    v = (x @ lp["wv"] + lp["bv"].astype(x.dtype)).reshape(B, S, H, Dh)
+    attn = attention(q, k, v, causal=False, kv_len=mask_len)
+    attn = attn.reshape(B, S, D) @ lp["wo"] + lp["bo"].astype(x.dtype)
+    x = layer_norm(x + attn, lp["attn_norm_scale"], lp["attn_norm_bias"], cfg.norm_eps)
+    inter = jax.nn.gelu((x @ lp["w_inter"] + lp["b_inter"].astype(x.dtype)).astype(jnp.float32))
+    out = inter.astype(x.dtype) @ lp["w_out"] + lp["b_out"].astype(x.dtype)
+    return layer_norm(x + out, lp["mlp_norm_scale"], lp["mlp_norm_bias"], cfg.norm_eps)
+
+
+@partial(jax.jit, static_argnums=0)
+def encode(
+    cfg: BertConfig,
+    params: dict,
+    tokens: jnp.ndarray,  # [B, S] right-padded
+    seq_lens: jnp.ndarray,  # [B]
+) -> jnp.ndarray:
+    """Token encoding -> hidden states [B, S, D]."""
+    B, S = tokens.shape
+    pos = jnp.arange(S)
+    x = (
+        params["embedding"][tokens]
+        + params["pos_embedding"][pos][None, :, :]
+        + params["type_embedding"][jnp.zeros_like(tokens)]
+    ).astype(cfg.dtype)
+    x = layer_norm(x, params["embed_norm_scale"], params["embed_norm_bias"], cfg.norm_eps)
+
+    def body(h, lp):
+        return _layer(cfg, h, lp, seq_lens), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return x
+
+
+@partial(jax.jit, static_argnums=0)
+def embed(
+    cfg: BertConfig,
+    params: dict,
+    tokens: jnp.ndarray,
+    seq_lens: jnp.ndarray,
+) -> jnp.ndarray:
+    """Mean-pooled, L2-normalized sentence embedding [B, D] — the /embed
+    endpoint's payload (BASELINE.json configs[1])."""
+    hidden = encode(cfg, params, tokens, seq_lens)
+    mask = (jnp.arange(tokens.shape[1])[None, :] < seq_lens[:, None])[..., None]
+    summed = jnp.sum(hidden.astype(jnp.float32) * mask, axis=1)
+    pooled = summed / jnp.maximum(seq_lens[:, None].astype(jnp.float32), 1.0)
+    return pooled / (jnp.linalg.norm(pooled, axis=-1, keepdims=True) + 1e-12)

@@ -1,0 +1,284 @@
+"""Llama-family decoder-only transformer (flagship model).
+
+Pure-functional JAX, TPU-first:
+- stacked layer params scanned with ``lax.scan`` → one compiled layer body,
+  flat compile time in depth;
+- GQA attention ([B,S,H,D] layout, f32 softmax), RoPE, SwiGLU MLP, RMSNorm;
+- bf16 weights/activations, f32 accumulation (``preferred_element_type``);
+- dense per-request KV cache (paged cache lives in serving/kv_cache.py);
+- sharding-agnostic: weights carry no mesh references — ShardingRules
+  (parallel/sharding.py) place them, XLA inserts the ICI collectives.
+
+Shapes follow Llama-3: 8B = 32L/32H/8KV/4096d/14336ff/128256V,
+70B = 80L/64H/8KV/8192d/28672ff (BASELINE.json configs[2]/[4]).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from gofr_tpu.ops.attention import attention, decode_attention
+from gofr_tpu.ops.norms import rms_norm
+from gofr_tpu.ops.rope import apply_rope, rope_table
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    # -- presets ---------------------------------------------------------------
+    @classmethod
+    def llama3_8b(cls, **kw: Any) -> "LlamaConfig":
+        return cls(**kw)
+
+    @classmethod
+    def llama3_70b(cls, **kw: Any) -> "LlamaConfig":
+        return cls(
+            d_model=8192, n_layers=80, n_heads=64, n_kv_heads=8, d_ff=28672, **kw
+        )
+
+    @classmethod
+    def tiny(cls, **kw: Any) -> "LlamaConfig":
+        """Test-size config: runs on CPU in milliseconds."""
+        defaults = dict(
+            vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=128, max_seq_len=128, dtype=jnp.float32,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
+    """Random-init params pytree with stacked layers [L, ...]."""
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
+    H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    def winit(key: jax.Array, shape: tuple, fan_in: int) -> jnp.ndarray:
+        return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(cfg.dtype)
+
+    ks = jax.random.split(k_layers, 7)
+    params: dict = {
+        "embedding": winit(k_embed, (cfg.vocab_size, D), D),
+        "layers": {
+            "wq": winit(ks[0], (L, D, H * Dh), D),
+            "wk": winit(ks[1], (L, D, Hkv * Dh), D),
+            "wv": winit(ks[2], (L, D, Hkv * Dh), D),
+            "wo": winit(ks[3], (L, H * Dh, D), H * Dh),
+            "w_gate": winit(ks[4], (L, D, F), D),
+            "w_up": winit(ks[5], (L, D, F), D),
+            "w_down": winit(ks[6], (L, F, D), F),
+            "attn_norm": jnp.ones((L, D), jnp.float32),
+            "mlp_norm": jnp.ones((L, D), jnp.float32),
+        },
+        "final_norm": jnp.ones((D,), jnp.float32),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = winit(k_head, (D, cfg.vocab_size), D)
+    return params
+
+
+def param_count(params: dict) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))
+
+
+# ---------------------------------------------------------------- KV cache
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class KVCache:
+    """Dense KV cache: [L, B, S_max, Hkv, Dh] per k/v. The serving layer's
+    paged cache (serving/kv_cache.py) converts to/from this layout for the
+    model step functions."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+    def tree_flatten(self):
+        return (self.k, self.v), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @classmethod
+    def create(cls, cfg: LlamaConfig, batch: int, max_len: int | None = None) -> "KVCache":
+        S = max_len or cfg.max_seq_len
+        shape = (cfg.n_layers, batch, S, cfg.n_kv_heads, cfg.head_dim)
+        return cls(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+# ---------------------------------------------------------------- layer body
+def _layer(
+    cfg: LlamaConfig,
+    x: jnp.ndarray,  # [B, S, D]
+    lp: dict,  # per-layer params (leading L axis stripped by scan)
+    sin: jnp.ndarray,
+    cos: jnp.ndarray,
+    positions: jnp.ndarray,  # [B, S] absolute positions
+    k_cache: jnp.ndarray | None,  # [B, S_max, Hkv, Dh]
+    v_cache: jnp.ndarray | None,
+    cache_len: jnp.ndarray | None,  # [B] length AFTER writing current tokens
+    mode: str,
+) -> tuple[jnp.ndarray, jnp.ndarray | None, jnp.ndarray | None]:
+    B, S, D = x.shape
+    H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(B, S, H, Dh)
+    k = (h @ lp["wk"]).reshape(B, S, Hkv, Dh)
+    v = (h @ lp["wv"]).reshape(B, S, Hkv, Dh)
+    q = apply_rope(q, positions, sin, cos)
+    k = apply_rope(k, positions, sin, cos)
+
+    if mode == "prefill_nocache":
+        attn = attention(q, k, v, causal=True, kv_len=None)
+        new_k = new_v = None
+    elif mode == "prefill":
+        # right-padded rows all start at 0: write the whole slab at offset 0
+        new_k = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, 0, 0))
+        new_v = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, 0, 0))
+        attn = attention(q, k, v, causal=True, kv_len=cache_len)
+    else:  # decode: S == 1, scatter at per-row positions
+        idx = cache_len - 1  # position just written
+        b_idx = jnp.arange(B)
+        new_k = k_cache.at[b_idx, idx].set(k[:, 0])
+        new_v = v_cache.at[b_idx, idx].set(v[:, 0])
+        attn = decode_attention(q, new_k, new_v, cache_len)
+
+    attn = attn.reshape(B, S, H * Dh)
+    x = x + attn @ lp["wo"]
+
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    return x, new_k, new_v
+
+
+def _run_layers(
+    cfg: LlamaConfig,
+    params: dict,
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    cache: KVCache | None,
+    cache_len: jnp.ndarray | None,
+    mode: str,
+) -> tuple[jnp.ndarray, KVCache | None]:
+    sin, cos = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+
+    if cache is None:
+        def body(h, lp):
+            h, _, _ = _layer(cfg, h, lp, sin, cos, positions, None, None, cache_len, mode)
+            return h, None
+
+        x, _ = jax.lax.scan(body, x, params["layers"])
+        return x, None
+
+    def body(h, xs):
+        lp, kc, vc = xs
+        h, nk, nv = _layer(cfg, h, lp, sin, cos, positions, kc, vc, cache_len, mode)
+        return h, (nk, nv)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    return x, KVCache(new_k, new_v)
+
+
+def _logits(cfg: LlamaConfig, params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embedding"].T if cfg.tie_embeddings else params["lm_head"]
+    return jnp.einsum("bsd,dv->bsv", x, head, preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------- entry points
+@partial(jax.jit, static_argnums=0)
+def forward(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Plain causal forward (no cache): [B, S] -> logits [B, S, V].
+    The graft entry / training-style step."""
+    B, S = tokens.shape
+    x = params["embedding"][tokens].astype(cfg.dtype)
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    x, _ = _run_layers(cfg, params, x, positions, None, None, "prefill_nocache")
+    return _logits(cfg, params, x)
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=(3,))
+def prefill(
+    cfg: LlamaConfig,
+    params: dict,
+    tokens: jnp.ndarray,  # [B, S] right-padded
+    cache: KVCache,
+    seq_lens: jnp.ndarray,  # [B] true lengths
+) -> tuple[jnp.ndarray, KVCache]:
+    """Prefill: fill the cache, return last-token logits [B, V]."""
+    B, S = tokens.shape
+    x = params["embedding"][tokens].astype(cfg.dtype)
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    x, cache = _run_layers(cfg, params, x, positions, cache, seq_lens, "prefill")
+    logits = _logits(cfg, params, x)  # [B, S, V]
+    last = jnp.take_along_axis(logits, (seq_lens - 1)[:, None, None], axis=1)[:, 0]
+    return last, cache
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=(3,))
+def decode_step(
+    cfg: LlamaConfig,
+    params: dict,
+    tokens: jnp.ndarray,  # [B] last sampled token per row
+    cache: KVCache,
+    cache_len: jnp.ndarray,  # [B] length including this token's position
+) -> tuple[jnp.ndarray, KVCache]:
+    """One decode step: [B] -> logits [B, V], cache updated in place
+    (donated)."""
+    B = tokens.shape[0]
+    x = params["embedding"][tokens][:, None, :].astype(cfg.dtype)  # [B, 1, D]
+    positions = (cache_len - 1)[:, None]  # [B, 1]
+    x, cache = _run_layers(cfg, params, x, positions, cache, cache_len, "decode")
+    logits = _logits(cfg, params, x)[:, 0]  # [B, V]
+    return logits, cache
+
+
+def greedy_generate(
+    cfg: LlamaConfig,
+    params: dict,
+    prompt: jnp.ndarray,  # [B, S] right-padded
+    seq_lens: jnp.ndarray,
+    max_new_tokens: int,
+) -> jnp.ndarray:
+    """Simple generate loop (serving uses the continuous-batching engine;
+    this is the library-level convenience + test oracle). Returns
+    [B, max_new_tokens]."""
+    B, S = prompt.shape
+    cache = KVCache.create(cfg, B, max_len=S + max_new_tokens)
+    logits, cache = prefill(cfg, params, prompt, cache, seq_lens)
+    tokens = jnp.argmax(logits, axis=-1)
+    out = [tokens]
+    cache_len = seq_lens
+    for _ in range(max_new_tokens - 1):
+        cache_len = cache_len + 1
+        logits, cache = decode_step(cfg, params, tokens, cache, cache_len)
+        tokens = jnp.argmax(logits, axis=-1)
+        out.append(tokens)
+    return jnp.stack(out, axis=1)

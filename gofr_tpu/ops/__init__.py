@@ -1,0 +1,22 @@
+"""TPU compute ops: norms, rotary embeddings, attention, sampling.
+
+All ops are pure jax (traced once under jit, static shapes, fused by XLA);
+the hot attention paths have Pallas TPU kernels in ops/flash_attention.py and
+ops/paged_attention.py with jax fallbacks selected at trace time.
+"""
+
+from gofr_tpu.ops.norms import layer_norm, rms_norm
+from gofr_tpu.ops.rope import apply_rope, rope_table
+from gofr_tpu.ops.attention import attention, decode_attention, gqa_repeat
+from gofr_tpu.ops.sampling import sample_logits
+
+__all__ = [
+    "rms_norm",
+    "layer_norm",
+    "rope_table",
+    "apply_rope",
+    "attention",
+    "decode_attention",
+    "gqa_repeat",
+    "sample_logits",
+]

@@ -1,0 +1,31 @@
+"""Rotary position embeddings (RoPE), half-rotation layout.
+
+Table is precomputed once per max length (static under jit) and gathered by
+position — decode steps index it with dynamic positions without recompute.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_table(max_len: int, head_dim: int, theta: float = 10000.0) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(sin, cos) tables of shape [max_len, head_dim//2], float32."""
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = jnp.arange(max_len, dtype=jnp.float32)[:, None] * freqs[None, :]
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(
+    x: jnp.ndarray,  # [..., seq, heads, head_dim]
+    positions: jnp.ndarray,  # [..., seq]
+    sin_table: jnp.ndarray,
+    cos_table: jnp.ndarray,
+) -> jnp.ndarray:
+    dtype = x.dtype
+    sin = jnp.take(sin_table, positions, axis=0)[..., :, None, :]  # [..., seq, 1, half]
+    cos = jnp.take(cos_table, positions, axis=0)[..., :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(dtype)

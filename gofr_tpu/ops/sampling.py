@@ -1,0 +1,61 @@
+"""Token sampling: greedy / temperature / top-k / top-p, vmappable and
+jit-stable (no data-dependent shapes — masks, not gathers)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def sample_logits(
+    logits: jnp.ndarray,  # [B, vocab]
+    key: jax.Array,
+    *,
+    temperature: jnp.ndarray | float = 1.0,
+    top_k: jnp.ndarray | int = 0,  # 0 = disabled
+    top_p: jnp.ndarray | float = 1.0,
+) -> jnp.ndarray:
+    """Returns sampled token ids [B]. temperature==0 → greedy (exact argmax,
+    not a divide-by-zero). Per-request scalars may be arrays broadcast over
+    the batch for continuous batching (each row has its own params)."""
+    logits = logits.astype(jnp.float32)
+    temperature = jnp.asarray(temperature, dtype=jnp.float32)
+    top_k = jnp.asarray(top_k, dtype=jnp.int32)
+    top_p = jnp.asarray(top_p, dtype=jnp.float32)
+
+    greedy_ids = jnp.argmax(logits, axis=-1)
+
+    safe_temp = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / _expand(safe_temp, logits)
+
+    # top-k mask: keep logits >= k-th largest (static vocab shape)
+    vocab = logits.shape[-1]
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    k_idx = jnp.clip(jnp.where(top_k > 0, top_k, vocab) - 1, 0, vocab - 1)
+    kth = jnp.take_along_axis(sorted_desc, _expand(k_idx, logits).astype(jnp.int32), axis=-1)
+    scaled = jnp.where(scaled >= kth, scaled, NEG_INF)
+
+    # top-p (nucleus): drop tokens beyond cumulative prob p in sorted order
+    sorted_scaled = jnp.sort(scaled, axis=-1)[..., ::-1]
+    probs_sorted = jax.nn.softmax(sorted_scaled, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    # keep the first token whose cumulative prob crosses p (always >=1 kept)
+    cutoff_mask = cum - probs_sorted < _expand(top_p, logits)
+    threshold = jnp.min(
+        jnp.where(cutoff_mask, sorted_scaled, jnp.inf), axis=-1, keepdims=True
+    )
+    scaled = jnp.where(scaled >= threshold, scaled, NEG_INF)
+
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    take_greedy = jnp.broadcast_to(temperature <= 0, sampled.shape)
+    return jnp.where(take_greedy, greedy_ids, sampled)
+
+
+def _expand(x: jnp.ndarray, ref: jnp.ndarray) -> jnp.ndarray:
+    """Broadcast a scalar or [B] array to [B, 1] against ref [B, vocab]."""
+    x = jnp.asarray(x)
+    if x.ndim == 0:
+        return x[None, None]
+    return x[:, None]

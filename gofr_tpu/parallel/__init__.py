@@ -1,0 +1,29 @@
+"""Parallelism: device meshes, sharding rules, collectives.
+
+SURVEY §2.9: the reference has no ML parallelism (its unit of scale is the
+stateless replica); this package provides the TPU-native equivalents —
+a named device mesh over ICI (dp/fsdp/pp/tp/sp/ep axes), per-weight sharding
+rules compiled into XLA executables (collectives inserted by the compiler,
+not hand-written NCCL), sequence/context parallelism via ring attention
+(§5.7), and host-side helpers.
+"""
+
+from gofr_tpu.parallel.mesh import MeshSpec, build_mesh, local_mesh
+from gofr_tpu.parallel.sharding import (
+    ShardingRules,
+    llama_sharding_rules,
+    named_sharding,
+    shard_params,
+    with_constraint,
+)
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "local_mesh",
+    "ShardingRules",
+    "llama_sharding_rules",
+    "named_sharding",
+    "shard_params",
+    "with_constraint",
+]

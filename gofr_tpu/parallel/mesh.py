@@ -1,0 +1,102 @@
+"""Device mesh construction.
+
+TPU-native scale-out (SURVEY §5.8): the framework's job is building/owning
+the device mesh; collectives are compiled into executables by XLA and ride
+ICI. ``TPU_MESH`` config (SURVEY §5.6 TPU_* namespace) picks the axis
+layout, e.g. ``dp=2,tp=4`` on 8 chips. Axis names are fixed vocabulary:
+
+- ``dp``  — data parallel (batch sharding)
+- ``fsdp`` — fully-sharded data parallel (weights sharded over dp group)
+- ``pp``  — pipeline stages
+- ``tp``  — tensor parallel (Megatron-style weight sharding)
+- ``sp``  — sequence/context parallel (ring attention axis, §5.7)
+- ``ep``  — expert parallel (MoE dispatch axis)
+
+Mesh axis order follows ICI topology best practice: outermost axes get the
+slower links (DCN between slices), innermost get ICI neighbors — for a
+single slice the order is (dp, fsdp, pp, sp, ep, tp) with tp innermost so
+tensor-parallel collectives ride nearest-neighbor ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_ORDER = ("dp", "fsdp", "pp", "sp", "ep", "tp")
+
+
+@dataclasses.dataclass
+class MeshSpec:
+    dp: int = 1
+    fsdp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+    tp: int = 1
+
+    @classmethod
+    def parse(cls, text: str) -> "MeshSpec":
+        """Parse ``"dp=2,tp=4"`` (TPU_MESH config value)."""
+        spec = cls()
+        if not text:
+            return spec
+        for part in text.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            m = re.match(r"^(dp|fsdp|pp|sp|ep|tp)\s*=\s*(-?\d+)$", part)
+            if not m:
+                raise ValueError(f"bad TPU_MESH entry: {part!r}")
+            setattr(spec, m.group(1), int(m.group(2)))
+        return spec
+
+    def sizes(self) -> tuple[int, ...]:
+        return tuple(getattr(self, a) for a in AXIS_ORDER)
+
+    def total(self) -> int:
+        return math.prod(s for s in self.sizes() if s > 0)
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        """Fill a single ``-1`` axis with the leftover device count (like a
+        reshape wildcard); validate the product matches."""
+        sizes = list(self.sizes())
+        if sizes.count(-1) > 1:
+            raise ValueError("at most one TPU_MESH axis may be -1")
+        if -1 in sizes:
+            known = math.prod(s for s in sizes if s != -1)
+            if n_devices % known != 0:
+                raise ValueError(f"{n_devices} devices not divisible by mesh product {known}")
+            sizes[sizes.index(-1)] = n_devices // known
+        if math.prod(sizes) != n_devices:
+            raise ValueError(
+                f"TPU_MESH product {math.prod(sizes)} != device count {n_devices}"
+            )
+        return MeshSpec(**dict(zip(AXIS_ORDER, sizes)))
+
+
+def build_mesh(spec: MeshSpec | str | None = None, devices: Any = None) -> Mesh:
+    """Create a named Mesh over the device grid. Axes of size 1 are kept —
+    sharding rules can always name them; XLA elides trivial collectives."""
+    if isinstance(spec, str):
+        spec = MeshSpec.parse(spec)
+    if devices is None:
+        devices = jax.devices()
+    if spec is None:
+        spec = MeshSpec(dp=len(devices))
+    spec = spec.resolve(len(devices))
+    grid = np.asarray(devices).reshape(spec.sizes())
+    return Mesh(grid, AXIS_ORDER)
+
+
+def local_mesh(**axes: int) -> Mesh:
+    """Convenience for tests: ``local_mesh(tp=4, dp=2)`` over however many
+    devices the platform offers."""
+    spec = MeshSpec(**axes)
+    return build_mesh(spec)

@@ -1,0 +1,128 @@
+"""Sharding rules: map parameter/activation logical axes to mesh axes.
+
+The scaling-book recipe: pick a mesh, annotate shardings on weights and a
+few activation constraint points, let XLA insert the collectives. Rules are
+(regex over param path) -> PartitionSpec. Megatron-style TP for transformer
+blocks: column-parallel in-projections (shard the output/head axis on
+``tp``), row-parallel out-projections (shard the input axis on ``tp``; XLA
+emits the psum/all-gather over ICI), embeddings sharded on vocab, and
+everything replicated over ``dp`` (batch is sharded on ``dp`` instead).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def named_sharding(mesh: Mesh, *axes: Any) -> NamedSharding:
+    return NamedSharding(mesh, P(*axes))
+
+
+class ShardingRules:
+    """Ordered (pattern -> PartitionSpec) rules applied to a params pytree by
+    path; first match wins, default replicated."""
+
+    def __init__(self, rules: list[tuple[str, P]]) -> None:
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(self, path: str) -> P:
+        for pat, spec in self.rules:
+            if pat.search(path):
+                return spec
+        return P()
+
+    def tree_specs(self, params: Any) -> Any:
+        """PartitionSpec pytree matching ``params`` by key path."""
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        treedef = jax.tree_util.tree_structure(params)
+        specs = []
+        for path, _leaf in flat:
+            path_str = "/".join(_path_key(k) for k in path)
+            specs.append(self.spec_for(path_str))
+        return jax.tree_util.tree_unflatten(treedef, specs)
+
+    def tree_shardings(self, mesh: Mesh, params: Any) -> Any:
+        return jax.tree.map(
+            lambda spec: NamedSharding(mesh, spec),
+            self.tree_specs(params),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+
+def _path_key(k: Any) -> str:
+    if hasattr(k, "key"):  # DictKey
+        return str(k.key)
+    if hasattr(k, "name"):  # GetAttrKey (NamedTuple states, e.g. optimizer mu/nu)
+        return str(k.name)
+    if hasattr(k, "idx"):  # SequenceKey
+        return str(k.idx)
+    return str(k)
+
+
+def llama_sharding_rules() -> ShardingRules:
+    """TP/FSDP rules for the Llama-family params produced by
+    gofr_tpu.models.llama (stacked-layer pytree). Axis conventions:
+
+    - wq/wk/wv: [L, d_model, heads*dh] — column-parallel: shard heads on tp,
+      d_model on fsdp
+    - wo:       [L, heads*dh, d_model] — row-parallel: shard input on tp
+      (XLA inserts the all-reduce over tp after the matmul)
+    - w_gate/w_up: [L, d_model, d_ff] — column-parallel
+    - w_down:      [L, d_ff, d_model] — row-parallel
+    - embedding [vocab, d_model] + lm_head [d_model, vocab]: shard vocab on
+      tp (logits all-gather), d_model on fsdp
+    - norms: replicated
+    """
+    return ShardingRules(
+        [
+            (r"embedding", P("tp", "fsdp")),
+            (r"lm_head", P("fsdp", "tp")),
+            (r"w[qkv]$", P(None, "fsdp", "tp")),
+            (r"wo$", P(None, "tp", "fsdp")),
+            (r"w_gate|w_up", P(None, "fsdp", "tp")),
+            (r"w_down", P(None, "tp", "fsdp")),
+            (r"norm|scale|bias", P()),
+        ]
+    )
+
+
+def bert_sharding_rules() -> ShardingRules:
+    return ShardingRules(
+        [
+            (r"embedding", P("tp", None)),
+            (r"w[qkv]$|w_inter", P(None, "fsdp", "tp")),
+            (r"wo$|w_out", P(None, "tp", "fsdp")),
+            (r"norm|scale|bias|pooler", P()),
+        ]
+    )
+
+
+def activation_spec(kind: str = "tokens") -> P:
+    """Standard activation constraint points: batch on dp(+fsdp), sequence
+    on sp, features replicated (tp acts inside layers)."""
+    if kind == "tokens":  # [batch, seq]
+        return P(("dp", "fsdp"), "sp")
+    if kind == "hidden":  # [batch, seq, d_model]
+        return P(("dp", "fsdp"), "sp", None)
+    if kind == "logits":  # [batch, seq, vocab]
+        return P(("dp", "fsdp"), "sp", "tp")
+    raise ValueError(f"unknown activation kind {kind}")
+
+
+def with_constraint(x: Any, mesh: Mesh, kind_or_spec: Any) -> Any:
+    """jax.lax.with_sharding_constraint with the standard specs; no-op
+    outside jit or when the mesh is trivial."""
+    spec = activation_spec(kind_or_spec) if isinstance(kind_or_spec, str) else kind_or_spec
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def shard_params(params: Any, mesh: Mesh, rules: ShardingRules) -> Any:
+    """Device-put a host pytree according to the rules (weight-loading
+    path: each host shards its slice; with one process this places the full
+    tree sharded across local devices)."""
+    shardings = rules.tree_shardings(mesh, params)
+    return jax.tree.map(jax.device_put, params, shardings)

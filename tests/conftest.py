@@ -4,22 +4,32 @@ Per SURVEY §4's implication: CI never needs TPU hardware — JAX runs on CPU
 with 8 virtual devices so multi-chip sharding paths (TP/DP/SP meshes) are
 exercised for real, the way the reference tests multi-node behavior against
 single-node service containers (.github/workflows/go.yml:38-77).
+
+The image pre-loads an axon/TPU sitecustomize that sets the jax_platforms
+CONFIG to "axon,cpu" (config beats the JAX_PLATFORMS env var), so tests must
+override via jax.config, not the environment. Set GOFR_TEST_TPU=1 to run the
+suite against the real chip instead.
 """
 
 import os
 import sys
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import asyncio  # noqa: E402
 
+import jax  # noqa: E402
 import pytest  # noqa: E402
+
+if os.environ.get("GOFR_TEST_TPU") != "1":
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+
+# Exact f32 matmuls in tests: the platform default uses fast bf16 passes,
+# which makes sliced-vs-full einsums differ by ~1e-2 and breaks
+# decode-vs-forward equivalence checks. Production TPU paths keep the fast
+# default (bf16 inputs are the design point).
+jax.config.update("jax_default_matmul_precision", "highest")
 
 
 @pytest.fixture

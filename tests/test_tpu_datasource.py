@@ -1,0 +1,65 @@
+"""TPU datasource: compile cache, execute, health, metrics wiring."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.config import MapConfig
+from gofr_tpu.datasource.tpu import TPUClient
+from gofr_tpu.metrics import new_metrics_manager
+
+
+@pytest.fixture
+def tpu():
+    client = TPUClient(mesh_spec="dp=8")
+    metrics = new_metrics_manager()
+    metrics.new_gauge("app_tpu_hbm_used_bytes", "")
+    metrics.new_gauge("app_tpu_hbm_limit_bytes", "")
+    metrics.new_gauge("app_tpu_duty_cycle", "")
+    metrics.new_histogram("app_http_service_response", "")
+    client.use_metrics(metrics)
+    client.connect()
+    return client
+
+
+def test_compile_and_execute(tpu):
+    def double(x):
+        return x * 2
+
+    tpu.compile("double", double, jnp.zeros((4,), jnp.float32))
+    out = tpu.execute("double", jnp.ones((4,), jnp.float32), block=True)
+    np.testing.assert_array_equal(np.asarray(out), [2, 2, 2, 2])
+    assert "double" in tpu._exec_meta
+    assert tpu.device_count() == 8
+
+
+def test_execute_unknown_raises_typed_503(tpu):
+    from gofr_tpu.datasource.tpu.client import TPUError
+
+    with pytest.raises(TPUError) as exc:
+        tpu.execute("missing", jnp.zeros(1))
+    assert exc.value.status_code == 503
+
+
+def test_health_reports_devices_and_executables(tpu):
+    def f(x):
+        return x + 1
+
+    tpu.compile("inc", f, jnp.zeros((2,)))
+    health = tpu.health_check()
+    assert health["status"] == "UP"
+    assert health["details"]["device_count"] == 8
+    assert "inc" in health["details"]["executables"]
+    assert health["details"]["mesh"]["dp"] == 8
+
+
+def test_from_config():
+    cfg = MapConfig({"TPU_MESH": "dp=2,tp=4"}, use_env=False)
+    client = TPUClient.from_config(cfg)
+    client.connect()
+    assert client.mesh().shape["tp"] == 4
+
+
+def test_unconnected_health_down():
+    client = TPUClient()
+    assert client.health_check()["status"] == "DOWN"
