@@ -16,6 +16,13 @@ from gofr_tpu.container.datasources import iter_health_checkers
 def aggregate_health(container: Any) -> dict[str, Any]:
     details: dict[str, Any] = iter_health_checkers(container.datasource_pairs())
 
+    serving = getattr(container, "serving", None)
+    if serving is not None and hasattr(serving, "health_check"):
+        try:
+            details["serving"] = serving.health_check()
+        except Exception as exc:
+            details["serving"] = {"status": "DOWN", "error": str(exc)}
+
     services: dict[str, Any] = {}
     for name, svc in container.services.items():
         check = getattr(svc, "health_check", None)

@@ -208,14 +208,23 @@ class HTTPServer:
                 if resp.stream is not None:
                     writer.write(_serialize_head(resp, chunked=True, keep_alive=keep_alive))
                     await writer.drain()
+                    # aclose() runs on EVERY exit (disconnect, abort, timeout,
+                    # cancellation) so the generator's finally-blocks fire —
+                    # that's what frees the batched-decode slot. aclose on an
+                    # exhausted generator is a no-op.
                     try:
                         async for chunk in resp.stream:
                             if not chunk:
                                 continue
                             writer.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
                             await writer.drain()
-                    except (ConnectionResetError, BrokenPipeError):
-                        return
+                    finally:
+                        aclose = getattr(resp.stream, "aclose", None)
+                        if aclose is not None:
+                            try:
+                                await aclose()
+                            except Exception:
+                                pass
                     writer.write(b"0\r\n\r\n")
                     await writer.drain()
                 else:
@@ -225,8 +234,8 @@ class HTTPServer:
                     await writer.drain()
                 if not keep_alive:
                     break
-        except (ConnectionResetError, BrokenPipeError, asyncio.IncompleteReadError):
-            pass
+        except (OSError, asyncio.IncompleteReadError, asyncio.TimeoutError):
+            pass  # client aborted/timed out; writer closed in finally
         finally:
             try:
                 writer.close()

@@ -1,0 +1,26 @@
+"""The serving engine: continuous batching between transports and the TPU.
+
+SURVEY §7 phase 4 — the TPU-native replacement for the reference's
+per-request goroutine model (§3.2): requests share compiled batch steps, so
+the unit of concurrency is the *slot*, not the thread. Components:
+
+- engine.py: the ServingEngine — admission queue, slot allocation, prefill/
+  decode interleave, per-token streaming, cancellation, metrics.
+- batch.py: jitted fixed-shape device functions (slot prefill insert,
+  batched decode+sample step).
+- tokenizer.py: tokenizer boundary (pluggable; byte-level default so the
+  stack runs with zero external assets).
+- handlers.py: ready-made HTTP handlers (/generate JSON + SSE stream,
+  /embed) that plug the engine into the App router.
+"""
+
+from gofr_tpu.serving.engine import EngineConfig, GenerationResult, ServingEngine
+from gofr_tpu.serving.tokenizer import ByteTokenizer, Tokenizer
+
+__all__ = [
+    "ServingEngine",
+    "EngineConfig",
+    "GenerationResult",
+    "Tokenizer",
+    "ByteTokenizer",
+]

@@ -1,0 +1,91 @@
+"""Jitted fixed-shape device functions for the continuous-batching engine.
+
+All shapes are static (slot count, padded prompt buckets) so everything
+compiles once per bucket and never again — the XLA contract. Slots are rows
+of a persistent batch KV cache; requests come and go between steps by
+scattering into / masking out rows, with buffers donated end-to-end so the
+cache never copies.
+
+Device-side state per engine:
+- ``SlotCache``: k/v [L, B_slots, S_max, Hkv, Dh]
+- ``cache_len``  [B_slots] valid length per slot (0 = free)
+- ``last_token`` [B_slots]
+- per-slot sampling params (temperature/top_k/top_p) + PRNG key
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from gofr_tpu.models import llama
+from gofr_tpu.ops.sampling import sample_logits
+
+
+@partial(jax.jit, static_argnums=0)
+def prefill_compute(
+    cfg: llama.LlamaConfig,
+    params: dict,
+    tokens: jnp.ndarray,  # [1, S_bucket] right-padded
+    seq_len: jnp.ndarray,  # [1]
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Run prefill WITHOUT a persistent cache: returns (last_logits [1,V],
+    k_slab, v_slab [L, S_bucket, Hkv, Dh]) for scatter into a slot."""
+    scratch = llama.KVCache.create(cfg, 1, max_len=tokens.shape[1])
+    last, cache = llama.prefill(cfg, params, tokens, scratch, seq_len)
+    return last, cache.k[:, 0], cache.v[:, 0]
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def insert_slot(
+    k_cache: jnp.ndarray,  # [L, B, S_max, Hkv, Dh] donated
+    v_cache: jnp.ndarray,
+    k_slab: jnp.ndarray,  # [L, S_bucket, Hkv, Dh]
+    v_slab: jnp.ndarray,
+    slot: jnp.ndarray,  # scalar int32
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter a prefilled slab into slot row [.., slot, :S_bucket]."""
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k_slab[:, None], (0, slot, 0, 0, 0)
+    )
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v_slab[:, None], (0, slot, 0, 0, 0)
+    )
+    return k_cache, v_cache
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=(2,))
+def decode_and_sample(
+    cfg: llama.LlamaConfig,
+    params: dict,
+    cache: llama.KVCache,  # donated
+    last_token: jnp.ndarray,  # [B]
+    cache_len: jnp.ndarray,  # [B] (>=1 even for free slots)
+    active: jnp.ndarray,  # [B] bool
+    temperature: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B] int32
+    top_p: jnp.ndarray,  # [B]
+    rng: jax.Array,
+) -> tuple[jnp.ndarray, llama.KVCache, jax.Array]:
+    """One continuous-batching decode step over all slots: forward, per-slot
+    sampling, returns (next_token [B], cache, new_rng). Inactive slots
+    compute garbage safely (cache_len clamped ≥1) and are ignored by the
+    host."""
+    step_len = jnp.where(active, cache_len + 1, 1)
+    logits, cache = llama.decode_step(cfg, params, last_token, cache, step_len)
+    rng, sample_key = jax.random.split(rng)
+    next_token = sample_logits(
+        logits, sample_key, temperature=temperature, top_k=top_k, top_p=top_p
+    )
+    return next_token, cache, rng
+
+
+def pad_bucket(length: int, buckets: tuple[int, ...]) -> int:
+    """Smallest bucket ≥ length (prompt padding, limits recompiles)."""
+    for b in buckets:
+        if length <= b:
+            return b
+    return buckets[-1]

@@ -1,0 +1,143 @@
+"""Ready-made serving handlers: /generate (JSON + SSE stream), /embed,
+/v1/models — the endpoints BASELINE.json configs[1..2] measure.
+
+Wire-up (mirrors the reference's route ergonomics)::
+
+    app = gofr_tpu.App()
+    engine = ServingEngine(cfg, params, metrics=app.container.metrics_manager)
+    register_generation_routes(app, engine)
+
+Streaming: ``"stream": true`` returns Server-Sent Events over chunked
+transfer — each token a ``data:`` line, final event carries usage stats.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+from typing import Any
+
+from gofr_tpu.http.errors import ErrorInvalidParam, ErrorMissingParam
+from gofr_tpu.http.responder import WireResponse
+
+
+@dataclasses.dataclass
+class GenerateRequest:
+    prompt: str = ""
+    max_tokens: int = 0
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    stream: bool = False
+
+
+def register_generation_routes(app: Any, engine: Any, prefix: str = "") -> None:
+    app.container.serving = engine
+    app.on_start(lambda ctx: engine.start())
+    app.on_shutdown(engine.stop)
+
+    async def generate(ctx: Any):
+        body = ctx.bind(GenerateRequest)
+        if not body.prompt:
+            raise ErrorMissingParam("prompt")
+        if body.temperature < 0 or body.top_p <= 0 or body.top_p > 1:
+            raise ErrorInvalidParam("temperature", "top_p")
+        kw = dict(
+            max_new_tokens=body.max_tokens or None,
+            temperature=body.temperature,
+            top_k=body.top_k,
+            top_p=body.top_p,
+        )
+        if body.stream:
+            return _sse_response(engine, body.prompt, kw)
+        result = await engine.generate(body.prompt, **kw)
+        return {
+            "id": result.request_id,
+            "text": result.text,
+            "finish_reason": result.finish_reason,
+            "usage": {
+                "prompt_tokens": result.prompt_tokens,
+                "completion_tokens": result.completion_tokens,
+                "ttft_ms": round(result.ttft_s * 1000, 2),
+                "duration_ms": round(result.duration_s * 1000, 2),
+            },
+        }
+
+    def models(ctx: Any):
+        cfg = engine.model_cfg
+        return {
+            "models": [
+                {
+                    "id": "flagship",
+                    "family": "llama",
+                    "n_layers": cfg.n_layers,
+                    "d_model": cfg.d_model,
+                    "vocab_size": cfg.vocab_size,
+                    "max_seq_len": engine.config.max_seq_len,
+                    "slots": engine.config.max_slots,
+                }
+            ]
+        }
+
+    app.post(prefix + "/generate", generate)
+    app.get(prefix + "/v1/models", models)
+
+
+def _sse_response(engine: Any, prompt: str, kw: dict) -> WireResponse:
+    async def gen():
+        try:
+            async for token_id, piece in engine.stream(prompt, **kw):
+                payload = json.dumps({"token": token_id, "text": piece})
+                yield f"data: {payload}\n\n".encode()
+            yield b"data: [DONE]\n\n"
+        except asyncio.CancelledError:
+            raise
+
+    return WireResponse(
+        headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        },
+        stream=gen(),
+    )
+
+
+def register_embedding_routes(app: Any, bert_cfg: Any, bert_params: Any, tokenizer: Any, prefix: str = "") -> None:
+    """The /embed endpoint (BASELINE.json configs[1]): tokenize, batch to a
+    padded bucket, run the jitted embedder."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gofr_tpu.models import bert as bert_model
+
+    async def embed(ctx: Any):
+        body = ctx.bind(dict) or {}
+        texts = body.get("input") or body.get("texts")
+        if isinstance(texts, str):
+            texts = [texts]
+        if not texts:
+            raise ErrorMissingParam("input")
+        ids = [tokenizer.encode(t)[: bert_cfg.max_seq_len] for t in texts]
+        max_len = max(len(i) for i in ids)
+        bucket = 1 << (max_len - 1).bit_length() if max_len > 1 else 1
+        bucket = min(max(bucket, 8), bert_cfg.max_seq_len)
+        arr = np.full((len(ids), bucket), 0, np.int32)
+        for row, seq in enumerate(ids):
+            arr[row, : len(seq)] = seq[:bucket]
+        lens = jnp.asarray([min(len(i), bucket) for i in ids], jnp.int32)
+
+        loop = asyncio.get_running_loop()
+        emb = await loop.run_in_executor(
+            None,
+            lambda: np.asarray(
+                bert_model.embed(bert_cfg, bert_params, jnp.asarray(arr), lens)
+            ),
+        )
+        return {
+            "embeddings": emb.tolist(),
+            "dim": int(emb.shape[1]),
+            "usage": {"prompt_tokens": int(sum(len(i) for i in ids))},
+        }
+
+    app.post(prefix + "/embed", embed)

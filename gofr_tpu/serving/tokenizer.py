@@ -1,0 +1,65 @@
+"""Tokenizer boundary.
+
+The engine only needs encode/decode + special ids; any implementation
+(SentencePiece, HF tokenizers loaded from local files) plugs in. The default
+ByteTokenizer is dependency-free: UTF-8 bytes offset by the special-token
+block — real text in/out with a 259-token vocab, which keeps tests, demos
+and the bench self-contained (no downloaded assets in the image).
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+
+@runtime_checkable
+class Tokenizer(Protocol):
+    bos_id: int
+    eos_id: int
+    pad_id: int
+    vocab_size: int
+
+    def encode(self, text: str) -> list[int]: ...
+
+    def decode(self, ids: list[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """ids 0..2 = pad/bos/eos; byte b -> id b+3."""
+
+    pad_id = 0
+    bos_id = 1
+    eos_id = 2
+    _offset = 3
+
+    def __init__(self, vocab_size: int | None = None) -> None:
+        self.vocab_size = vocab_size or (256 + self._offset)
+
+    def encode(self, text: str) -> list[int]:
+        return [self.bos_id] + [b + self._offset for b in text.encode("utf-8")]
+
+    def decode(self, ids: list[int]) -> str:
+        data = bytes(
+            i - self._offset for i in ids if self._offset <= i < self._offset + 256
+        )
+        return data.decode("utf-8", "replace")
+
+
+class HFTokenizer:
+    """Adapter for a local `transformers` tokenizer directory (no network:
+    pass a path that already contains tokenizer.json)."""
+
+    def __init__(self, path: str) -> None:
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.bos_id = self._tok.bos_token_id or 1
+        self.eos_id = self._tok.eos_token_id or 2
+        self.pad_id = self._tok.pad_token_id or 0
+        self.vocab_size = self._tok.vocab_size
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text)
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
