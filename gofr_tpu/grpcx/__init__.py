@@ -1,0 +1,15 @@
+"""gRPC serving (reference: pkg/gofr/grpc.go + pkg/gofr/grpc/log.go).
+
+grpc.aio server with chained recovery + observability interceptors, a
+built-in standard health service (grpc.health.v1 wire format, hand-framed —
+the image carries no grpc_health package), container injection into
+servicers, and the Inference service: unary Generate/Embed/Echo plus
+server-streaming GenerateStream — the token-by-token decode path of the
+north star (SURVEY §3.3: "this is where token-by-token decode streaming
+slots in").
+"""
+
+from gofr_tpu.grpcx.server import GRPCServer
+from gofr_tpu.grpcx.inference import InferenceService, InferenceClient
+
+__all__ = ["GRPCServer", "InferenceService", "InferenceClient"]

@@ -1,0 +1,197 @@
+"""The Inference gRPC service + client.
+
+The north-star serving surface over gRPC (SURVEY §3.3): unary Echo (the
+framework-overhead bench, BASELINE.json configs[0]), unary Generate, unary
+Embed, and server-streaming GenerateStream for token-by-token decode
+(configs[2]). Wire format: JSON bytes with identity serializers — the
+service is defined with generic method handlers, so no protoc step is
+needed; any gRPC client sends `application/grpc` frames of UTF-8 JSON.
+
+Servicers follow the reference's DI convention (grpc.go:222-269): a
+``container`` attribute is injected at registration.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any
+
+import grpc
+import grpc.aio
+
+SERVICE_NAME = "gofr.v1.Inference"
+
+_identity = lambda b: b  # noqa: E731
+
+
+def _json_bytes(obj: Any) -> bytes:
+    return json.dumps(obj).encode("utf-8")
+
+
+async def _parse(request: bytes, context: Any) -> dict:
+    """Malformed bodies are client errors: INVALID_ARGUMENT, not a handler
+    panic/INTERNAL."""
+    if not request:
+        return {}
+    try:
+        data = json.loads(request.decode("utf-8"))
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        await context.abort(
+            grpc.StatusCode.INVALID_ARGUMENT, "request body must be UTF-8 JSON"
+        )
+    return data if isinstance(data, dict) else {"value": data}
+
+
+class InferenceService:
+    """Register with ``app.register_grpc_service(InferenceService(engine))``
+    or attach embedder params for /Embed."""
+
+    def __init__(self, engine: Any = None, embedder: Any = None) -> None:
+        self.container: Any = None  # injected by GRPCServer.register
+        self.engine = engine
+        self.embedder = embedder  # (bert_cfg, bert_params, tokenizer)
+
+    # -- gofr generic-service contract ----------------------------------------
+    def gofr_service_name(self) -> str:
+        return SERVICE_NAME
+
+    def gofr_method_handlers(self) -> dict[str, Any]:
+        return {
+            "Echo": grpc.unary_unary_rpc_method_handler(
+                self.echo, request_deserializer=_identity, response_serializer=_identity
+            ),
+            "Generate": grpc.unary_unary_rpc_method_handler(
+                self.generate, request_deserializer=_identity, response_serializer=_identity
+            ),
+            "GenerateStream": grpc.unary_stream_rpc_method_handler(
+                self.generate_stream, request_deserializer=_identity, response_serializer=_identity
+            ),
+            "Embed": grpc.unary_unary_rpc_method_handler(
+                self.embed, request_deserializer=_identity, response_serializer=_identity
+            ),
+        }
+
+    # -- methods ---------------------------------------------------------------
+    async def echo(self, request: bytes, context: Any) -> bytes:
+        """configs[0]: framework-overhead calibration."""
+        return request or b"{}"
+
+    def _gen_kwargs(self, body: dict) -> dict:
+        return dict(
+            max_new_tokens=int(body.get("max_tokens") or 0) or None,
+            temperature=float(body.get("temperature", 0.0)),
+            top_k=int(body.get("top_k", 0)),
+            top_p=float(body.get("top_p", 1.0)),
+        )
+
+    async def generate(self, request: bytes, context: Any) -> bytes:
+        if self.engine is None:
+            await context.abort(grpc.StatusCode.UNIMPLEMENTED, "no engine attached")
+        body = await _parse(request, context)
+        prompt = body.get("prompt")
+        if not prompt:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "prompt required")
+        result = await self.engine.generate(prompt, **self._gen_kwargs(body))
+        return _json_bytes(
+            {
+                "id": result.request_id,
+                "text": result.text,
+                "finish_reason": result.finish_reason,
+                "usage": {
+                    "prompt_tokens": result.prompt_tokens,
+                    "completion_tokens": result.completion_tokens,
+                    "ttft_ms": round(result.ttft_s * 1000, 2),
+                },
+            }
+        )
+
+    async def generate_stream(self, request: bytes, context: Any):
+        """Server-streaming decode: one JSON frame per token."""
+        if self.engine is None:
+            await context.abort(grpc.StatusCode.UNIMPLEMENTED, "no engine attached")
+        body = await _parse(request, context)
+        prompt = body.get("prompt")
+        if not prompt:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "prompt required")
+        async for token_id, piece in self.engine.stream(prompt, **self._gen_kwargs(body)):
+            yield _json_bytes({"token": token_id, "text": piece})
+        yield _json_bytes({"done": True})
+
+    async def embed(self, request: bytes, context: Any) -> bytes:
+        if self.embedder is None:
+            await context.abort(grpc.StatusCode.UNIMPLEMENTED, "no embedder attached")
+        import jax.numpy as jnp
+        import numpy as np
+
+        from gofr_tpu.models import bert as bert_model
+
+        body = await _parse(request, context)
+        texts = body.get("input") or body.get("texts") or []
+        if isinstance(texts, str):
+            texts = [texts]
+        if not texts:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "input required")
+        bert_cfg, bert_params, tokenizer = self.embedder
+        from gofr_tpu.serving.tokenizer import pad_batch
+
+        arr, lens = pad_batch(tokenizer, texts, bert_cfg.max_seq_len)
+        loop = asyncio.get_running_loop()
+        emb = await loop.run_in_executor(
+            None,
+            lambda: np.asarray(
+                bert_model.embed(
+                    bert_cfg, bert_params, jnp.asarray(arr), jnp.asarray(lens, jnp.int32)
+                )
+            ),
+        )
+        return _json_bytes({"embeddings": emb.tolist(), "dim": int(emb.shape[1])})
+
+
+class InferenceClient:
+    """Minimal client for the Inference service (tests, benches, and the
+    DCN cross-host coordination path reuse this)."""
+
+    def __init__(self, target: str) -> None:
+        self.target = target
+        self._channel = grpc.aio.insecure_channel(target)
+
+    def _unary(self, method: str):
+        return self._channel.unary_unary(
+            f"/{SERVICE_NAME}/{method}",
+            request_serializer=_identity,
+            response_deserializer=_identity,
+        )
+
+    async def echo(self, payload: dict) -> dict:
+        resp = await self._unary("Echo")(_json_bytes(payload))
+        return json.loads(resp)
+
+    async def generate(self, prompt: str, **kw: Any) -> dict:
+        resp = await self._unary("Generate")(_json_bytes({"prompt": prompt, **kw}))
+        return json.loads(resp)
+
+    async def generate_stream(self, prompt: str, **kw: Any):
+        stream = self._channel.unary_stream(
+            f"/{SERVICE_NAME}/GenerateStream",
+            request_serializer=_identity,
+            response_deserializer=_identity,
+        )(_json_bytes({"prompt": prompt, **kw}))
+        async for frame in stream:
+            yield json.loads(frame)
+
+    async def embed(self, texts: list[str]) -> dict:
+        resp = await self._unary("Embed")(_json_bytes({"input": texts}))
+        return json.loads(resp)
+
+    async def health(self) -> bool:
+        check = self._channel.unary_unary(
+            "/grpc.health.v1.Health/Check",
+            request_serializer=_identity,
+            response_deserializer=_identity,
+        )
+        resp = await check(b"")
+        return resp == b"\x08\x01"
+
+    async def close(self) -> None:
+        await self._channel.close()

@@ -1,0 +1,216 @@
+"""The gRPC server wrapper.
+
+Reference parity: pkg/gofr/grpc.go — server construction with chained
+interceptors (recovery first, then observability, grpc.go:96-104), optional
+reflection via GRPC_ENABLE_REFLECTION (grpc.go:131-134; logged-and-skipped
+here, the image has no reflection package), graceful stop (grpc.go:185-197),
+server status/error metrics (grpc.go:114-119), and reflection-based
+container injection into registered servicers (grpc.go:222-269 → here: the
+``container`` attribute is set on the servicer when present).
+
+Services register either with a generated ``add_*_to_server`` adder or as
+gofr generic services exposing ``gofr_service_name()`` +
+``gofr_method_handlers()``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, Callable
+
+import grpc
+import grpc.aio
+
+GRPC_STATUS_LABELS = {True: "OK", False: "ERROR"}
+
+
+def _health_handlers(container: Any) -> "grpc.GenericRpcHandler":
+    """Standard grpc.health.v1.Health service, hand-framed protobuf:
+    HealthCheckResponse{status=1} is `0x08 0x01` (SERVING) / `0x08 0x02`
+    (NOT_SERVING)."""
+
+    def check(request: bytes, context: Any) -> bytes:
+        try:
+            health = container.health()
+            serving = health.get("status") == "UP"
+        except Exception:
+            serving = False
+        return b"\x08\x01" if serving else b"\x08\x02"
+
+    method = grpc.unary_unary_rpc_method_handler(
+        check,
+        request_deserializer=lambda b: b,
+        response_serializer=lambda b: b,
+    )
+    return grpc.method_handlers_generic_handler(
+        "grpc.health.v1.Health", {"Check": method}
+    )
+
+
+class _ObservabilityInterceptor(grpc.aio.ServerInterceptor):
+    """Per-RPC span + structured log + ``app_grpc_server_stats`` histogram
+    (grpc/log.go:150-251). Wraps each handler behavior; recovery included
+    (panic → INTERNAL, grpc_recovery analogue)."""
+
+    def __init__(self, container: Any) -> None:
+        self.container = container
+
+    async def intercept_service(self, continuation: Callable, details: Any) -> Any:
+        handler = await continuation(details)
+        if handler is None:
+            return None
+        method = details.method
+        container = self.container
+
+        def wrap_unary(behavior: Callable) -> Callable:
+            async def wrapped(request: Any, context: Any) -> Any:
+                start = time.perf_counter()
+                span = container.tracer.start_span(f"grpc {method}", kind="server")
+                ok = True
+                try:
+                    with span:
+                        return await _maybe_async(behavior, request, context)
+                except grpc.aio.AbortError:
+                    ok = False
+                    raise
+                except Exception as exc:
+                    ok = False
+                    container.logger.error(f"grpc handler panic in {method}: {exc}")
+                    container.metrics_manager.increment_counter(
+                        "grpc_server_errors_total", method=method
+                    )
+                    await context.abort(grpc.StatusCode.INTERNAL, "internal error")
+                finally:
+                    elapsed = time.perf_counter() - start
+                    container.metrics_manager.record_histogram(
+                        "app_grpc_server_stats", elapsed,
+                        method=method, status=GRPC_STATUS_LABELS[ok],
+                    )
+                    container.logger.info(
+                        f"gRPC {method} {'OK' if ok else 'ERROR'} {elapsed*1e6:.0f}µs"
+                    )
+
+            return wrapped
+
+        def wrap_stream(behavior: Callable) -> Callable:
+            async def wrapped(request: Any, context: Any):
+                start = time.perf_counter()
+                span = container.tracer.start_span(f"grpc {method}", kind="server")
+                ok = True
+                try:
+                    with span:
+                        async for item in behavior(request, context):
+                            yield item
+                except grpc.aio.AbortError:
+                    ok = False
+                    raise
+                except Exception as exc:
+                    ok = False
+                    container.logger.error(f"grpc stream panic in {method}: {exc}")
+                    container.metrics_manager.increment_counter(
+                        "grpc_server_errors_total", method=method
+                    )
+                    await context.abort(grpc.StatusCode.INTERNAL, "internal error")
+                finally:
+                    elapsed = time.perf_counter() - start
+                    container.metrics_manager.record_histogram(
+                        "app_grpc_stream_stats", elapsed,
+                        method=method, status=GRPC_STATUS_LABELS[ok],
+                    )
+                    container.logger.info(
+                        f"gRPC stream {method} {'OK' if ok else 'ERROR'} {elapsed*1e6:.0f}µs"
+                    )
+
+            return wrapped
+
+        if handler.unary_unary is not None:
+            return grpc.unary_unary_rpc_method_handler(
+                wrap_unary(handler.unary_unary),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer,
+            )
+        if handler.unary_stream is not None:
+            return grpc.unary_stream_rpc_method_handler(
+                wrap_stream(handler.unary_stream),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer,
+            )
+        return handler
+
+
+async def _maybe_async(fn: Callable, *args: Any) -> Any:
+    result = fn(*args)
+    if asyncio.iscoroutine(result):
+        return await result
+    return result
+
+
+class GRPCServer:
+    def __init__(self, container: Any, port: int, config: Any = None) -> None:
+        self.container = container
+        self.port = port
+        self.config = config
+        self._server: grpc.aio.Server | None = None
+        self._pending: list[Any] = []  # registered before start
+        self._register_metrics()
+
+    def _register_metrics(self) -> None:
+        m = self.container.metrics_manager
+        if m.get("app_grpc_server_stats") is None:
+            m.new_histogram("app_grpc_server_stats", "gRPC unary handler latency")
+            m.new_histogram("app_grpc_stream_stats", "gRPC stream handler latency")
+            m.new_counter("grpc_server_errors_total", "gRPC handler errors")
+            m.new_gauge("grpc_server_status", "1 while the gRPC server is serving")
+
+    def register(self, servicer: Any, adder: Callable | None = None) -> None:
+        """RegisterService (grpc.go:200-220): container injection + deferred
+        add (server object exists only at start). Registration after start
+        raises — grpc.aio cannot add handlers to a serving server, and a
+        silent UNIMPLEMENTED is worse than an error."""
+        if self._server is not None:
+            raise RuntimeError(
+                "cannot register a gRPC service after the server has started"
+            )
+        if hasattr(servicer, "container") and servicer.container is None:
+            servicer.container = self.container
+        elif hasattr(servicer, "use_container"):
+            servicer.use_container(self.container)
+        self._pending.append((servicer, adder))
+
+    async def start(self) -> None:
+        self._server = grpc.aio.server(
+            interceptors=[_ObservabilityInterceptor(self.container)]
+        )
+        self._server.add_generic_rpc_handlers((_health_handlers(self.container),))
+        for servicer, adder in self._pending:
+            if adder is not None:
+                adder(servicer, self._server)
+            elif hasattr(servicer, "gofr_method_handlers"):
+                handler = grpc.method_handlers_generic_handler(
+                    servicer.gofr_service_name(), servicer.gofr_method_handlers()
+                )
+                self._server.add_generic_rpc_handlers((handler,))
+            else:
+                raise TypeError(
+                    f"servicer {type(servicer).__name__} has neither an adder "
+                    "nor gofr_method_handlers()"
+                )
+        if self.config is not None and self.config.get_or_default(
+            "GRPC_ENABLE_REFLECTION", "false"
+        ).lower() == "true":
+            self.container.logger.warn(
+                "GRPC_ENABLE_REFLECTION requested but grpc_reflection is not "
+                "available in this image; skipping"
+            )
+        self._server.add_insecure_port(f"[::]:{self.port}")
+        await self._server.start()
+        self.container.metrics_manager.set_gauge("grpc_server_status", 1)
+        self.container.logger.info(f"grpc server listening on :{self.port}")
+
+    async def shutdown(self, grace: float = 5.0) -> None:
+        if self._server is not None:
+            self.container.metrics_manager.set_gauge("grpc_server_status", 0)
+            await self._server.stop(grace)
+            self._server = None

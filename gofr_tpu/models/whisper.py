@@ -1,0 +1,254 @@
+"""Whisper-style encoder-decoder ASR model (BASELINE.json configs[3]).
+
+Architecture (Whisper-large-v3 shape at full scale): conv2×-downsampled
+log-mel frontend + sinusoidal positions → pre-norm encoder; decoder with
+self- + cross-attention and learned positions. Same TPU-first construction
+as the llama module: stacked layers under lax.scan, bf16 weights, f32
+softmax/norms, static shapes; greedy transcription decodes with a dense KV
+cache over the decoder while encoder states stay resident.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from gofr_tpu.ops.attention import attention, decode_attention
+from gofr_tpu.ops.norms import layer_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class WhisperConfig:
+    n_mels: int = 128
+    vocab_size: int = 51866
+    d_model: int = 1280
+    n_audio_layers: int = 32
+    n_text_layers: int = 32
+    n_heads: int = 20
+    d_ff: int = 5120
+    max_audio_len: int = 1500  # frames after conv (30 s)
+    max_text_len: int = 448
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    sot_id: int = 50258
+    eot_id: int = 50257
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def large_v3(cls, **kw: Any) -> "WhisperConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw: Any) -> "WhisperConfig":
+        defaults = dict(
+            n_mels=8, vocab_size=64, d_model=32, n_audio_layers=2, n_text_layers=2,
+            n_heads=2, d_ff=64, max_audio_len=32, max_text_len=16,
+            dtype=jnp.float32, sot_id=1, eot_id=2,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+def _sinusoids(length: int, channels: int) -> jnp.ndarray:
+    log_timescale = math.log(10000.0) / (channels // 2 - 1)
+    inv = jnp.exp(-log_timescale * jnp.arange(channels // 2, dtype=jnp.float32))
+    scaled = jnp.arange(length, dtype=jnp.float32)[:, None] * inv[None, :]
+    return jnp.concatenate([jnp.sin(scaled), jnp.cos(scaled)], axis=1)
+
+
+def init_params(cfg: WhisperConfig, key: jax.Array) -> dict:
+    ks = jax.random.split(key, 16)
+    D, F, H, Dh = cfg.d_model, cfg.d_ff, cfg.n_heads, cfg.head_dim
+    La, Lt = cfg.n_audio_layers, cfg.n_text_layers
+
+    def winit(k: jax.Array, shape: tuple, fan_in: int) -> jnp.ndarray:
+        return (jax.random.normal(k, shape, jnp.float32) / math.sqrt(fan_in)).astype(cfg.dtype)
+
+    def enc_layer_params(k: jax.Array) -> dict:
+        kk = jax.random.split(k, 6)
+        return {
+            "wq": winit(kk[0], (La, D, D), D), "wk": winit(kk[1], (La, D, D), D),
+            "wv": winit(kk[2], (La, D, D), D), "wo": winit(kk[3], (La, D, D), D),
+            "w1": winit(kk[4], (La, D, F), D), "w2": winit(kk[5], (La, F, D), F),
+            "ln1_s": jnp.ones((La, D), jnp.float32), "ln1_b": jnp.zeros((La, D), jnp.float32),
+            "ln2_s": jnp.ones((La, D), jnp.float32), "ln2_b": jnp.zeros((La, D), jnp.float32),
+        }
+
+    def dec_layer_params(k: jax.Array) -> dict:
+        kk = jax.random.split(k, 10)
+        return {
+            "wq": winit(kk[0], (Lt, D, D), D), "wk": winit(kk[1], (Lt, D, D), D),
+            "wv": winit(kk[2], (Lt, D, D), D), "wo": winit(kk[3], (Lt, D, D), D),
+            "xwq": winit(kk[4], (Lt, D, D), D), "xwk": winit(kk[5], (Lt, D, D), D),
+            "xwv": winit(kk[6], (Lt, D, D), D), "xwo": winit(kk[7], (Lt, D, D), D),
+            "w1": winit(kk[8], (Lt, D, F), D), "w2": winit(kk[9], (Lt, F, D), F),
+            "ln1_s": jnp.ones((Lt, D), jnp.float32), "ln1_b": jnp.zeros((Lt, D), jnp.float32),
+            "lnx_s": jnp.ones((Lt, D), jnp.float32), "lnx_b": jnp.zeros((Lt, D), jnp.float32),
+            "ln2_s": jnp.ones((Lt, D), jnp.float32), "ln2_b": jnp.zeros((Lt, D), jnp.float32),
+        }
+
+    return {
+        "conv1": winit(ks[0], (3, cfg.n_mels, D), 3 * cfg.n_mels),
+        "conv1_b": jnp.zeros((D,), jnp.float32),
+        "conv2": winit(ks[1], (3, D, D), 3 * D),
+        "conv2_b": jnp.zeros((D,), jnp.float32),
+        "enc": enc_layer_params(ks[2]),
+        "enc_ln_s": jnp.ones((D,), jnp.float32),
+        "enc_ln_b": jnp.zeros((D,), jnp.float32),
+        "tok_embedding": winit(ks[3], (cfg.vocab_size, D), D),
+        "pos_embedding": winit(ks[4], (cfg.max_text_len, D), D),
+        "dec": dec_layer_params(ks[5]),
+        "dec_ln_s": jnp.ones((D,), jnp.float32),
+        "dec_ln_b": jnp.zeros((D,), jnp.float32),
+    }
+
+
+def _conv1d(x: jnp.ndarray, w: jnp.ndarray, b: jnp.ndarray, stride: int) -> jnp.ndarray:
+    """[B, T, Cin] * [K, Cin, Cout] -> [B, T', Cout], SAME padding."""
+    out = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=(stride,),
+        padding="SAME",
+        dimension_numbers=("NWC", "WIO", "NWC"),
+    )
+    return out + b.astype(out.dtype)
+
+
+@partial(jax.jit, static_argnums=0)
+def encode_audio(cfg: WhisperConfig, params: dict, mel: jnp.ndarray) -> jnp.ndarray:
+    """[B, T_frames, n_mels] -> encoder states [B, T', D] (T' = T/2)."""
+    x = mel.astype(cfg.dtype)
+    x = jax.nn.gelu(_conv1d(x, params["conv1"], params["conv1_b"], 1).astype(jnp.float32)).astype(cfg.dtype)
+    x = jax.nn.gelu(_conv1d(x, params["conv2"], params["conv2_b"], 2).astype(jnp.float32)).astype(cfg.dtype)
+    T = x.shape[1]
+    x = x + _sinusoids(T, cfg.d_model).astype(cfg.dtype)[None]
+
+    H, Dh = cfg.n_heads, cfg.head_dim
+
+    def body(h, lp):
+        B, S, D = h.shape
+        a = layer_norm(h, lp["ln1_s"], lp["ln1_b"], cfg.norm_eps)
+        q = (a @ lp["wq"]).reshape(B, S, H, Dh)
+        k = (a @ lp["wk"]).reshape(B, S, H, Dh)
+        v = (a @ lp["wv"]).reshape(B, S, H, Dh)
+        attn = attention(q, k, v, causal=False).reshape(B, S, D)
+        h = h + attn @ lp["wo"]
+        m = layer_norm(h, lp["ln2_s"], lp["ln2_b"], cfg.norm_eps)
+        inter = jax.nn.gelu((m @ lp["w1"]).astype(jnp.float32)).astype(m.dtype)
+        h = h + inter @ lp["w2"]
+        return h, None
+
+    x, _ = jax.lax.scan(body, x, params["enc"])
+    return layer_norm(x, params["enc_ln_s"], params["enc_ln_b"], cfg.norm_eps)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DecCache:
+    """Decoder self-attention KV cache [Lt, B, S_text, H, Dh]."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+    def tree_flatten(self):
+        return (self.k, self.v), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @classmethod
+    def create(cls, cfg: WhisperConfig, batch: int) -> "DecCache":
+        shape = (cfg.n_text_layers, batch, cfg.max_text_len, cfg.n_heads, cfg.head_dim)
+        return cls(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=(4,))
+def decode_text_step(
+    cfg: WhisperConfig,
+    params: dict,
+    enc_states: jnp.ndarray,  # [B, T', D]
+    tokens: jnp.ndarray,  # [B] current token
+    cache: DecCache,
+    pos: jnp.ndarray,  # [B] position of this token (0-based)
+) -> tuple[jnp.ndarray, DecCache]:
+    """One decoder step -> (logits [B, V], cache)."""
+    B = tokens.shape[0]
+    H, Dh, D = cfg.n_heads, cfg.head_dim, cfg.d_model
+    x = (params["tok_embedding"][tokens] + params["pos_embedding"][pos]).astype(cfg.dtype)[:, None]
+
+    def body(h, xs):
+        lp, kc, vc = xs
+        a = layer_norm(h, lp["ln1_s"], lp["ln1_b"], cfg.norm_eps)
+        q = (a @ lp["wq"]).reshape(B, 1, H, Dh)
+        k = (a @ lp["wk"]).reshape(B, 1, H, Dh)
+        v = (a @ lp["wv"]).reshape(B, 1, H, Dh)
+        b_idx = jnp.arange(B)
+        kc = kc.at[b_idx, pos].set(k[:, 0])
+        vc = vc.at[b_idx, pos].set(v[:, 0])
+        attn = decode_attention(q, kc, vc, pos + 1).reshape(B, 1, D)
+        h = h + attn @ lp["wo"]
+
+        xa = layer_norm(h, lp["lnx_s"], lp["lnx_b"], cfg.norm_eps)
+        xq = (xa @ lp["xwq"]).reshape(B, 1, H, Dh)
+        xk = (enc_states @ lp["xwk"]).reshape(B, -1, H, Dh)
+        xv = (enc_states @ lp["xwv"]).reshape(B, -1, H, Dh)
+        xattn = attention(xq, xk, xv, causal=False).reshape(B, 1, D)
+        h = h + xattn @ lp["xwo"]
+
+        m = layer_norm(h, lp["ln2_s"], lp["ln2_b"], cfg.norm_eps)
+        inter = jax.nn.gelu((m @ lp["w1"]).astype(jnp.float32)).astype(m.dtype)
+        h = h + inter @ lp["w2"]
+        return h, (kc, vc)
+
+    x, (nk, nv) = jax.lax.scan(body, x, (params["dec"], cache.k, cache.v))
+    x = layer_norm(x, params["dec_ln_s"], params["dec_ln_b"], cfg.norm_eps)
+    logits = jnp.einsum(
+        "bsd,vd->bsv", x, params["tok_embedding"], preferred_element_type=jnp.float32
+    )[:, 0]
+    return logits, DecCache(nk, nv)
+
+
+def transcribe(
+    cfg: WhisperConfig,
+    params: dict,
+    mel: jnp.ndarray,  # [B, T_frames, n_mels]
+    max_tokens: int | None = None,
+) -> list[list[int]]:
+    """Greedy transcription. Returns token ids per batch row (EOT-trimmed).
+    The async ASR worker calls this; the hot loop is fully jitted."""
+    import numpy as np
+
+    B = mel.shape[0]
+    max_tokens = min(max_tokens or cfg.max_text_len - 1, cfg.max_text_len - 1)
+    enc_states = encode_audio(cfg, params, mel)
+    cache = DecCache.create(cfg, B)
+    tokens = jnp.full((B,), cfg.sot_id, jnp.int32)
+    # -1 fill: token id 0 is a legitimate vocab entry, not a terminator
+    out = np.full((B, max_tokens), -1, np.int64)
+    steps_done = 0
+    for step in range(max_tokens):
+        pos = jnp.full((B,), step, jnp.int32)
+        logits, cache = decode_text_step(cfg, params, enc_states, tokens, cache, pos)
+        tokens = jnp.argmax(logits, axis=-1)
+        out[:, step] = np.asarray(tokens)
+        steps_done = step + 1
+        if bool((out[:, :steps_done] == cfg.eot_id).any(axis=1).all()):
+            break
+    results: list[list[int]] = []
+    for row in out[:, :steps_done]:
+        ids: list[int] = []
+        for t in row:
+            if t == cfg.eot_id or t == -1:
+                break
+            ids.append(int(t))
+        results.append(ids)
+    return results

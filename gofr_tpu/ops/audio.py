@@ -1,0 +1,66 @@
+"""Audio featurization: log-mel spectrogram on-device.
+
+Whisper-style frontend: 16 kHz PCM -> STFT (hann window) -> mel filterbank
+-> log10, all in jax so the whole ASR pipeline compiles into one XLA
+program (no host-side librosa dependency).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def mel_filterbank(n_mels: int, n_fft: int, sample_rate: int = 16000) -> np.ndarray:
+    """[n_mels, n_fft//2+1] triangular filters (host-side constant)."""
+    n_freqs = n_fft // 2 + 1
+    fmin, fmax = 0.0, sample_rate / 2
+
+    def hz_to_mel(f: float) -> float:
+        return 2595.0 * math.log10(1.0 + f / 700.0)
+
+    def mel_to_hz(m: np.ndarray) -> np.ndarray:
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+
+    mel_pts = np.linspace(hz_to_mel(fmin), hz_to_mel(fmax), n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts)
+    bins = np.floor((n_fft + 1) * hz_pts / sample_rate).astype(int)
+    fb = np.zeros((n_mels, n_freqs), np.float32)
+    for m in range(1, n_mels + 1):
+        left, center, right = bins[m - 1], bins[m], bins[m + 1]
+        for k in range(left, center):
+            if center > left:
+                fb[m - 1, k] = (k - left) / (center - left)
+        for k in range(center, right):
+            if right > center:
+                fb[m - 1, k] = (right - k) / (right - center)
+    return fb
+
+
+def log_mel_spectrogram(
+    audio: jnp.ndarray,  # [B, n_samples] f32 in [-1, 1]
+    *,
+    n_fft: int = 400,
+    hop: int = 160,
+    n_mels: int = 80,
+    sample_rate: int = 16000,
+) -> jnp.ndarray:
+    """[B, n_frames, n_mels] log-mel features."""
+    B, n = audio.shape
+    n_frames = 1 + (n - n_fft) // hop if n >= n_fft else 1
+    if n < n_fft:
+        audio = jnp.pad(audio, ((0, 0), (0, n_fft - n)))
+    idx = jnp.arange(n_frames)[:, None] * hop + jnp.arange(n_fft)[None, :]
+    frames = audio[:, idx]  # [B, n_frames, n_fft]
+    window = jnp.asarray(np.hanning(n_fft).astype(np.float32))
+    spec = jnp.fft.rfft(frames * window, axis=-1)
+    power = jnp.abs(spec) ** 2
+    fb = jnp.asarray(mel_filterbank(n_mels, n_fft, sample_rate))
+    mel = jnp.einsum("btf,mf->btm", power, fb)
+    logmel = jnp.log10(jnp.maximum(mel, 1e-10))
+    # whisper-style dynamic range compression
+    logmel = jnp.maximum(logmel, jnp.max(logmel, axis=(1, 2), keepdims=True) - 8.0)
+    return (logmel + 4.0) / 4.0

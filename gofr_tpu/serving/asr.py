@@ -1,0 +1,74 @@
+"""Async ASR worker: Pub/Sub-fed Whisper transcription.
+
+SURVEY §3.4 / BASELINE.json configs[3]: the subscriber loop is the async
+inference blueprint — jobs arrive on a broker topic, the handler binds the
+audio payload, runs the jitted transcription, and publishes the result to a
+reply topic with commit-on-success (at-least-once).
+
+Job message (JSON): ``{"id": ..., "audio": [f32 samples] | "audio_b64":
+base64 f32le, "sample_rate": 16000, "reply_topic": "asr-results"}``.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from gofr_tpu.models import whisper
+from gofr_tpu.ops.audio import log_mel_spectrogram
+
+
+class ASRWorker:
+    def __init__(
+        self,
+        cfg: whisper.WhisperConfig,
+        params: dict,
+        tokenizer: Any = None,
+        reply_topic: str = "asr-results",
+        n_fft: int = 400,
+        hop: int = 160,
+    ) -> None:
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.reply_topic = reply_topic
+        self.n_fft = n_fft
+        self.hop = hop
+
+    def decode_audio(self, job: dict) -> np.ndarray:
+        if "audio_b64" in job:
+            raw = base64.b64decode(job["audio_b64"])
+            return np.frombuffer(raw, np.float32)
+        return np.asarray(job.get("audio", []), np.float32)
+
+    def transcribe_job(self, job: dict) -> dict:
+        audio = self.decode_audio(job)
+        if audio.size == 0:
+            return {"id": job.get("id"), "error": "empty audio"}
+        mel = log_mel_spectrogram(
+            jnp.asarray(audio[None, :]),
+            n_fft=self.n_fft, hop=self.hop, n_mels=self.cfg.n_mels,
+        )
+        ids = whisper.transcribe(self.cfg, self.params, mel, job.get("max_tokens"))[0]
+        text = self.tokenizer.decode(ids) if self.tokenizer is not None else None
+        return {"id": job.get("id"), "token_ids": ids, "text": text}
+
+    async def handler(self, ctx: Any) -> Any:
+        """The subscription handler: ``app.subscribe("asr-jobs",
+        worker.handler)``. Transcription runs in the executor so the jitted
+        decode (and its first compile) never stalls the event loop; the
+        result is published to the job's reply topic."""
+        import asyncio
+
+        job = ctx.bind(dict)
+        loop = asyncio.get_running_loop()
+        result = await loop.run_in_executor(None, self.transcribe_job, job)
+        publisher = ctx.get_publisher()
+        if publisher is not None:
+            topic = job.get("reply_topic", self.reply_topic)
+            publisher.publish(topic, json.dumps(result).encode())
+        return result

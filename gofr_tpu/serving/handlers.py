@@ -110,6 +110,7 @@ def register_embedding_routes(app: Any, bert_cfg: Any, bert_params: Any, tokeniz
     import numpy as np
 
     from gofr_tpu.models import bert as bert_model
+    from gofr_tpu.serving.tokenizer import pad_batch
 
     async def embed(ctx: Any):
         body = ctx.bind(dict) or {}
@@ -118,26 +119,20 @@ def register_embedding_routes(app: Any, bert_cfg: Any, bert_params: Any, tokeniz
             texts = [texts]
         if not texts:
             raise ErrorMissingParam("input")
-        ids = [tokenizer.encode(t)[: bert_cfg.max_seq_len] for t in texts]
-        max_len = max(len(i) for i in ids)
-        bucket = 1 << (max_len - 1).bit_length() if max_len > 1 else 1
-        bucket = min(max(bucket, 8), bert_cfg.max_seq_len)
-        arr = np.full((len(ids), bucket), 0, np.int32)
-        for row, seq in enumerate(ids):
-            arr[row, : len(seq)] = seq[:bucket]
-        lens = jnp.asarray([min(len(i), bucket) for i in ids], jnp.int32)
-
+        arr, lens = pad_batch(tokenizer, texts, bert_cfg.max_seq_len)
         loop = asyncio.get_running_loop()
         emb = await loop.run_in_executor(
             None,
             lambda: np.asarray(
-                bert_model.embed(bert_cfg, bert_params, jnp.asarray(arr), lens)
+                bert_model.embed(
+                    bert_cfg, bert_params, jnp.asarray(arr), jnp.asarray(lens, jnp.int32)
+                )
             ),
         )
         return {
             "embeddings": emb.tolist(),
             "dim": int(emb.shape[1]),
-            "usage": {"prompt_tokens": int(sum(len(i) for i in ids))},
+            "usage": {"prompt_tokens": int(sum(lens))},
         }
 
     app.post(prefix + "/embed", embed)

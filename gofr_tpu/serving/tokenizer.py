@@ -45,6 +45,25 @@ class ByteTokenizer:
         return data.decode("utf-8", "replace")
 
 
+def pad_batch(tokenizer: Tokenizer, texts: list[str], max_seq_len: int):
+    """Tokenize + right-pad a text batch to a power-of-two bucket clamped to
+    ``max_seq_len`` (limits XLA recompiles to a few shapes). Returns
+    (tokens [B, bucket] int32 ndarray, lens [B] list)."""
+    import numpy as np
+
+    ids = [tokenizer.encode(t)[:max_seq_len] for t in texts]
+    max_len = max((len(i) for i in ids), default=1)
+    bucket = 1 << (max_len - 1).bit_length() if max_len > 1 else 1
+    bucket = min(max(bucket, 8), max_seq_len)
+    arr = np.full((len(ids), bucket), tokenizer.pad_id, np.int32)
+    lens = []
+    for row, seq in enumerate(ids):
+        seq = seq[:bucket]
+        arr[row, : len(seq)] = seq
+        lens.append(len(seq))
+    return arr, lens
+
+
 class HFTokenizer:
     """Adapter for a local `transformers` tokenizer directory (no network:
     pass a path that already contains tokenizer.json)."""

@@ -1,0 +1,90 @@
+"""gRPC server integration: echo, generate, streaming, health, interceptor
+metrics (reference model: grpc examples' main_test.go)."""
+
+import asyncio
+
+import jax
+import pytest
+
+from gofr_tpu.config import MapConfig
+from gofr_tpu.grpcx import GRPCServer, InferenceClient, InferenceService
+from gofr_tpu.models import llama
+from gofr_tpu.serving import ByteTokenizer, EngineConfig, ServingEngine
+from gofr_tpu.testutil import get_free_port, new_mock_container
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = ServingEngine(
+        cfg, params,
+        EngineConfig(max_slots=2, max_seq_len=64, prefill_buckets=(16, 32)),
+        ByteTokenizer(),
+    )
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def test_grpc_end_to_end(engine, run_async):
+    container, _ = new_mock_container()
+    port = get_free_port()
+    server = GRPCServer(container, port, MapConfig({}, use_env=False))
+    server.register(InferenceService(engine))
+
+    async def scenario():
+        await server.start()
+        client = InferenceClient(f"127.0.0.1:{port}")
+        try:
+            # unary echo (configs[0])
+            echoed = await client.echo({"ping": 1})
+            assert echoed == {"ping": 1}
+
+            # health service (standard wire format)
+            assert await client.health() is True
+
+            # unary generate
+            result = await client.generate("abc", max_tokens=4)
+            assert result["finish_reason"] in ("length", "stop")
+            assert result["usage"]["completion_tokens"] <= 4
+
+            # server-streaming decode
+            frames = []
+            async for frame in client.generate_stream("xyz", max_tokens=3):
+                frames.append(frame)
+            assert frames[-1] == {"done": True}
+            assert 1 <= len(frames) - 1 <= 3
+            for f in frames[:-1]:
+                assert "token" in f
+
+            # invalid argument handling
+            import grpc
+
+            with pytest.raises(grpc.aio.AioRpcError) as err:
+                await client.generate("")
+            assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        finally:
+            await client.close()
+            await server.shutdown(grace=0.5)
+
+    run_async(scenario())
+
+    # interceptor metrics recorded
+    unary_sum, unary_count = container.metrics_manager.get("app_grpc_server_stats").snapshot(
+        {"method": "/gofr.v1.Inference/Generate", "status": "OK"}
+    )
+    assert unary_count >= 1
+    stream_sum, stream_count = container.metrics_manager.get("app_grpc_stream_stats").snapshot(
+        {"method": "/gofr.v1.Inference/GenerateStream", "status": "OK"}
+    )
+    assert stream_count >= 1
+
+
+def test_container_injection(engine):
+    container, _ = new_mock_container()
+    server = GRPCServer(container, get_free_port())
+    svc = InferenceService(engine)
+    assert svc.container is None
+    server.register(svc)
+    assert svc.container is container
