@@ -174,6 +174,13 @@ class App:
     def add_tpu(self, tpu: Any) -> None:
         self.container.register_datasource("tpu", tpu)
 
+    def add_rest_handlers(self, entity_cls: type, table: str | None = None) -> None:
+        """AddRESTHandlers (crud_handlers.go): auto CRUD routes for a
+        dataclass entity backed by ctx.sql."""
+        from gofr_tpu.crud import add_rest_handlers
+
+        add_rest_handlers(self, entity_cls, table)
+
     # ------------------------------------------------------------ async + cron
     def subscribe(self, topic: str, handler: Handler) -> None:
         """gofr.go:233-249."""
@@ -272,6 +279,8 @@ class App:
         self._servers = [metrics_server, http_server]
         await metrics_server.start()
         await http_server.start()
+        if self.container.ws_manager is not None:
+            await self.container.ws_manager.connect_services()
         if self._grpc_server is not None:
             await self._grpc_server.start()
         await self.subscription_manager.start()
@@ -310,14 +319,14 @@ class App:
         await self._shutdown_event.wait()
         await self.shutdown()
 
-    def run(self) -> None:
+    def run(self) -> int | None:
         if self.is_cmd:
-            self._run_cmd()
-            return
+            return self._run_cmd()
         try:
             asyncio.run(self.run_async())
         except KeyboardInterrupt:
             pass
+        return None
 
     def stop(self) -> None:
         """Request shutdown from any thread."""
@@ -351,16 +360,18 @@ class App:
     async def _shutdown_servers(self) -> None:
         await self.subscription_manager.stop()
         await self.crontab.stop()
+        if self.container.ws_manager is not None:
+            await self.container.ws_manager.close()
         if self._grpc_server is not None:
             await self._grpc_server.shutdown()
         for server in self._servers:
             await server.shutdown()
 
     # -- CMD execution (cmd.go:35-164) ----------------------------------------
-    def _run_cmd(self) -> None:
+    def _run_cmd(self) -> int:
         from gofr_tpu.cli import run_cmd
 
-        run_cmd(self)
+        return run_cmd(self)
 
 
 def _hook_request() -> Any:

@@ -1,0 +1,13 @@
+"""CLI apps + terminal (reference: pkg/gofr/cmd.go + pkg/gofr/cmd/).
+
+``new_cmd()`` apps route subcommands with prefix matching and auto help
+(cmd.go:35-164); ``cmd.Request`` parses ``-flag`` / ``key=value`` args
+(cmd/request.go:14-60); responses print to stdout (cmd/responder.go). The
+terminal package provides colors, spinners and progress bars
+(cmd/terminal/).
+"""
+
+from gofr_tpu.cli.cmd import CMDRequest, run_cmd
+from gofr_tpu.cli.terminal import Output, ProgressBar, Spinner
+
+__all__ = ["run_cmd", "CMDRequest", "Output", "Spinner", "ProgressBar"]

@@ -1,0 +1,15 @@
+"""File systems (reference: pkg/gofr/datasource/file/).
+
+The FileSystem/File contracts (interface.go:12-133) with a local
+implementation (local_fs.go), JSON/text RowReaders (row_reader.go), and an
+observability wrapper logging every operation (observability.go). Object
+stores (S3/GCS/FTP/SFTP in the reference's external modules) plug in behind
+the same contract; GCS is the weight-loading path in the TPU build
+(SURVEY §5.4: checkpoint load = model weights through this abstraction).
+"""
+
+from gofr_tpu.datasource.file.local import LocalFileSystem
+from gofr_tpu.datasource.file.observability import ObservedFileSystem
+from gofr_tpu.datasource.file.row_reader import JSONRowReader, TextRowReader
+
+__all__ = ["LocalFileSystem", "ObservedFileSystem", "JSONRowReader", "TextRowReader"]

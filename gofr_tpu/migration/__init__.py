@@ -1,0 +1,23 @@
+"""Versioned migrations (reference: pkg/gofr/migration/).
+
+An ordered int-keyed map of ``Migrate`` objects runs against the initialized
+datasources (migration/migration.go:29-99): a ``gofr_migration`` tracking
+table records applied versions with start time + duration; versions at or
+below the last applied are skipped (resume semantics, :50-98); SQL
+migrations run inside a transaction with rollback on failure. The
+``Datasource`` facade hands the user's UP function scoped handles
+(migration/datasource.go).
+
+TPU-build extension (SURVEY §5.4): the same bookkeeping versions
+weight/compiled-executable caches — a migration can warm the XLA compile
+cache or re-shard checkpoints, recorded like any schema change.
+"""
+
+from gofr_tpu.migration.migration import (
+    Datasource,
+    Migrate,
+    MigrationError,
+    run_migrations,
+)
+
+__all__ = ["Migrate", "Datasource", "MigrationError", "run_migrations"]

@@ -1,0 +1,130 @@
+"""Migration runner.
+
+Reference parity: migration/migration.go — ``run_migrations`` builds the
+migrator chain over whichever datasources exist (:118-235), ensures the
+``gofr_migration`` tracking store, fetches the last applied version, and for
+each higher version begins a transaction, calls the user's UP function with
+the Datasource facade, and commits bookkeeping (:57-98) or rolls back.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Any, Callable
+
+
+class MigrationError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class Migrate:
+    """migration/migration.go:14-18."""
+
+    up: Callable[["Datasource"], None]
+
+
+@dataclasses.dataclass
+class Datasource:
+    """The facade handed to UP functions (migration/datasource.go)."""
+
+    sql: Any = None
+    redis: Any = None
+    kv_store: Any = None
+    pubsub: Any = None
+    tpu: Any = None
+    logger: Any = None
+
+
+SQL_TRACKING_TABLE = """
+CREATE TABLE IF NOT EXISTS gofr_migration (
+    version    INTEGER PRIMARY KEY,
+    method     TEXT NOT NULL,
+    start_time TEXT NOT NULL,
+    duration   INTEGER
+)
+"""
+
+REDIS_TRACKING_KEY = "gofr_migrations"
+
+
+def _sql_last_version(sql: Any) -> int:
+    row = sql.query_row("SELECT MAX(version) AS v FROM gofr_migration")
+    return int(row["v"]) if row and row.get("v") is not None else 0
+
+
+def _redis_last_version(redis: Any) -> int:
+    data = redis.hgetall(REDIS_TRACKING_KEY)
+    return max((int(v) for v in data.keys()), default=0)
+
+
+def _kv_last_version(kv: Any) -> int:
+    try:
+        return int(kv.get("gofr_migration_version"))
+    except Exception:
+        return 0
+
+
+def run_migrations(migrations: dict[int, Migrate | Callable], container: Any) -> None:
+    """App.Migrate (gofr.go:220-227 → migration.Run)."""
+    if not migrations:
+        return
+    logger = container.logger
+    versions = sorted(migrations)
+    if any(v <= 0 for v in versions):
+        raise MigrationError("migration versions must be positive integers")
+
+    ds = Datasource(
+        sql=container.sql,
+        redis=container.redis,
+        kv_store=container.kv_store,
+        pubsub=container.pubsub,
+        tpu=container.tpu,
+        logger=logger,
+    )
+
+    # determine last applied version across available tracking stores
+    last = 0
+    if ds.sql is not None:
+        ds.sql.exec(SQL_TRACKING_TABLE)
+        last = max(last, _sql_last_version(ds.sql))
+    if ds.redis is not None:
+        last = max(last, _redis_last_version(ds.redis))
+    if ds.sql is None and ds.redis is None and ds.kv_store is not None:
+        last = max(last, _kv_last_version(ds.kv_store))
+
+    for version in versions:
+        if version <= last:
+            logger.debug(f"skipping migration {version} (already applied)")
+            continue
+        migrate = migrations[version]
+        up = migrate.up if isinstance(migrate, Migrate) else migrate
+        start = time.time()
+        started = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(start))
+
+        tx = ds.sql.begin() if ds.sql is not None else None
+        scoped = dataclasses.replace(ds, sql=tx if tx is not None else None)
+        try:
+            up(scoped)
+        except Exception as exc:
+            if tx is not None:
+                tx.rollback()
+            raise MigrationError(f"migration {version} failed: {exc}") from exc
+
+        duration_ms = int((time.time() - start) * 1000)
+        if tx is not None:
+            tx.exec(
+                "INSERT INTO gofr_migration (version, method, start_time, duration) VALUES (?, ?, ?, ?)",
+                version, "UP", started, duration_ms,
+            )
+            tx.commit()
+        if ds.redis is not None:
+            ds.redis.hset(
+                REDIS_TRACKING_KEY, str(version),
+                json.dumps({"method": "UP", "startTime": started, "duration": duration_ms}),
+            )
+        if ds.sql is None and ds.redis is None and ds.kv_store is not None:
+            ds.kv_store.set("gofr_migration_version", str(version))
+        logger.info(f"migration {version} applied in {duration_ms}ms")

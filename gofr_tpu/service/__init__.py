@@ -1,0 +1,32 @@
+"""Inter-service HTTP client (reference: pkg/gofr/service/).
+
+Base client with per-request span, trace propagation, structured log +
+``app_http_service_response`` histogram (service/new.go:136-210), and an
+Options decorator chain (service/options.go:3-5): circuit breaker
+(circuit_breaker.go:24-157), retry (retry.go:96-109), basic/API-key/OAuth
+auth, default headers, custom health (health_config.go:5-31).
+"""
+
+from gofr_tpu.service.client import HTTPService, ServiceResponse, new_http_service
+from gofr_tpu.service.options import (
+    APIKeyConfig,
+    BasicAuthConfig,
+    CircuitBreakerConfig,
+    DefaultHeaders,
+    HealthConfig,
+    OAuthConfig,
+    RetryConfig,
+)
+
+__all__ = [
+    "HTTPService",
+    "ServiceResponse",
+    "new_http_service",
+    "CircuitBreakerConfig",
+    "RetryConfig",
+    "BasicAuthConfig",
+    "APIKeyConfig",
+    "OAuthConfig",
+    "DefaultHeaders",
+    "HealthConfig",
+]

@@ -1,0 +1,278 @@
+"""Option decorators for the HTTP service client.
+
+Reference parity: service/options.go:3-5 — each option wraps the client and
+returns a client with the same surface. Implemented: circuit breaker
+(service/circuit_breaker.go:24-157: failure counting, Open state, async
+health-probe recovery loop), retry (service/retry.go:96-109: retry on error
+or 5xx), Basic/API-key/OAuth client-credentials auth (service/{basic_auth,
+apikey_auth,oauth}.go, token cache), default headers (custom_header.go),
+custom health endpoint/timeout (health_config.go:5-31).
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import threading
+import time
+from typing import Any
+
+from gofr_tpu.service.client import ServiceResponse
+
+
+class _Wrapper:
+    """Forwards the client surface; subclasses override ``request``."""
+
+    def __init__(self, inner: Any) -> None:
+        self._inner = inner
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+    def request(self, method: str, path: str, **kw: Any) -> ServiceResponse:
+        return self._inner.request(method, path, **kw)
+
+    def get(self, path: str, params: dict | None = None, **kw: Any) -> ServiceResponse:
+        return self.request("GET", path, params=params, **kw)
+
+    def post(self, path: str, params: dict | None = None, body: bytes | None = None, **kw: Any) -> ServiceResponse:
+        return self.request("POST", path, params=params, body=body, **kw)
+
+    def put(self, path: str, params: dict | None = None, body: bytes | None = None, **kw: Any) -> ServiceResponse:
+        return self.request("PUT", path, params=params, body=body, **kw)
+
+    def patch(self, path: str, params: dict | None = None, body: bytes | None = None, **kw: Any) -> ServiceResponse:
+        return self.request("PATCH", path, params=params, body=body, **kw)
+
+    def delete(self, path: str, body: bytes | None = None, **kw: Any) -> ServiceResponse:
+        return self.request("DELETE", path, body=body, **kw)
+
+    def health_check(self) -> dict[str, Any]:
+        return self._inner.health_check()
+
+
+class CircuitBreakerError(Exception):
+    status_code = 503
+
+    def __init__(self, address: str) -> None:
+        super().__init__(f"circuit breaker open for {address}")
+
+
+@dataclasses.dataclass
+class CircuitBreakerConfig:
+    """service/circuit_breaker.go: Closed until ``threshold`` consecutive
+    failures, then Open; a background probe hits the health endpoint every
+    ``interval`` seconds and closes the breaker on success."""
+
+    threshold: int = 5
+    interval: float = 10.0
+
+    def add_option(self, inner: Any) -> "CircuitBreaker":
+        return CircuitBreaker(inner, self.threshold, self.interval)
+
+
+class CircuitBreaker(_Wrapper):
+    def __init__(self, inner: Any, threshold: int, interval: float) -> None:
+        super().__init__(inner)
+        self.threshold = threshold
+        self.interval = interval
+        self._failures = 0
+        self._open = False
+        self._lock = threading.Lock()
+        self._probe_thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    @property
+    def is_open(self) -> bool:
+        return self._open
+
+    def request(self, method: str, path: str, **kw: Any) -> ServiceResponse:
+        with self._lock:
+            if self._open:
+                raise CircuitBreakerError(getattr(self._inner, "address", "?"))
+        try:
+            resp = self._inner.request(method, path, **kw)
+        except Exception:
+            self._record_failure()
+            raise
+        if resp.status_code >= 500:
+            self._record_failure()
+        else:
+            with self._lock:
+                self._failures = 0
+        return resp
+
+    def _record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self._failures >= self.threshold and not self._open:
+                self._open = True
+                self._start_probe()
+
+    def _start_probe(self) -> None:
+        """Async recovery loop (circuit_breaker.go:100-119)."""
+        self._stop.clear()
+        self._probe_thread = threading.Thread(target=self._probe_loop, daemon=True, name="cb-probe")
+        self._probe_thread.start()
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            health = self._inner.health_check()
+            if health.get("status") == "UP":
+                with self._lock:
+                    self._open = False
+                    self._failures = 0
+                self._stop.set()
+                return
+
+    def health_check(self) -> dict[str, Any]:
+        if self._open:
+            return {"status": "DOWN", "details": {"circuit_breaker": "open"}}
+        return self._inner.health_check()
+
+
+@dataclasses.dataclass
+class RetryConfig:
+    """service/retry.go:96-109: retry on transport error or 5xx."""
+
+    max_retries: int = 3
+    backoff: float = 0.0
+
+    def add_option(self, inner: Any) -> "Retry":
+        return Retry(inner, self.max_retries, self.backoff)
+
+
+class Retry(_Wrapper):
+    def __init__(self, inner: Any, max_retries: int, backoff: float) -> None:
+        super().__init__(inner)
+        self.max_retries = max_retries
+        self.backoff = backoff
+
+    def request(self, method: str, path: str, **kw: Any) -> ServiceResponse:
+        last_exc: Exception | None = None
+        last_resp: ServiceResponse | None = None
+        for attempt in range(self.max_retries + 1):
+            if attempt and self.backoff:
+                time.sleep(self.backoff * attempt)
+            try:
+                resp = self._inner.request(method, path, **kw)
+            except CircuitBreakerError:
+                raise  # breaker opening mid-retry: stop hammering
+            except Exception as exc:
+                last_exc = exc
+                continue
+            if resp.status_code < 500:
+                return resp
+            last_resp = resp
+        if last_resp is not None:
+            return last_resp
+        assert last_exc is not None
+        raise last_exc
+
+
+class _HeaderOption(_Wrapper):
+    def __init__(self, inner: Any, headers: dict[str, str]) -> None:
+        super().__init__(inner)
+        self._headers = headers
+
+    def request(self, method: str, path: str, **kw: Any) -> ServiceResponse:
+        headers = dict(self._headers)
+        headers.update(kw.pop("headers", None) or {})
+        return self._inner.request(method, path, headers=headers, **kw)
+
+
+@dataclasses.dataclass
+class BasicAuthConfig:
+    username: str = ""
+    password: str = ""
+
+    def add_option(self, inner: Any) -> Any:
+        token = base64.b64encode(f"{self.username}:{self.password}".encode()).decode()
+        return _HeaderOption(inner, {"Authorization": f"Basic {token}"})
+
+
+@dataclasses.dataclass
+class APIKeyConfig:
+    api_key: str = ""
+
+    def add_option(self, inner: Any) -> Any:
+        return _HeaderOption(inner, {"X-API-Key": self.api_key})
+
+
+@dataclasses.dataclass
+class DefaultHeaders:
+    headers: dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def add_option(self, inner: Any) -> Any:
+        return _HeaderOption(inner, dict(self.headers))
+
+
+@dataclasses.dataclass
+class OAuthConfig:
+    """Client-credentials flow with token cache (service/oauth.go)."""
+
+    token_url: str = ""
+    client_id: str = ""
+    client_secret: str = ""
+    scopes: tuple[str, ...] = ()
+    early_refresh: float = 30.0
+
+    def add_option(self, inner: Any) -> "OAuth":
+        return OAuth(inner, self)
+
+
+class OAuth(_Wrapper):
+    def __init__(self, inner: Any, cfg: OAuthConfig) -> None:
+        super().__init__(inner)
+        self.cfg = cfg
+        self._token: str | None = None
+        self._expires_at = 0.0
+        self._lock = threading.Lock()
+
+    def _fetch_token(self) -> str:
+        import json
+        import urllib.parse
+        import urllib.request
+
+        data = urllib.parse.urlencode(
+            {
+                "grant_type": "client_credentials",
+                "client_id": self.cfg.client_id,
+                "client_secret": self.cfg.client_secret,
+                **({"scope": " ".join(self.cfg.scopes)} if self.cfg.scopes else {}),
+            }
+        ).encode()
+        req = urllib.request.Request(self.cfg.token_url, data=data, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            payload = json.loads(resp.read())
+        self._token = payload["access_token"]
+        self._expires_at = time.time() + float(payload.get("expires_in", 3600))
+        return self._token
+
+    def _bearer(self) -> str:
+        with self._lock:
+            if self._token is None or time.time() > self._expires_at - self.cfg.early_refresh:
+                self._fetch_token()
+            return self._token  # type: ignore[return-value]
+
+    def request(self, method: str, path: str, **kw: Any) -> ServiceResponse:
+        headers = kw.pop("headers", None) or {}
+        headers.setdefault("Authorization", f"Bearer {self._bearer()}")
+        return self._inner.request(method, path, headers=headers, **kw)
+
+
+@dataclasses.dataclass
+class HealthConfig:
+    """Custom health endpoint/timeout (service/health_config.go:5-31)."""
+
+    endpoint: str = ".well-known/alive"
+    timeout: float | None = None
+
+    def add_option(self, inner: Any) -> Any:
+        base = inner
+        while hasattr(base, "_inner"):
+            base = base._inner
+        base.health_endpoint = self.endpoint.lstrip("/")
+        if self.timeout is not None:
+            base.health_timeout = self.timeout
+        return inner

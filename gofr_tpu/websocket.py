@@ -1,0 +1,332 @@
+"""WebSockets: server upgrade + per-message handler loop, connection
+manager, outbound WS services with reconnection.
+
+Reference parity: pkg/gofr/websocket.go + pkg/gofr/websocket/ —
+``app.websocket(route, handler)`` runs the handler per received message
+(websocket.go:30-49,100-117), connections are tracked in a manager keyed by
+the Sec-WebSocket-Key (middleware/web_socket.go:14-37), writes are
+serialized per connection (websocket/websocket.go:21-26), and
+``add_ws_service`` maintains an outbound connection with a reconnection
+loop (websocket.go:52-98).
+
+The server side implements RFC6455 framing directly on the asyncio streams
+owned by our HTTP server; the outbound client uses the ``websockets``
+library (present in the image), mirroring the reference's use of
+gorilla/websocket.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import json
+import os
+import struct
+from typing import Any
+
+WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+# opcodes
+OP_CONT, OP_TEXT, OP_BINARY, OP_CLOSE, OP_PING, OP_PONG = 0, 1, 2, 8, 9, 10
+
+
+def accept_key(client_key: str) -> str:
+    digest = hashlib.sha1((client_key + WS_MAGIC).encode()).digest()
+    return base64.b64encode(digest).decode()
+
+
+def _encode_frame(opcode: int, payload: bytes, mask: bool = False) -> bytes:
+    head = bytes([0x80 | opcode])
+    length = len(payload)
+    mask_bit = 0x80 if mask else 0
+    if length < 126:
+        head += bytes([mask_bit | length])
+    elif length < (1 << 16):
+        head += bytes([mask_bit | 126]) + struct.pack(">H", length)
+    else:
+        head += bytes([mask_bit | 127]) + struct.pack(">Q", length)
+    if mask:
+        key = os.urandom(4)
+        masked = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+        return head + key + masked
+    return head + payload
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> tuple[bool, int, bytes]:
+    header = await reader.readexactly(2)
+    fin = bool(header[0] & 0x80)
+    opcode = header[0] & 0x0F
+    masked = header[1] & 0x80
+    length = header[1] & 0x7F
+    if length == 126:
+        length = struct.unpack(">H", await reader.readexactly(2))[0]
+    elif length == 127:
+        length = struct.unpack(">Q", await reader.readexactly(8))[0]
+    if length > (64 << 20):
+        raise ConnectionError("websocket frame too large")
+    key = await reader.readexactly(4) if masked else None
+    payload = await reader.readexactly(length) if length else b""
+    if key:
+        payload = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+    return fin, opcode, payload
+
+
+async def read_message(reader: asyncio.StreamReader) -> tuple[int, bytes]:
+    """Read one complete message, reassembling FIN=0 fragment chains
+    (continuation frames). Control frames may interleave; PING/CLOSE are
+    returned immediately for the caller to handle."""
+    fin, opcode, payload = await _read_frame(reader)
+    if opcode in (OP_CLOSE, OP_PING, OP_PONG):
+        return opcode, payload
+    parts = [payload]
+    first_opcode = opcode
+    while not fin:
+        fin, opcode, payload = await _read_frame(reader)
+        if opcode in (OP_CLOSE, OP_PING, OP_PONG):
+            # control frame interleaved within a fragmented message
+            return opcode, payload
+        parts.append(payload)
+    return first_opcode, b"".join(parts)
+
+
+class Connection:
+    """Thread/task-safe server-side connection (websocket/websocket.go:21-26:
+    per-connection write mutex)."""
+
+    def __init__(self, key: str, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        self.key = key
+        self._reader = reader
+        self._writer = writer
+        self._write_lock = asyncio.Lock()
+        self.closed = False
+        self._bg_sends: set = set()  # strong refs to fire-and-forget sends
+
+    async def send_async(self, data: Any) -> None:
+        if isinstance(data, (dict, list)):
+            payload, op = json.dumps(data).encode(), OP_TEXT
+        elif isinstance(data, str):
+            payload, op = data.encode(), OP_TEXT
+        else:
+            payload, op = bytes(data), OP_BINARY
+        async with self._write_lock:
+            self._writer.write(_encode_frame(op, payload))
+            await self._writer.drain()
+
+    def send(self, data: Any) -> None:
+        """Sync facade. From an executor thread it blocks until sent; called
+        on the event loop itself it schedules the send instead of blocking
+        (blocking there would deadlock the loop against its own coroutine)."""
+        loop = getattr(self, "_loop", None)
+        if loop is None:
+            raise RuntimeError("connection not bound to a loop")
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            task = loop.create_task(self.send_async(data))
+            self._bg_sends.add(task)
+            task.add_done_callback(self._bg_sends.discard)
+        else:
+            asyncio.run_coroutine_threadsafe(self.send_async(data), loop).result(timeout=30)
+
+    async def close(self, code: int = 1000) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        try:
+            async with self._write_lock:
+                self._writer.write(_encode_frame(OP_CLOSE, struct.pack(">H", code)))
+                await self._writer.drain()
+            self._writer.close()
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            pass
+
+
+class WSManager:
+    """Connection hub (websocket/websocket.go:114-198) + outbound services."""
+
+    def __init__(self, logger: Any = None) -> None:
+        self.logger = logger
+        self.connections: dict[str, Connection] = {}
+        self.services: dict[str, Any] = {}  # name -> client connection
+        self._service_urls: dict[str, tuple[str, bool]] = {}  # name -> (url, reconnect)
+        self._tasks: list[asyncio.Task] = []
+        self._loop: asyncio.AbstractEventLoop | None = None
+
+    def add_connection(self, key: str, conn: Connection) -> None:
+        self.connections[key] = conn
+
+    def remove_connection(self, key: str) -> None:
+        self.connections.pop(key, None)
+
+    def get_connection(self, key: str) -> Connection | None:
+        return self.connections.get(key)
+
+    # -- outbound services (websocket.go:52-98) --------------------------------
+    def add_service(self, name: str, url: str, reconnect: bool = True) -> None:
+        """Record an outbound service; connected at app start
+        (connect_services) with an optional reconnection loop."""
+        self._service_urls[name] = (url, reconnect)
+
+    async def connect_services(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        for name, (url, reconnect) in self._service_urls.items():
+            task = asyncio.create_task(
+                self._service_loop(name, url, reconnect), name=f"ws-svc-{name}"
+            )
+            self._tasks.append(task)  # strong ref: loop holds only weak refs
+
+    async def close(self) -> None:
+        for task in self._tasks:
+            task.cancel()
+        if self._tasks:
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+
+    async def _service_loop(self, name: str, url: str, reconnect: bool) -> None:
+        import websockets
+
+        while True:
+            try:
+                async with websockets.connect(url) as ws:
+                    self.services[name] = ws
+                    if self.logger:
+                        self.logger.info(f"connected to websocket service {name} at {url}")
+                    await ws.wait_closed()
+            except Exception as exc:
+                if self.logger:
+                    self.logger.debug(f"ws service {name} connection error: {exc}")
+            self.services.pop(name, None)
+            if not reconnect:
+                return
+            await asyncio.sleep(2.0)
+
+    def write_to_service(self, name: str, data: Any) -> None:
+        """Safe from both the event loop and executor threads (sync handlers
+        run in the executor, handler.py)."""
+        ws = self.services.get(name)
+        if ws is None:
+            raise RuntimeError(f"websocket service {name} not connected")
+        if self._loop is None:
+            raise RuntimeError("websocket manager not started")
+        payload = json.dumps(data) if isinstance(data, (dict, list)) else data
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is self._loop:
+            task = self._loop.create_task(ws.send(payload))
+            self._tasks.append(task)
+        else:
+            asyncio.run_coroutine_threadsafe(ws.send(payload), self._loop).result(timeout=30)
+
+
+class _WSRequest:
+    """Adapts one received WS message to the Request contract so the same
+    Handler signature serves sockets (websocket.go:100-117)."""
+
+    def __init__(self, base_request: Any, message: bytes) -> None:
+        self._base = base_request
+        self.message = message
+
+    def param(self, key: str) -> str:
+        return self._base.param(key)
+
+    def params(self, key: str) -> list[str]:
+        return self._base.params(key)
+
+    def path_param(self, key: str) -> str:
+        return self._base.path_param(key)
+
+    def header(self, key: str) -> str:
+        return self._base.header(key)
+
+    def host_name(self) -> str:
+        return self._base.host_name()
+
+    def bind(self, target: Any) -> Any:
+        """Reuses the HTTP request's binder so WS payloads behave exactly
+        like JSON bodies (same coercion, same BindError on malformed
+        input)."""
+        if target is bytes:
+            return self.message
+        if target is str:
+            return self.message.decode("utf-8", "replace")
+        from gofr_tpu.http.request import Request
+
+        return Request(
+            "GET", "/ws", {}, {"Content-Type": "application/json"}, self.message
+        ).bind(target)
+
+
+class WSUpgrader:
+    """Plugs into HTTPServer.ws_upgrader: performs the RFC6455 handshake for
+    registered ws routes, then runs the per-message handler loop."""
+
+    def __init__(self, registry: dict[str, Any], container: Any) -> None:
+        from gofr_tpu.http.router import Router
+
+        self.container = container
+        self.router = Router()
+        for pattern, handler in registry.items():
+            self.router.add("GET", pattern, handler)
+
+    async def __call__(self, request: Any, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> bool:
+        match = self.router.lookup("GET", request.path)
+        if match is None:
+            return False
+        handler, params = match
+        request.path_params = params
+        client_key = request.header("sec-websocket-key")
+        if not client_key:
+            return False
+
+        # handshake
+        response = (
+            "HTTP/1.1 101 Switching Protocols\r\n"
+            "Upgrade: websocket\r\n"
+            "Connection: Upgrade\r\n"
+            f"Sec-WebSocket-Accept: {accept_key(client_key)}\r\n\r\n"
+        )
+        writer.write(response.encode())
+        await writer.drain()
+
+        conn = Connection(client_key, reader, writer)
+        conn._loop = asyncio.get_running_loop()  # type: ignore[attr-defined]
+        manager = self.container.ws_manager
+        if manager is not None:
+            manager.add_connection(client_key, conn)
+
+        from gofr_tpu.context import Context
+        from gofr_tpu.handler import execute_handler
+
+        try:
+            while not conn.closed:
+                try:
+                    opcode, payload = await read_message(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError, ConnectionError):
+                    break
+                if opcode == OP_CLOSE:
+                    await conn.close()
+                    break
+                if opcode == OP_PING:
+                    async with conn._write_lock:
+                        writer.write(_encode_frame(OP_PONG, payload))
+                        await writer.drain()
+                    continue
+                if opcode not in (OP_TEXT, OP_BINARY):
+                    continue
+                ctx = Context(_WSRequest(request, payload), self.container)
+                ctx.websocket = conn
+                result = await execute_handler(handler, ctx)
+                if result.error is not None:
+                    self.container.logger.log_error(result.error)
+                elif result.data is not None:
+                    await conn.send_async(result.data)
+        finally:
+            if manager is not None:
+                manager.remove_connection(client_key)
+            await conn.close()
+        return True

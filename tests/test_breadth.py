@@ -1,0 +1,216 @@
+"""Migrations, CRUD handlers, CLI, file datasource, cron parser."""
+
+import dataclasses
+import io
+import json
+
+import pytest
+
+from gofr_tpu.config import MapConfig
+from gofr_tpu.testutil import new_mock_container
+
+
+# ---------------------------------------------------------------- migrations
+def test_migrations_apply_and_resume():
+    from gofr_tpu.migration import Migrate, run_migrations
+
+    container, mocks = new_mock_container()
+    applied = []
+
+    migrations = {
+        1: Migrate(up=lambda ds: (applied.append(1), ds.sql.exec(
+            "CREATE TABLE users (id INTEGER PRIMARY KEY, name TEXT)"))),
+        2: Migrate(up=lambda ds: (applied.append(2), ds.sql.exec(
+            "INSERT INTO users (id, name) VALUES (1, 'ada')"))),
+    }
+    run_migrations(migrations, container)
+    assert applied == [1, 2]
+    rows = mocks.sql.query("SELECT version FROM gofr_migration ORDER BY version")
+    assert [r["version"] for r in rows] == [1, 2]
+
+    # resume: re-running skips applied versions, applies only new ones
+    migrations[3] = Migrate(up=lambda ds: applied.append(3))
+    run_migrations(migrations, container)
+    assert applied == [1, 2, 3]
+
+
+def test_migration_rollback_on_failure():
+    from gofr_tpu.migration import Migrate, MigrationError, run_migrations
+
+    container, mocks = new_mock_container()
+
+    def bad(ds):
+        ds.sql.exec("CREATE TABLE t1 (id INTEGER)")
+        raise RuntimeError("boom")
+
+    with pytest.raises(MigrationError):
+        run_migrations({1: Migrate(up=bad)}, container)
+    # transaction rolled back: table must not exist, version not recorded
+    rows = mocks.sql.query("SELECT name FROM sqlite_master WHERE name='t1'")
+    assert rows == []
+    assert mocks.sql.query("SELECT * FROM gofr_migration") == []
+
+
+# ---------------------------------------------------------------- CRUD
+@dataclasses.dataclass
+class Book:
+    id: int = 0
+    title: str = ""
+
+
+def test_crud_handlers(run_async):
+    import asyncio
+
+    from gofr_tpu.crud import add_rest_handlers
+    from gofr_tpu.context import Context
+    from gofr_tpu.http.errors import ErrorEntityNotFound
+    from gofr_tpu.http.request import Request
+
+    container, mocks = new_mock_container()
+    mocks.sql.exec("CREATE TABLE book (id INTEGER PRIMARY KEY, title TEXT)")
+
+    routes = {}
+
+    class FakeApp:
+        def __init__(self):
+            self.container = container
+
+        def post(self, p, h):
+            routes[("POST", p)] = h
+
+        def get(self, p, h):
+            routes[("GET", p)] = h
+
+        def put(self, p, h):
+            routes[("PUT", p)] = h
+
+        def delete(self, p, h):
+            routes[("DELETE", p)] = h
+
+    add_rest_handlers(FakeApp(), Book)
+    assert ("POST", "/book") in routes and ("GET", "/book/{id}") in routes
+
+    def call(method, pattern, body=None, path_params=None):
+        req = Request(
+            method, pattern, {}, {"Content-Type": "application/json"},
+            json.dumps(body).encode() if body else b"",
+            path_params or {},
+        )
+        return routes[(method, pattern)](Context(req, container))
+
+    assert "successfully created" in call("POST", "/book", {"id": 1, "title": "jax"})
+    books = call("GET", "/book")
+    assert len(books) == 1 and books[0].title == "jax"
+    one = call("GET", "/book/{id}", path_params={"id": "1"})
+    assert one.id == 1
+    assert "updated" in call("PUT", "/book/{id}", {"id": 1, "title": "xla"}, {"id": "1"})
+    assert call("GET", "/book/{id}", path_params={"id": "1"}).title == "xla"
+    assert "deleted" in call("DELETE", "/book/{id}", path_params={"id": "1"})
+    with pytest.raises(ErrorEntityNotFound):
+        call("GET", "/book/{id}", path_params={"id": "1"})
+
+
+# ---------------------------------------------------------------- CLI
+def test_cmd_routing_and_flags(capsys):
+    import gofr_tpu
+
+    app = gofr_tpu.new_cmd(MapConfig({"APP_NAME": "tool"}, use_env=False))
+
+    def hello(ctx):
+        return f"hello {ctx.param('name') or 'world'}"
+
+    def fail(ctx):
+        raise ValueError("nope")
+
+    app.sub_command("hello", hello, "greets")
+    app.sub_command("boom", fail, "fails")
+
+    from gofr_tpu.cli import run_cmd
+
+    assert run_cmd(app, ["hello", "-name=ada"]) == 0
+    out = capsys.readouterr().out
+    assert "hello ada" in out
+
+    assert run_cmd(app, ["h"]) == 0  # prefix match
+    assert run_cmd(app, ["nope"]) == 1
+    assert "Available commands" in capsys.readouterr().out
+
+    assert run_cmd(app, ["--help"]) == 0
+    assert "greets" in capsys.readouterr().out
+
+
+def test_cmd_request_parsing():
+    from gofr_tpu.cli import CMDRequest
+
+    req = CMDRequest(["migrate", "-dry=true", "--env=prod", "key=val", "extra"])
+    assert req.command == "migrate"
+    assert req.param("dry") == "true"
+    assert req.param("env") == "prod"
+    assert req.param("key") == "val"
+    assert req.positional == ["migrate", "extra"]
+
+
+# ---------------------------------------------------------------- files
+def test_local_fs_and_row_readers(tmp_path):
+    from gofr_tpu.datasource.file import JSONRowReader, LocalFileSystem, TextRowReader
+
+    fs = LocalFileSystem(str(tmp_path))
+    fs.mkdir("sub")
+    with fs.open_file("sub/data.jsonl", "w") as f:
+        f.write('{"a": 1}\n{"a": 2}\n')
+    with fs.open_file("sub/data.jsonl", "r") as f:
+        rows = list(JSONRowReader(f))
+    assert rows == [{"a": 1}, {"a": 2}]
+
+    with fs.open_file("lines.txt", "w") as f:
+        f.write("one\ntwo\n")
+    with fs.open_file("lines.txt", "r") as f:
+        assert list(TextRowReader(f)) == ["one", "two"]
+
+    infos = fs.read_dir(".")
+    names = [i.name for i in infos]
+    assert "sub" in names and "lines.txt" in names
+    assert fs.stat("lines.txt").size == 8
+    fs.rename("lines.txt", "lines2.txt")
+    fs.remove("lines2.txt")
+    assert fs.health_check()["status"] == "UP"
+
+
+def test_observed_fs_logs(tmp_path):
+    from gofr_tpu.datasource.file import LocalFileSystem, ObservedFileSystem
+    from gofr_tpu.logging import Level, new_logger
+    from gofr_tpu.testutil import stdout_output_for_func
+
+    def scenario():
+        logger = new_logger(Level.DEBUG, exit_on_fatal=False)
+        fs = ObservedFileSystem(LocalFileSystem(str(tmp_path)), logger)
+        fs.mkdir("obs")
+        fs.read_dir(".")
+
+    out = stdout_output_for_func(scenario)
+    assert "mkdir" in out and "read_dir" in out
+
+
+# ---------------------------------------------------------------- cron parser
+def test_cron_parser():
+    import time as time_mod
+
+    from gofr_tpu.cron import CronParseError, Schedule
+
+    s = Schedule("*/15 * * * *")
+    t = time_mod.struct_time((2026, 7, 29, 10, 30, 0, 2, 210, 0))
+    assert s.matches(t)
+    t2 = time_mod.struct_time((2026, 7, 29, 10, 31, 0, 2, 210, 0))
+    assert not s.matches(t2)
+
+    s6 = Schedule("*/5 * * * * *")  # seconds granularity
+    assert s6.has_seconds
+
+    with pytest.raises(CronParseError):
+        Schedule("61 * * * *")
+    with pytest.raises(CronParseError):
+        Schedule("* * *")
+
+    s_range = Schedule("0 9-17/2 * * 1-5")
+    assert s_range.sets["hour"] == {9, 11, 13, 15, 17}
+    assert s_range.sets["dow"] == {1, 2, 3, 4, 5}

@@ -1,0 +1,139 @@
+"""Inter-service HTTP client: verbs, tracing header, circuit breaker, retry,
+auth options (reference model: pkg/gofr/service/*_test.go with httptest)."""
+
+import http.server
+import json
+import threading
+import time
+
+import pytest
+
+from gofr_tpu.service import (
+    APIKeyConfig,
+    BasicAuthConfig,
+    CircuitBreakerConfig,
+    DefaultHeaders,
+    HealthConfig,
+    HTTPService,
+    RetryConfig,
+    new_http_service,
+)
+from gofr_tpu.service.options import CircuitBreakerError
+from gofr_tpu.testutil import get_free_port
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    calls: list = []
+    fail_count = 0
+
+    def log_message(self, *args):
+        pass
+
+    def _respond(self, code, body=b"{}"):
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        _Handler.calls.append(("GET", self.path, dict(self.headers)))
+        if self.path.startswith("/fail"):
+            if _Handler.fail_count > 0:
+                _Handler.fail_count -= 1
+                self._respond(500)
+                return
+            self._respond(200)
+        elif self.path.startswith("/.well-known/alive"):
+            self._respond(200)
+        else:
+            self._respond(200, json.dumps({"path": self.path}).encode())
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        _Handler.calls.append(("POST", self.path, body))
+        self._respond(201, body or b"{}")
+
+
+@pytest.fixture(scope="module")
+def backend():
+    port = get_free_port()
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{port}"
+    server.shutdown()
+
+
+def test_verbs_and_trace_header(backend):
+    _Handler.calls.clear()
+    svc = HTTPService(backend)
+    resp = svc.get("items", params={"q": "x"})
+    assert resp.ok and resp.json()["path"] == "/items?q=x"
+
+    resp = svc.post("items", json={"a": 1})
+    assert resp.status_code == 201 and resp.json() == {"a": 1}
+
+    from gofr_tpu.tracing import Tracer
+
+    svc_traced = HTTPService(backend, tracer=Tracer("t"))
+    svc_traced.get("traced")
+    method, path, headers = _Handler.calls[-1]
+    assert "traceparent" in {k.lower() for k in headers}
+
+
+def test_health_check_and_custom_endpoint(backend):
+    svc = HTTPService(backend)
+    assert svc.health_check()["status"] == "UP"
+    svc2 = new_http_service(backend, None, None, None, HealthConfig(endpoint="items"))
+    assert svc2.health_check()["status"] == "UP"
+    down = HTTPService("http://127.0.0.1:1")  # nothing listening
+    assert down.health_check()["status"] == "DOWN"
+
+
+def test_retry_on_5xx(backend):
+    _Handler.fail_count = 2
+    svc = new_http_service(backend, None, None, None, RetryConfig(max_retries=3))
+    resp = svc.get("fail")
+    assert resp.ok  # succeeded on 3rd attempt
+
+
+def test_circuit_breaker_opens_and_recovers(backend):
+    _Handler.fail_count = 10
+    svc = new_http_service(
+        backend, None, None, None,
+        CircuitBreakerConfig(threshold=2, interval=0.1),
+    )
+    assert svc.get("fail").status_code == 500
+    assert svc.get("fail").status_code == 500
+    # breaker now open: immediate rejection without hitting the backend
+    with pytest.raises(CircuitBreakerError):
+        svc.get("fail")
+    # probe loop hits /.well-known/alive (healthy) and closes the breaker
+    deadline = time.time() + 5
+    while svc.is_open and time.time() < deadline:
+        time.sleep(0.05)
+    assert not svc.is_open
+    _Handler.fail_count = 0
+    assert svc.get("fail").ok
+
+
+def test_auth_and_header_options(backend):
+    _Handler.calls.clear()
+    svc = new_http_service(
+        backend, None, None, None,
+        BasicAuthConfig("user", "pass"),
+        DefaultHeaders({"X-Extra": "1"}),
+    )
+    svc.get("authd")
+    _method, _path, headers = _Handler.calls[-1]
+    lower = {k.lower(): v for k, v in headers.items()}
+    assert lower["authorization"].startswith("Basic ")
+    assert lower["x-extra"] == "1"
+
+    _Handler.calls.clear()
+    svc2 = new_http_service(backend, None, None, None, APIKeyConfig("secret-key"))
+    svc2.get("keyed")
+    lower = {k.lower(): v for k, v in _Handler.calls[-1][2].items()}
+    assert lower["x-api-key"] == "secret-key"

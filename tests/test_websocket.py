@@ -1,0 +1,94 @@
+"""WebSocket server: handshake, per-message handler loop, JSON bind,
+manager tracking (reference model: websocket examples' tests)."""
+
+import asyncio
+import json
+import threading
+import time
+
+import pytest
+
+import gofr_tpu
+from gofr_tpu.config import MapConfig
+from gofr_tpu.testutil import get_free_port
+
+
+@pytest.fixture
+def ws_app():
+    http_port = get_free_port()
+    config = MapConfig(
+        {
+            "HTTP_PORT": str(http_port),
+            "METRICS_PORT": str(get_free_port()),
+            "APP_NAME": "ws-app",
+            "LOG_LEVEL": "ERROR",
+        },
+        use_env=False,
+    )
+    app = gofr_tpu.App(config)
+
+    def echo_handler(ctx):
+        data = ctx.bind(dict)
+        return {"echo": data, "route_id": ctx.path_param("id")}
+
+    app.websocket("/ws/{id}", echo_handler)
+    thread = threading.Thread(target=app.run, daemon=True)
+    thread.start()
+    # wait for server
+    import urllib.request
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{http_port}/.well-known/alive", timeout=1
+            )
+            break
+        except Exception:
+            time.sleep(0.05)
+    yield app, http_port
+    app.stop()
+    thread.join(timeout=10)
+
+
+def test_websocket_echo_roundtrip(ws_app):
+    app, port = ws_app
+
+    async def scenario():
+        import websockets
+
+        async with websockets.connect(f"ws://127.0.0.1:{port}/ws/42") as ws:
+            await ws.send(json.dumps({"msg": "hello"}))
+            reply = json.loads(await asyncio.wait_for(ws.recv(), timeout=10))
+            assert reply == {"echo": {"msg": "hello"}, "route_id": "42"}
+
+            # second message on the same connection (loop keeps running)
+            await ws.send(json.dumps({"msg": "again"}))
+            reply2 = json.loads(await asyncio.wait_for(ws.recv(), timeout=10))
+            assert reply2["echo"]["msg"] == "again"
+
+    asyncio.run(scenario())
+
+
+def test_websocket_unregistered_route_stays_http(ws_app):
+    app, port = ws_app
+    import urllib.request
+
+    # a normal HTTP request to a ws route path is a 404 (no upgrade headers)
+    try:
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/ws/42", timeout=5)
+        raise AssertionError("expected HTTPError")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def test_frame_codec_roundtrip():
+    from gofr_tpu.websocket import OP_TEXT, _encode_frame
+
+    frame = _encode_frame(OP_TEXT, b"hello", mask=False)
+    assert frame[0] == 0x80 | OP_TEXT
+    assert frame[1] == 5
+    assert frame[2:] == b"hello"
+
+    big = _encode_frame(OP_TEXT, b"x" * 300, mask=False)
+    assert big[1] == 126  # extended 16-bit length
