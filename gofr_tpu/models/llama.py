@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from gofr_tpu.ops.attention import attention, decode_attention
+from gofr_tpu.ops.flash_attention import flash_attention
 from gofr_tpu.ops.norms import rms_norm
 from gofr_tpu.ops.rope import apply_rope, rope_table
 
@@ -41,6 +42,9 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     tie_embeddings: bool = False
+    # "auto" → Pallas flash-attention for prefill when shapes tile cleanly
+    # (seq multiple of 128); "dense" / "flash" force a path.
+    attn_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -161,7 +165,15 @@ def _layer(
         # right-padded rows all start at 0: write the whole slab at offset 0
         new_k = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, 0, 0))
         new_v = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, 0, 0))
-        attn = attention(q, k, v, causal=True, kv_len=cache_len)
+        use_flash_auto = (
+            cfg.attn_impl == "auto"
+            and S % 128 == 0
+            and jax.default_backend() == "tpu"  # interpret mode off-TPU is slow
+        )
+        if cfg.attn_impl == "flash" or use_flash_auto:
+            attn = flash_attention(q, k, v, cache_len, causal=True)
+        else:
+            attn = attention(q, k, v, causal=True, kv_len=cache_len)
     else:  # decode: S == 1, scatter at per-row positions
         idx = cache_len - 1  # position just written
         b_idx = jnp.arange(B)
