@@ -263,7 +263,11 @@ class App:
 
             if self.container.ws_manager is None:
                 self.container.ws_manager = WSManager(self.logger)
-            ws_upgrader = WSUpgrader(self._ws_registry, self.container)
+            ws_upgrader = WSUpgrader(
+                self._ws_registry,
+                self.container,
+                middlewares=self._middlewares + self._user_middlewares,
+            )
 
         http_server = HTTPServer(
             handler,

@@ -27,11 +27,11 @@ class CMDRequest:
         self.positional: list[str] = []
         for arg in argv:
             if arg.startswith("--"):
-                key, _, val = arg[2:].partition("=")
-                self.flags[key] = val or "true"
+                key, sep, val = arg[2:].partition("=")
+                self.flags[key] = val if sep else "true"  # `--name=` means empty
             elif arg.startswith("-"):
-                key, _, val = arg[1:].partition("=")
-                self.flags[key] = val or "true"
+                key, sep, val = arg[1:].partition("=")
+                self.flags[key] = val if sep else "true"
             elif "=" in arg:
                 key, _, val = arg.partition("=")
                 self.flags[key] = val

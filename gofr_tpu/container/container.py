@@ -98,6 +98,7 @@ class Container:
         m.new_gauge("app_sql_open_connections", "Number of open SQL connections")
         m.new_gauge("app_sql_inuse_connections", "Number of inuse SQL connections")
         m.new_histogram("app_redis_stats", "Response time of Redis commands in milliseconds")
+        m.new_histogram("app_file_stats", "Duration of file-system operations in milliseconds")
         m.new_counter("app_pubsub_publish_total_count", "Number of total publish operations")
         m.new_counter("app_pubsub_publish_success_count", "Number of successful publish operations")
         m.new_counter("app_pubsub_subscribe_total_count", "Number of total subscribe operations")

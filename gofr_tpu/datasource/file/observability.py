@@ -51,13 +51,22 @@ class ObservedFileSystem:
 
         def wrapped(*args: Any, **kw: Any) -> Any:
             start = time.perf_counter()
+            status = "SUCCESS"
             try:
                 return attr(*args, **kw)
+            except Exception:
+                status = "ERROR"
+                raise
             finally:
                 duration_us = int((time.perf_counter() - start) * 1e6)
                 if self._logger is not None:
                     target = str(args[0]) if args else ""
                     self._logger.debug(FileLog(name, target, duration_us))
+                if self._metrics is not None:
+                    self._metrics.record_histogram(
+                        "app_file_stats", duration_us / 1000.0,
+                        operation=name, status=status,
+                    )
 
         return wrapped
 

@@ -106,20 +106,23 @@ def run_migrations(migrations: dict[int, Migrate | Callable], container: Any) ->
 
         tx = ds.sql.begin() if ds.sql is not None else None
         scoped = dataclasses.replace(ds, sql=tx if tx is not None else None)
+        # Bookkeeping INSERT + commit stay inside the guarded block: a racing
+        # runner hitting the version PRIMARY KEY must roll the whole
+        # transaction back, not leave it open for a later implicit commit
+        # (migration.go:68-97 commits migration data + bookkeeping atomically).
         try:
             up(scoped)
+            duration_ms = int((time.time() - start) * 1000)
+            if tx is not None:
+                tx.exec(
+                    "INSERT INTO gofr_migration (version, method, start_time, duration) VALUES (?, ?, ?, ?)",
+                    version, "UP", started, duration_ms,
+                )
+                tx.commit()
         except Exception as exc:
             if tx is not None:
                 tx.rollback()
             raise MigrationError(f"migration {version} failed: {exc}") from exc
-
-        duration_ms = int((time.time() - start) * 1000)
-        if tx is not None:
-            tx.exec(
-                "INSERT INTO gofr_migration (version, method, start_time, duration) VALUES (?, ?, ?, ?)",
-                version, "UP", started, duration_ms,
-            )
-            tx.commit()
         if ds.redis is not None:
             ds.redis.hset(
                 REDIS_TRACKING_KEY, str(version),

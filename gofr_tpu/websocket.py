@@ -72,22 +72,49 @@ async def _read_frame(reader: asyncio.StreamReader) -> tuple[bool, int, bytes]:
     return fin, opcode, payload
 
 
-async def read_message(reader: asyncio.StreamReader) -> tuple[int, bytes]:
+async def read_message(
+    reader: asyncio.StreamReader,
+    pong: Any = None,  # async callable(payload) answering PINGs in-place
+) -> tuple[int, bytes]:
     """Read one complete message, reassembling FIN=0 fragment chains
-    (continuation frames). Control frames may interleave; PING/CLOSE are
-    returned immediately for the caller to handle."""
-    fin, opcode, payload = await _read_frame(reader)
-    if opcode in (OP_CLOSE, OP_PING, OP_PONG):
-        return opcode, payload
-    parts = [payload]
-    first_opcode = opcode
-    while not fin:
+    (continuation frames). Control frames may legally interleave within a
+    fragmented message (RFC6455 §5.4): CLOSE is returned immediately; PING is
+    answered via ``pong`` (or returned, if no callback, when not
+    mid-fragment) without discarding the partial message."""
+    parts: list[bytes] = []
+    first_opcode: int | None = None
+    while True:
         fin, opcode, payload = await _read_frame(reader)
-        if opcode in (OP_CLOSE, OP_PING, OP_PONG):
-            # control frame interleaved within a fragmented message
+        if opcode == OP_CLOSE:
             return opcode, payload
+        if opcode in (OP_PING, OP_PONG):
+            if opcode == OP_PING and pong is not None:
+                await pong(payload)
+                continue
+            if first_opcode is None:
+                return opcode, payload
+            continue  # mid-fragment PONG (or unanswerable PING): drop it
+        if first_opcode is None:
+            first_opcode = opcode
         parts.append(payload)
-    return first_opcode, b"".join(parts)
+        if fin:
+            return first_opcode, b"".join(parts)
+
+
+def _dispatch_send(loop: asyncio.AbstractEventLoop, coro: Any, bg_sends: set) -> None:
+    """Run a send coroutine from either the event loop (schedule, keep a
+    strong ref until done) or an executor thread (block until sent) — sync
+    handlers run in the executor (handler.py), so both call sites exist."""
+    try:
+        running = asyncio.get_running_loop()
+    except RuntimeError:
+        running = None
+    if running is loop:
+        task = loop.create_task(coro)
+        bg_sends.add(task)
+        task.add_done_callback(bg_sends.discard)
+    else:
+        asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=30)
 
 
 class Connection:
@@ -120,16 +147,7 @@ class Connection:
         loop = getattr(self, "_loop", None)
         if loop is None:
             raise RuntimeError("connection not bound to a loop")
-        try:
-            running = asyncio.get_running_loop()
-        except RuntimeError:
-            running = None
-        if running is loop:
-            task = loop.create_task(self.send_async(data))
-            self._bg_sends.add(task)
-            task.add_done_callback(self._bg_sends.discard)
-        else:
-            asyncio.run_coroutine_threadsafe(self.send_async(data), loop).result(timeout=30)
+        _dispatch_send(loop, self.send_async(data), self._bg_sends)
 
     async def close(self, code: int = 1000) -> None:
         if self.closed:
@@ -153,6 +171,7 @@ class WSManager:
         self.services: dict[str, Any] = {}  # name -> client connection
         self._service_urls: dict[str, tuple[str, bool]] = {}  # name -> (url, reconnect)
         self._tasks: list[asyncio.Task] = []
+        self._bg_sends: set = set()  # strong refs to fire-and-forget sends
         self._loop: asyncio.AbstractEventLoop | None = None
 
     def add_connection(self, key: str, conn: Connection) -> None:
@@ -212,15 +231,7 @@ class WSManager:
         if self._loop is None:
             raise RuntimeError("websocket manager not started")
         payload = json.dumps(data) if isinstance(data, (dict, list)) else data
-        try:
-            running = asyncio.get_running_loop()
-        except RuntimeError:
-            running = None
-        if running is self._loop:
-            task = self._loop.create_task(ws.send(payload))
-            self._tasks.append(task)
-        else:
-            asyncio.run_coroutine_threadsafe(ws.send(payload), self._loop).result(timeout=30)
+        _dispatch_send(self._loop, ws.send(payload), self._bg_sends)
 
 
 class _WSRequest:
@@ -230,6 +241,10 @@ class _WSRequest:
     def __init__(self, base_request: Any, message: bytes) -> None:
         self._base = base_request
         self.message = message
+        # auth context set by the upgrade gate's middleware carries over to
+        # every message handled on this connection (ctx.get_auth_info()).
+        self.auth = getattr(base_request, "auth", None)
+        self.path = getattr(base_request, "path", "/ws")
 
     def param(self, key: str) -> str:
         return self._base.param(key)
@@ -265,13 +280,30 @@ class WSUpgrader:
     """Plugs into HTTPServer.ws_upgrader: performs the RFC6455 handshake for
     registered ws routes, then runs the per-message handler loop."""
 
-    def __init__(self, registry: dict[str, Any], container: Any) -> None:
+    def __init__(
+        self,
+        registry: dict[str, Any],
+        container: Any,
+        middlewares: list[Any] | None = None,
+    ) -> None:
+        from gofr_tpu.http.responder import WireResponse
         from gofr_tpu.http.router import Router
+        from gofr_tpu.http.middleware.core import chain
 
         self.container = container
         self.router = Router()
         for pattern, handler in registry.items():
             self.router.add("GET", pattern, handler)
+
+        # Auth (and any user) middleware must gate the upgrade exactly as it
+        # gates plain routes (the reference runs WS upgrades inside the
+        # middleware chain, middleware/web_socket.go:14-37). The gate runs the
+        # chain over the upgrade request with a 101-sentinel terminal handler;
+        # any middleware rejection (401/403/...) is written back pre-handshake.
+        async def _accept(_req: Any) -> WireResponse:
+            return WireResponse(status=101)
+
+        self._gate = chain(_accept, middlewares) if middlewares else None
 
     async def __call__(self, request: Any, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> bool:
         match = self.router.lookup("GET", request.path)
@@ -282,6 +314,28 @@ class WSUpgrader:
         client_key = request.header("sec-websocket-key")
         if not client_key:
             return False
+
+        if self._gate is not None:
+            from gofr_tpu.http.responder import WireResponse
+            from gofr_tpu.http.server import _serialize_head
+
+            try:
+                verdict = await self._gate(request)
+            except Exception as exc:  # same isolation the HTTP chain gives
+                if self.container.logger:
+                    self.container.logger.error(f"ws upgrade middleware error: {exc}")
+                verdict = WireResponse(
+                    status=500,
+                    body=b'{"error":{"message":"internal error"}}',
+                    headers={"Content-Type": "application/json"},
+                )
+            if verdict.status != 101:
+                writer.write(
+                    _serialize_head(verdict, chunked=False, keep_alive=False)
+                    + verdict.body
+                )
+                await writer.drain()
+                return True  # handled: rejected before the handshake
 
         # handshake
         response = (
@@ -302,20 +356,20 @@ class WSUpgrader:
         from gofr_tpu.context import Context
         from gofr_tpu.handler import execute_handler
 
+        async def _pong(payload: bytes) -> None:
+            async with conn._write_lock:
+                writer.write(_encode_frame(OP_PONG, payload))
+                await writer.drain()
+
         try:
             while not conn.closed:
                 try:
-                    opcode, payload = await read_message(reader)
+                    opcode, payload = await read_message(reader, pong=_pong)
                 except (asyncio.IncompleteReadError, ConnectionResetError, ConnectionError):
                     break
                 if opcode == OP_CLOSE:
                     await conn.close()
                     break
-                if opcode == OP_PING:
-                    async with conn._write_lock:
-                        writer.write(_encode_frame(OP_PONG, payload))
-                        await writer.drain()
-                    continue
                 if opcode not in (OP_TEXT, OP_BINARY):
                     continue
                 ctx = Context(_WSRequest(request, payload), self.container)

@@ -92,3 +92,97 @@ def test_frame_codec_roundtrip():
 
     big = _encode_frame(OP_TEXT, b"x" * 300, mask=False)
     assert big[1] == 126  # extended 16-bit length
+
+
+def test_websocket_upgrade_gated_by_auth():
+    """WS upgrades must pass the same auth middleware as plain routes
+    (middleware/web_socket.go runs inside the chain in the reference)."""
+    http_port = get_free_port()
+    config = MapConfig(
+        {
+            "HTTP_PORT": str(http_port),
+            "METRICS_PORT": str(get_free_port()),
+            "APP_NAME": "ws-auth-app",
+            "LOG_LEVEL": "ERROR",
+        },
+        use_env=False,
+    )
+    app = gofr_tpu.App(config)
+    app.enable_basic_auth({"admin": "secret"})
+    app.websocket("/ws", lambda ctx: {"ok": True})
+    thread = threading.Thread(target=app.run, daemon=True)
+    thread.start()
+    import urllib.request
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{http_port}/.well-known/alive", timeout=1
+            )
+            break
+        except Exception:
+            time.sleep(0.05)
+
+    async def scenario():
+        import websockets
+
+        # no credentials -> the handshake must be refused (HTTP 401, not 101)
+        with pytest.raises(Exception) as exc_info:
+            async with websockets.connect(f"ws://127.0.0.1:{http_port}/ws"):
+                pass
+        assert "401" in str(exc_info.value)
+
+        # valid credentials -> upgrade succeeds
+        import base64
+
+        creds = base64.b64encode(b"admin:secret").decode()
+        async with websockets.connect(
+            f"ws://127.0.0.1:{http_port}/ws",
+            additional_headers={"Authorization": f"Basic {creds}"},
+        ) as ws:
+            await ws.send(json.dumps({}))
+            reply = json.loads(await asyncio.wait_for(ws.recv(), timeout=10))
+            assert reply == {"ok": True}
+
+    try:
+        asyncio.run(scenario())
+    finally:
+        app.stop()
+        thread.join(timeout=10)
+
+
+def test_read_message_reassembles_interleaved_ping():
+    """RFC6455 §5.4: a PING between fragments must not discard the partial
+    message."""
+    from gofr_tpu.websocket import (
+        OP_CONT, OP_PING, OP_TEXT, read_message,
+    )
+    import struct
+
+    def frame(opcode, payload, fin):
+        head = bytes([(0x80 if fin else 0) | opcode])
+        head += bytes([len(payload)])
+        return head + payload
+
+    stream = (
+        frame(OP_TEXT, b"hel", fin=False)
+        + frame(OP_PING, b"p", fin=True)
+        + frame(OP_CONT, b"lo", fin=True)
+    )
+
+    async def scenario():
+        reader = asyncio.StreamReader()
+        reader.feed_data(stream)
+        reader.feed_eof()
+        pongs = []
+
+        async def pong(payload):
+            pongs.append(payload)
+
+        opcode, message = await read_message(reader, pong=pong)
+        assert opcode == OP_TEXT
+        assert message == b"hello"
+        assert pongs == [b"p"]
+
+    asyncio.run(scenario())
