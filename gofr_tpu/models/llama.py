@@ -43,7 +43,8 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     tie_embeddings: bool = False
     # "auto" → Pallas flash-attention for prefill when shapes tile cleanly
-    # (seq multiple of 128); "dense" / "flash" force a path.
+    # (seq multiple of 128); "dense" / "flash" force a path; "cp" → context-
+    # parallel ring/Ulysses attention under an ambient cp_context(mesh).
     attn_impl: str = "auto"
 
     @property
@@ -159,7 +160,14 @@ def _layer(
     k = apply_rope(k, positions, sin, cos)
 
     if mode == "prefill_nocache":
-        attn = attention(q, k, v, causal=True, kv_len=None)
+        if cfg.attn_impl == "cp":
+            # long-context path: seq axis sharded on the sp mesh axis, ring
+            # or Ulysses attention per the ambient cp_context (§5.7)
+            from gofr_tpu.parallel.context_parallel import cp_attention
+
+            attn = cp_attention(q, k, v)
+        else:
+            attn = attention(q, k, v, causal=True, kv_len=None)
         new_k = new_v = None
     elif mode == "prefill":
         # right-padded rows all start at 0: write the whole slab at offset 0
@@ -199,6 +207,14 @@ def _run_layers(
     cache_len: jnp.ndarray | None,
     mode: str,
 ) -> tuple[jnp.ndarray, KVCache | None]:
+    if cfg.attn_impl == "cp" and mode != "prefill_nocache":
+        # context-parallel attention covers the no-cache forward path only;
+        # failing loudly beats silently serving dense attention when the
+        # config asked for O(S/n) memory (serving CP lands with paged KV).
+        raise ValueError(
+            f"attn_impl='cp' is not supported in mode={mode!r}; "
+            "use forward() or a dense/flash attn_impl for prefill/decode"
+        )
     sin, cos = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
 
     if cache is None:
@@ -225,15 +241,33 @@ def _logits(cfg: LlamaConfig, params: dict, x: jnp.ndarray) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------- entry points
-@partial(jax.jit, static_argnums=0)
-def forward(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
-    """Plain causal forward (no cache): [B, S] -> logits [B, S, V].
-    The graft entry / training-style step."""
+@partial(jax.jit, static_argnums=(0, 3))
+def _forward_jit(
+    cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, _cp_key: Any
+) -> jnp.ndarray:
     B, S = tokens.shape
     x = params["embedding"][tokens].astype(cfg.dtype)
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     x, _ = _run_layers(cfg, params, x, positions, None, None, "prefill_nocache")
     return _logits(cfg, params, x)
+
+
+def forward(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Plain causal forward (no cache): [B, S] -> logits [B, S, V].
+    The graft entry / training-style step.
+
+    For attn_impl="cp" the ambient cp_context (mesh, axis, impl) joins the
+    jit cache key — a context switch retraces instead of silently reusing
+    the collectives compiled for a previous mesh.
+    """
+    cp_key = None
+    if cfg.attn_impl == "cp":
+        from gofr_tpu.parallel.context_parallel import current_cp
+
+        cp_key = current_cp()
+        if cp_key is None:
+            raise RuntimeError("attn_impl='cp' requires an enclosing cp_context(mesh)")
+    return _forward_jit(cfg, params, tokens, cp_key)
 
 
 @partial(jax.jit, static_argnums=0, donate_argnums=(3,))

@@ -8,6 +8,11 @@ not hand-written NCCL), sequence/context parallelism via ring attention
 (§5.7), and host-side helpers.
 """
 
+from gofr_tpu.parallel.context_parallel import (
+    cp_context,
+    ring_attention,
+    ulysses_attention,
+)
 from gofr_tpu.parallel.mesh import MeshSpec, build_mesh, local_mesh
 from gofr_tpu.parallel.sharding import (
     ShardingRules,
@@ -18,6 +23,9 @@ from gofr_tpu.parallel.sharding import (
 )
 
 __all__ = [
+    "cp_context",
+    "ring_attention",
+    "ulysses_attention",
     "MeshSpec",
     "build_mesh",
     "local_mesh",

@@ -72,17 +72,28 @@ async def _read_frame(reader: asyncio.StreamReader) -> tuple[bool, int, bytes]:
     return fin, opcode, payload
 
 
+MAX_MESSAGE_BYTES = 64 << 20  # total across a fragment chain, same as per-frame
+
+
 async def read_message(
     reader: asyncio.StreamReader,
     pong: Any = None,  # async callable(payload) answering PINGs in-place
 ) -> tuple[int, bytes]:
     """Read one complete message, reassembling FIN=0 fragment chains
-    (continuation frames). Control frames may legally interleave within a
-    fragmented message (RFC6455 §5.4): CLOSE is returned immediately; PING is
-    answered via ``pong`` (or returned, if no callback, when not
-    mid-fragment) without discarding the partial message."""
+    (continuation frames), capped at MAX_MESSAGE_BYTES total (the per-frame
+    cap alone is bypassable by fragmenting). Control frames may legally
+    interleave within a fragmented message (RFC6455 §5.4): CLOSE is returned
+    immediately; a PING is answered via ``pong`` when given — without a
+    callback a pre-fragment PING is returned to the caller and a mid-fragment
+    one is queued and returned as its own message after reassembly, so the
+    caller can still answer it."""
+    pending = getattr(reader, "_gofr_pending_pings", None)
+    if pending:
+        return OP_PING, pending.pop(0)
     parts: list[bytes] = []
+    total = 0
     first_opcode: int | None = None
+    pending_pings: list[bytes] = []
     while True:
         fin, opcode, payload = await _read_frame(reader)
         if opcode == OP_CLOSE:
@@ -93,11 +104,18 @@ async def read_message(
                 continue
             if first_opcode is None:
                 return opcode, payload
-            continue  # mid-fragment PONG (or unanswerable PING): drop it
+            if opcode == OP_PING:
+                pending_pings.append(payload)
+            continue  # mid-fragment PONG: drop it
+        total += len(payload)
+        if total > MAX_MESSAGE_BYTES:
+            raise ConnectionError("websocket message too large")
         if first_opcode is None:
             first_opcode = opcode
         parts.append(payload)
         if fin:
+            if pending_pings:
+                reader._gofr_pending_pings = pending_pings  # type: ignore[attr-defined]
             return first_opcode, b"".join(parts)
 
 
