@@ -1,0 +1,183 @@
+"""MoE Llama family (Mixtral-shape): dense GQA attention + top-k sparse
+expert FFN, with expert parallelism over the ``ep`` mesh axis.
+
+The reference has no model zoo at all (SURVEY §2.9 — EP listed as a
+required TPU-build capability with no GoFr counterpart); shapes follow
+Mixtral-8x7B conventions. Attention reuses the llama layer pieces
+(ops/attention, ops/rope, rms_norm); the FFN routes through
+ops/moe.moe_ffn_ep when a mesh is supplied (GShard all_to_all dispatch over
+ICI) or the dense reference path off-mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from gofr_tpu.models.llama import _logits
+from gofr_tpu.ops import moe as moe_ops
+from gofr_tpu.ops.attention import attention
+from gofr_tpu.ops.norms import rms_norm
+from gofr_tpu.ops.rope import apply_rope, rope_table
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    max_seq_len: int = 8192
+    rope_theta: float = 1e6
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    tie_embeddings: bool = False
+    aux_loss_coef: float = 0.01  # load-balance loss (Switch-style)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def mixtral_8x7b(cls, **kw: Any) -> "MoeConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw: Any) -> "MoeConfig":
+        defaults = dict(
+            vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=128, n_experts=4, top_k=2, max_seq_len=128, dtype=jnp.float32,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+def init_params(cfg: MoeConfig, key: jax.Array) -> dict:
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    L, D, F, E = cfg.n_layers, cfg.d_model, cfg.d_ff, cfg.n_experts
+    H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    def winit(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(cfg.dtype)
+
+    ks = jax.random.split(k_layers, 8)
+    return {
+        "embedding": winit(k_embed, (cfg.vocab_size, D), D),
+        "layers": {
+            "wq": winit(ks[0], (L, D, H * Dh), D),
+            "wk": winit(ks[1], (L, D, Hkv * Dh), D),
+            "wv": winit(ks[2], (L, D, Hkv * Dh), D),
+            "wo": winit(ks[3], (L, H * Dh, D), H * Dh),
+            "w_router": winit(ks[4], (L, D, E), D).astype(jnp.float32),
+            "w_gate": winit(ks[5], (L, E, D, F), D),
+            "w_up": winit(ks[6], (L, E, D, F), D),
+            "w_down": winit(ks[7], (L, E, F, D), F),
+            "attn_norm": jnp.ones((L, D), jnp.float32),
+            "mlp_norm": jnp.ones((L, D), jnp.float32),
+        },
+        "final_norm": jnp.ones((D,), jnp.float32),
+        "lm_head": winit(k_head, (D, cfg.vocab_size), D),
+    }
+
+
+def _moe_block(cfg: MoeConfig, lp: dict, h: jnp.ndarray, mesh: Any) -> jnp.ndarray:
+    """FFN block: [B, S, D] -> [B, S, D] through the MoE."""
+    B, S, D = h.shape
+    flat = h.reshape(B * S, D)
+    if mesh is not None:
+        out = moe_ops.moe_ffn_ep(
+            flat, lp["w_router"], lp["w_gate"], lp["w_up"], lp["w_down"], mesh,
+            top_k=cfg.top_k, capacity_factor=cfg.capacity_factor,
+        )
+    else:
+        out = moe_ops.moe_ffn_reference(
+            flat, lp["w_router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+            top_k=cfg.top_k,
+        )
+    return out.reshape(B, S, D)
+
+
+def _layer(cfg: MoeConfig, h: jnp.ndarray, lp: dict, sin, cos, positions, mesh):
+    B, S, D = h.shape
+    H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    x = rms_norm(h, lp["attn_norm"], cfg.norm_eps)
+    q = apply_rope((x @ lp["wq"]).reshape(B, S, H, Dh), positions, sin, cos)
+    k = apply_rope((x @ lp["wk"]).reshape(B, S, Hkv, Dh), positions, sin, cos)
+    v = (x @ lp["wv"]).reshape(B, S, Hkv, Dh)
+    attn = attention(q, k, v, causal=True)
+    h = h + attn.reshape(B, S, H * Dh) @ lp["wo"]
+    x = rms_norm(h, lp["mlp_norm"], cfg.norm_eps)
+    return h + _moe_block(cfg, lp, x, mesh)
+
+
+@partial(jax.jit, static_argnums=(0, 3))
+def _forward_jit(cfg: MoeConfig, params: dict, tokens: jnp.ndarray, mesh: Any):
+    B, S = tokens.shape
+    x = params["embedding"][tokens].astype(cfg.dtype)
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    sin, cos = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+
+    def body(h, lp):
+        return _layer(cfg, h, lp, sin, cos, positions, mesh), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return _logits(cfg, params, x)
+
+
+def forward(
+    cfg: MoeConfig, params: dict, tokens: jnp.ndarray, mesh: Any = None
+) -> jnp.ndarray:
+    """[B, S] -> logits [B, S, V]. With ``mesh`` (must carry an ``ep``
+    axis) expert FFNs run expert-parallel via all_to_all dispatch."""
+    return _forward_jit(cfg, params, tokens, mesh)
+
+
+def load_balance_loss(
+    cfg: MoeConfig, params: dict, tokens: jnp.ndarray
+) -> jnp.ndarray:
+    """Switch-transformer auxiliary loss: E · Σ_e f_e · P_e, averaged over
+    layers — pushes routing toward uniform expert utilization."""
+    B, S = tokens.shape
+    x = params["embedding"][tokens].astype(cfg.dtype)
+    flat = x.reshape(B * S, -1)
+
+    def per_layer(w_router):
+        probs = jax.nn.softmax((flat @ w_router).astype(jnp.float32), axis=-1)
+        top1 = jnp.argmax(probs, axis=-1)
+        f = jnp.mean(jax.nn.one_hot(top1, cfg.n_experts, dtype=jnp.float32), axis=0)
+        p = jnp.mean(probs, axis=0)
+        return cfg.n_experts * jnp.sum(f * p)
+
+    losses = jax.vmap(per_layer)(params["layers"]["w_router"])
+    return jnp.mean(losses)
+
+
+def moe_sharding_rules():
+    """Sharding rules for the MoE param tree: experts on ep, Megatron TP
+    inside each expert, attention as in the llama rules."""
+    from jax.sharding import PartitionSpec as P
+
+    from gofr_tpu.parallel.sharding import ShardingRules
+
+    return ShardingRules(
+        [
+            (r"embedding", P("tp", "fsdp")),
+            (r"lm_head", P("fsdp", "tp")),
+            (r"w[qkv]$", P(None, "fsdp", "tp")),
+            (r"wo$", P(None, "tp", "fsdp")),
+            (r"w_router", P()),
+            (r"w_gate|w_up", P(None, "ep", None, "tp")),
+            (r"w_down", P(None, "ep", "tp", None)),
+            (r"norm", P()),
+        ]
+    )

@@ -21,33 +21,69 @@ from gofr_tpu.models import llama
 from gofr_tpu.parallel.sharding import ShardingRules, llama_sharding_rules
 
 
-def cross_entropy_loss(cfg: llama.LlamaConfig, params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
-    """Next-token CE over [B, S] tokens (shift-by-one)."""
-    logits = llama.forward(cfg, params, tokens)  # [B, S, V] f32
+def next_token_nll(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Shift-by-one next-token negative log-likelihood over [B, S]."""
     targets = tokens[:, 1:]
-    logits = logits[:, :-1]
-    logp = jax.nn.log_softmax(logits, axis=-1)
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(nll)
 
 
-def make_train_step(cfg: llama.LlamaConfig, optimizer: Any = None):
-    """Returns (init_opt_state, train_step) where train_step is jittable:
-    (params, opt_state, tokens) -> (params, opt_state, loss)."""
+def cross_entropy_loss(cfg: llama.LlamaConfig, params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Next-token CE over [B, S] tokens (shift-by-one)."""
+    return next_token_nll(llama.forward(cfg, params, tokens), tokens)
+
+
+def _make_step(loss_fn: Any, optimizer: Any):
+    """Shared step builder: (init_opt_state, train_step) around a
+    ``loss_fn(params, tokens) -> scalar``."""
     optimizer = optimizer or optax.adamw(3e-4)
 
     def init_opt_state(params: dict) -> Any:
         return optimizer.init(params)
 
     def train_step(params: dict, opt_state: Any, tokens: jnp.ndarray):
-        loss, grads = jax.value_and_grad(
-            lambda p: cross_entropy_loss(cfg, p, tokens)
-        )(params)
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
     return init_opt_state, train_step
+
+
+def make_train_step(cfg: llama.LlamaConfig, optimizer: Any = None):
+    """Returns (init_opt_state, train_step) where train_step is jittable:
+    (params, opt_state, tokens) -> (params, opt_state, loss)."""
+    return _make_step(lambda p, t: cross_entropy_loss(cfg, p, t), optimizer)
+
+
+def make_pp_train_step(
+    cfg: llama.LlamaConfig, mesh: Any, optimizer: Any = None,
+    microbatches: int | None = None,
+):
+    """Pipeline-parallel variant: forward through parallel/pipeline.py's
+    GPipe schedule (layer stack stage-sharded on pp), loss/grads/update as
+    usual — jax.grad differentiates through the ppermute ring."""
+    from gofr_tpu.parallel.pipeline import pp_forward
+
+    def loss_fn(params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
+        logits = pp_forward(cfg, params, tokens, mesh, microbatches=microbatches)
+        return next_token_nll(logits, tokens)
+
+    return _make_step(loss_fn, optimizer)
+
+
+def make_moe_train_step(cfg: Any, mesh: Any, optimizer: Any = None):
+    """MoE training step: CE + Switch-style load-balance aux loss, expert
+    FFNs dispatched expert-parallel over the mesh's ep axis."""
+    from gofr_tpu.models import moe
+
+    def loss_fn(params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
+        logits = moe.forward(cfg, params, tokens, mesh)
+        aux = moe.load_balance_loss(cfg, params, tokens)
+        return next_token_nll(logits, tokens) + cfg.aux_loss_coef * aux
+
+    return _make_step(loss_fn, optimizer)
 
 
 def sharded_train_step(
@@ -58,11 +94,17 @@ def sharded_train_step(
 ):
     """jit the train step with explicit in/out shardings over ``mesh``:
     params + opt state by the weight rules, tokens batch-sharded on
-    (dp, fsdp) and sequence on sp."""
+    (dp, fsdp) and sequence on sp. When the mesh has a non-trivial pp axis
+    the forward runs the GPipe pipeline (and the rules must be
+    llama_sharding_rules(pp=True))."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    rules = rules or llama_sharding_rules()
-    init_opt_state, train_step = make_train_step(cfg, optimizer)
+    use_pp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("pp", 1) > 1
+    rules = rules or llama_sharding_rules(pp=use_pp)
+    if use_pp:
+        init_opt_state, train_step = make_pp_train_step(cfg, mesh, optimizer)
+    else:
+        init_opt_state, train_step = make_train_step(cfg, optimizer)
 
     def shard_tree(tree: Any) -> Any:
         return rules.tree_shardings(mesh, tree)

@@ -1,0 +1,182 @@
+"""Mixture-of-Experts ops: top-k routing and expert-parallel dispatch.
+
+No counterpart exists in the reference (SURVEY §2.9 lists EP as absent);
+design follows the GShard/Mixtral lineage, TPU-first:
+
+- routing and the dispatch/combine one-hots are dense einsums (MXU work,
+  static shapes — no dynamic gather/scatter that would defeat XLA),
+- expert parallelism is a ``shard_map`` over the ``ep`` mesh axis: tokens
+  are grouped per device, ``all_to_all`` carries each group's dispatched
+  tokens to the devices owning their experts and back — the two transposes
+  ride ICI, exactly the pattern the scaling book prescribes for MoE.
+
+Capacity model: each expert accepts at most C tokens per group
+(C = ceil(top_k · tokens/E) · capacity_factor); overflow tokens fall
+through with a zero expert contribution (standard GShard drop policy) and
+the combine weights are renormalized over the surviving assignments.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def router_topk(
+    x: jnp.ndarray,  # [T, D]
+    w_router: jnp.ndarray,  # [D, E]
+    k: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-k gating: returns (expert_idx [T, k], gate_weights [T, k]);
+    weights are softmax probs renormalized over the selected k."""
+    logits = (x @ w_router).astype(jnp.float32)  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_i = jax.lax.top_k(probs, k)
+    top_p = top_p / (jnp.sum(top_p, axis=-1, keepdims=True) + 1e-9)
+    return top_i, top_p
+
+
+def _dispatch_combine(
+    top_i: jnp.ndarray,  # [T, k]
+    top_p: jnp.ndarray,  # [T, k]
+    n_experts: int,
+    capacity: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Build GShard dispatch [T, E, C] (one-hot) and combine [T, E, C]
+    (gate-weighted) tensors. Position of a token within its expert's buffer
+    is its routing order (cumsum over tokens)."""
+    T, k = top_i.shape
+    onehot = jax.nn.one_hot(top_i, n_experts, dtype=jnp.float32)  # [T, k, E]
+    # position within each expert buffer, counted over (token, k) in order
+    flat = onehot.reshape(T * k, n_experts)
+    pos = jnp.cumsum(flat, axis=0) - flat  # [T*k, E] position if routed
+    pos = pos.reshape(T, k, n_experts)
+    in_cap = (pos < capacity).astype(jnp.float32)
+    keep = onehot * in_cap  # [T, k, E]
+    pos_idx = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [T, k]
+    cap_onehot = jax.nn.one_hot(pos_idx, capacity, dtype=jnp.float32)  # [T, k, C]
+    dispatch = jnp.einsum("tke,tkc->tec", keep, cap_onehot)
+    combine = jnp.einsum("tke,tkc,tk->tec", keep, cap_onehot, top_p)
+    # renormalize over surviving assignments so dropped tokens don't skew
+    surv = jnp.einsum("tec->t", combine)
+    combine = combine / (surv[:, None, None] + 1e-9)
+    mask_any = (jnp.einsum("tec->t", dispatch) > 0)[:, None, None]
+    combine = jnp.where(mask_any, combine, 0.0)
+    return dispatch, combine
+
+
+def expert_ffn(
+    h: jnp.ndarray,  # [E, N, D] tokens grouped per expert
+    w_gate: jnp.ndarray,  # [E, D, F]
+    w_up: jnp.ndarray,  # [E, D, F]
+    w_down: jnp.ndarray,  # [E, F, D]
+) -> jnp.ndarray:
+    """SwiGLU FFN per expert — batched einsum over the expert axis (MXU)."""
+    gate = jnp.einsum("end,edf->enf", h, w_gate)
+    up = jnp.einsum("end,edf->enf", h, w_up)
+    act = jax.nn.silu(gate.astype(jnp.float32)).astype(h.dtype) * up
+    return jnp.einsum("enf,efd->end", act, w_down)
+
+
+def capacity_for(tokens: int, n_experts: int, top_k: int, factor: float) -> int:
+    return max(1, math.ceil(top_k * tokens / n_experts * factor))
+
+
+def moe_ffn_reference(
+    x: jnp.ndarray,  # [T, D]
+    w_router: jnp.ndarray,
+    w_gate: jnp.ndarray,  # [E, D, F]
+    w_up: jnp.ndarray,
+    w_down: jnp.ndarray,
+    *,
+    top_k: int = 2,
+) -> jnp.ndarray:
+    """Dense reference (no capacity drops, no EP): every expert computes
+    every token, combined by the top-k gates. O(E·T·D·F) — test/debug only."""
+    top_i, top_p = router_topk(x, w_router, top_k)
+    all_out = expert_ffn(
+        jnp.broadcast_to(x, (w_gate.shape[0], *x.shape)), w_gate, w_up, w_down
+    )  # [E, T, D]
+    onehot = jax.nn.one_hot(top_i, w_gate.shape[0], dtype=jnp.float32)  # [T,k,E]
+    weights = jnp.einsum("tke,tk->te", onehot, top_p)  # [T, E]
+    return jnp.einsum("etd,te->td", all_out.astype(jnp.float32), weights).astype(x.dtype)
+
+
+def moe_ffn_ep_sharded(
+    x: jnp.ndarray,  # [t, D] — this device's token group
+    w_router: jnp.ndarray,  # [D, E] replicated
+    w_gate: jnp.ndarray,  # [E_loc, D, F] — local expert shard
+    w_up: jnp.ndarray,
+    w_down: jnp.ndarray,
+    *,
+    axis_name: str,
+    axis_size: int,
+    n_experts: int,
+    top_k: int,
+    capacity: int,
+) -> jnp.ndarray:
+    """Per-device body: route locally, all_to_all tokens to expert owners,
+    run local experts, all_to_all back, combine."""
+    n = axis_size
+    e_loc = n_experts // n
+    top_i, top_p = router_topk(x, w_router, top_k)
+    dispatch, combine = _dispatch_combine(top_i, top_p, n_experts, capacity)
+
+    # [t, E, C] x [t, D] -> [E, C, D], grouped by owning device
+    sent = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+    sent = sent.reshape(n, e_loc, capacity, -1)
+    # exchange: device g receives, from every peer p, the block destined for
+    # g's experts; afterwards axis 0 indexes the source group
+    recv = jax.lax.all_to_all(sent, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    h = recv.transpose(1, 0, 2, 3).reshape(e_loc, n * capacity, -1)  # [E_loc, N, D]
+    out = expert_ffn(h, w_gate, w_up, w_down)  # [E_loc, N, D]
+    out = out.reshape(e_loc, n, capacity, -1).transpose(1, 0, 2, 3)  # [n, E_loc, C, D]
+    back = jax.lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    back = back.reshape(n_experts, capacity, -1)  # [E, C, D] for this group
+    return jnp.einsum("ecd,tec->td", back.astype(jnp.float32), combine).astype(x.dtype)
+
+
+def moe_ffn_ep(
+    x: jnp.ndarray,  # [T, D] global tokens
+    w_router: jnp.ndarray,
+    w_gate: jnp.ndarray,  # [E, D, F]
+    w_up: jnp.ndarray,
+    w_down: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    axis: str = "ep",
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    capacity: int | None = None,
+) -> jnp.ndarray:
+    """Expert-parallel MoE FFN: tokens grouped on ``axis``, experts sharded
+    on ``axis``, two all_to_all transposes over ICI."""
+    n = mesh.shape[axis]
+    T = x.shape[0]
+    E = w_gate.shape[0]
+    if T % n != 0:
+        raise ValueError(f"tokens {T} not divisible by {axis}={n}")
+    if E % n != 0:
+        raise ValueError(f"experts {E} not divisible by {axis}={n}")
+    cap = capacity or capacity_for(T // n, E, top_k, capacity_factor)
+    fn = functools.partial(
+        moe_ffn_ep_sharded,
+        axis_name=axis,
+        axis_size=n,
+        n_experts=E,
+        top_k=top_k,
+        capacity=cap,
+    )
+    espec = P(axis)
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P(axis), P(), espec, espec, espec),
+        out_specs=P(axis),
+        axis_names={axis},
+    )(x, w_router, w_gate, w_up, w_down)

@@ -98,7 +98,12 @@ def ring_attention_sharded(
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         return k_blk, v_blk, acc, m, l
 
-    _, _, acc, m, l = jax.lax.fori_loop(0, n, body, (k, v, acc, m, l))
+    # n-1 accumulate+rotate steps, then a final accumulate with no rotation
+    # (the last rotated blocks would be discarded — one ICI hop saved/layer)
+    k_blk, v_blk, acc, m, l = jax.lax.fori_loop(0, n - 1, body, (k, v, acc, m, l))
+    acc, m, l = _block_accumulate(
+        q, k_blk, v_blk, acc, m, l, q_start, ((idx - (n - 1)) % n) * S_loc, scale
+    )
     out = acc / (l.transpose(0, 2, 1)[..., None] + 1e-30)
     return out.astype(q.dtype)
 
@@ -140,13 +145,19 @@ def ulysses_attention_sharded(
     when Hkv doesn't divide)."""
     from gofr_tpu.ops.attention import attention
 
+    import math as _math
+
     H = q.shape[2]
     n = axis_size
     if H % n != 0:
         raise ValueError(f"heads {H} not divisible by {axis_name}={n}")
     if k.shape[2] % n != 0:
-        k = gqa_repeat(k, H)
-        v = gqa_repeat(v, H)
+        # repeat KV only to lcm(Hkv, n) — enough for an even head split; the
+        # inner attention's gqa_repeat finishes the broadcast locally, so the
+        # all_to_all moves the minimum KV volume
+        target = _math.lcm(k.shape[2], n)
+        k = gqa_repeat(k, target)
+        v = gqa_repeat(v, target)
 
     def reshard_in(x):  # [B,S_loc,h,D] -> [B,S,h/n,D]
         return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)

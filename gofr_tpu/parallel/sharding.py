@@ -63,7 +63,7 @@ def _path_key(k: Any) -> str:
     return str(k)
 
 
-def llama_sharding_rules() -> ShardingRules:
+def llama_sharding_rules(pp: bool = False) -> ShardingRules:
     """TP/FSDP rules for the Llama-family params produced by
     gofr_tpu.models.llama (stacked-layer pytree). Axis conventions:
 
@@ -76,18 +76,24 @@ def llama_sharding_rules() -> ShardingRules:
     - embedding [vocab, d_model] + lm_head [d_model, vocab]: shard vocab on
       tp (logits all-gather), d_model on fsdp
     - norms: replicated
+
+    With ``pp=True`` the stacked layer axis [L, ...] is sharded on the
+    ``pp`` mesh axis (stage s owns layers [s*L/n, (s+1)*L/n)), matching the
+    pipeline_forward stage split in parallel/pipeline.py.
     """
-    return ShardingRules(
-        [
-            (r"embedding", P("tp", "fsdp")),
-            (r"lm_head", P("fsdp", "tp")),
-            (r"w[qkv]$", P(None, "fsdp", "tp")),
-            (r"wo$", P(None, "tp", "fsdp")),
-            (r"w_gate|w_up", P(None, "fsdp", "tp")),
-            (r"w_down", P(None, "tp", "fsdp")),
-            (r"norm|scale|bias", P()),
-        ]
-    )
+    lead = "pp" if pp else None
+    rules = [
+        (r"embedding", P("tp", "fsdp")),
+        (r"lm_head", P("fsdp", "tp")),
+        (r"w[qkv]$", P(lead, "fsdp", "tp")),
+        (r"wo$", P(lead, "tp", "fsdp")),
+        (r"w_gate|w_up", P(lead, "fsdp", "tp")),
+        (r"w_down", P(lead, "tp", "fsdp")),
+    ]
+    if pp:
+        rules.append((r"layers/.*(norm)", P("pp")))
+    rules.append((r"norm|scale|bias", P()))
+    return ShardingRules(rules)
 
 
 def bert_sharding_rules() -> ShardingRules:

@@ -63,6 +63,9 @@ async def _read_frame(reader: asyncio.StreamReader) -> tuple[bool, int, bytes]:
         length = struct.unpack(">H", await reader.readexactly(2))[0]
     elif length == 127:
         length = struct.unpack(">Q", await reader.readexactly(8))[0]
+    if opcode >= 0x8 and (length > 125 or not fin):
+        # RFC6455 §5.5: control frames carry ≤125 bytes and must not fragment
+        raise ConnectionError("websocket control frame too large or fragmented")
     if length > (64 << 20):
         raise ConnectionError("websocket frame too large")
     key = await reader.readexactly(4) if masked else None
@@ -88,8 +91,12 @@ async def read_message(
     one is queued and returned as its own message after reassembly, so the
     caller can still answer it."""
     pending = getattr(reader, "_gofr_pending_pings", None)
-    if pending:
-        return OP_PING, pending.pop(0)
+    while pending:
+        payload = pending.pop(0)
+        if pong is not None:
+            await pong(payload)  # caller can answer now: do it in-place
+        else:
+            return OP_PING, payload
     parts: list[bytes] = []
     total = 0
     first_opcode: int | None = None
@@ -105,7 +112,9 @@ async def read_message(
             if first_opcode is None:
                 return opcode, payload
             if opcode == OP_PING:
-                pending_pings.append(payload)
+                # RFC6455 only requires answering the most recent unanswered
+                # PING — keep a tiny bounded queue, not one entry per frame
+                pending_pings = pending_pings[-7:] + [payload]
             continue  # mid-fragment PONG: drop it
         total += len(payload)
         if total > MAX_MESSAGE_BYTES:

@@ -47,6 +47,15 @@ def test_ulysses_matches_dense(sp_mesh):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_ulysses_gqa_partial_repeat(sp_mesh):
+    """Hkv=2, n=4, H=8: KV repeats only to lcm=4 before the all_to_all;
+    head-group mapping must survive the contiguous split."""
+    q, k, v = _qkv(jax.random.PRNGKey(5), H=8, Hkv=2)
+    ref = attention(q, k, v, causal=True)
+    out = ulysses_attention(q, k, v, sp_mesh, axis="sp")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
 def test_ring_rejects_indivisible_seq(sp_mesh):
     q, k, v = _qkv(jax.random.PRNGKey(3), S=30)
     with pytest.raises(ValueError):
