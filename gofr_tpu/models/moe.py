@@ -90,21 +90,22 @@ def init_params(cfg: MoeConfig, key: jax.Array) -> dict:
     }
 
 
-def _moe_block(cfg: MoeConfig, lp: dict, h: jnp.ndarray, mesh: Any) -> jnp.ndarray:
-    """FFN block: [B, S, D] -> [B, S, D] through the MoE."""
+def _moe_block(cfg: MoeConfig, lp: dict, h: jnp.ndarray, mesh: Any):
+    """FFN block: [B, S, D] -> ([B, S, D], (f_e, P_e)) through the MoE."""
     B, S, D = h.shape
     flat = h.reshape(B * S, D)
     if mesh is not None:
-        out = moe_ops.moe_ffn_ep(
+        out, f, p = moe_ops.moe_ffn_ep(
             flat, lp["w_router"], lp["w_gate"], lp["w_up"], lp["w_down"], mesh,
             top_k=cfg.top_k, capacity_factor=cfg.capacity_factor,
+            return_stats=True,
         )
     else:
-        out = moe_ops.moe_ffn_reference(
+        out, f, p = moe_ops.moe_ffn_reference(
             flat, lp["w_router"], lp["w_gate"], lp["w_up"], lp["w_down"],
-            top_k=cfg.top_k,
+            top_k=cfg.top_k, return_stats=True,
         )
-    return out.reshape(B, S, D)
+    return out.reshape(B, S, D), (f, p)
 
 
 def _layer(cfg: MoeConfig, h: jnp.ndarray, lp: dict, sin, cos, positions, mesh):
@@ -117,7 +118,8 @@ def _layer(cfg: MoeConfig, h: jnp.ndarray, lp: dict, sin, cos, positions, mesh):
     attn = attention(q, k, v, causal=True)
     h = h + attn.reshape(B, S, H * Dh) @ lp["wo"]
     x = rms_norm(h, lp["mlp_norm"], cfg.norm_eps)
-    return h + _moe_block(cfg, lp, x, mesh)
+    out, stats = _moe_block(cfg, lp, x, mesh)
+    return h + out, stats
 
 
 @partial(jax.jit, static_argnums=(0, 3))
@@ -128,38 +130,42 @@ def _forward_jit(cfg: MoeConfig, params: dict, tokens: jnp.ndarray, mesh: Any):
     sin, cos = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
 
     def body(h, lp):
-        return _layer(cfg, h, lp, sin, cos, positions, mesh), None
+        h, stats = _layer(cfg, h, lp, sin, cos, positions, mesh)
+        return h, stats
 
-    x, _ = jax.lax.scan(body, x, params["layers"])
-    return _logits(cfg, params, x)
+    x, (f, p) = jax.lax.scan(body, x, params["layers"])
+    return _logits(cfg, params, x), (f, p)  # f, p: [L, E]
 
 
 def forward(
-    cfg: MoeConfig, params: dict, tokens: jnp.ndarray, mesh: Any = None
-) -> jnp.ndarray:
+    cfg: MoeConfig, params: dict, tokens: jnp.ndarray, mesh: Any = None,
+    return_aux: bool = False,
+):
     """[B, S] -> logits [B, S, V]. With ``mesh`` (must carry an ``ep``
-    axis) expert FFNs run expert-parallel via all_to_all dispatch."""
-    return _forward_jit(cfg, params, tokens, mesh)
+    axis) expert FFNs run expert-parallel via all_to_all dispatch. With
+    ``return_aux`` also returns per-layer router stats (f, p) [L, E] from
+    the ACTUAL per-layer routing (the inputs each router really saw)."""
+    logits, stats = _forward_jit(cfg, params, tokens, mesh)
+    return (logits, stats) if return_aux else logits
+
+
+def load_balance_loss_from_stats(
+    cfg: MoeConfig, f: jnp.ndarray, p: jnp.ndarray
+) -> jnp.ndarray:
+    """Switch-transformer auxiliary loss E · Σ_e f_e · P_e averaged over
+    layers, from the per-layer routing stats the forward pass emits."""
+    return jnp.mean(cfg.n_experts * jnp.sum(f * p, axis=-1))
 
 
 def load_balance_loss(
-    cfg: MoeConfig, params: dict, tokens: jnp.ndarray
+    cfg: MoeConfig, params: dict, tokens: jnp.ndarray, mesh: Any = None
 ) -> jnp.ndarray:
-    """Switch-transformer auxiliary loss: E · Σ_e f_e · P_e, averaged over
-    layers — pushes routing toward uniform expert utilization."""
-    B, S = tokens.shape
-    x = params["embedding"][tokens].astype(cfg.dtype)
-    flat = x.reshape(B * S, -1)
-
-    def per_layer(w_router):
-        probs = jax.nn.softmax((flat @ w_router).astype(jnp.float32), axis=-1)
-        top1 = jnp.argmax(probs, axis=-1)
-        f = jnp.mean(jax.nn.one_hot(top1, cfg.n_experts, dtype=jnp.float32), axis=0)
-        p = jnp.mean(probs, axis=0)
-        return cfg.n_experts * jnp.sum(f * p)
-
-    losses = jax.vmap(per_layer)(params["layers"]["w_router"])
-    return jnp.mean(losses)
+    """Aux loss computed by running the real forward (per-layer hidden
+    states feed each router — not the embeddings). Prefer
+    ``forward(..., return_aux=True)`` + ``load_balance_loss_from_stats``
+    when you also need the logits, to avoid a second pass."""
+    _, (f, p) = forward(cfg, params, tokens, mesh, return_aux=True)
+    return load_balance_loss_from_stats(cfg, f, p)
 
 
 def moe_sharding_rules():

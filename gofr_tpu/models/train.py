@@ -79,8 +79,8 @@ def make_moe_train_step(cfg: Any, mesh: Any, optimizer: Any = None):
     from gofr_tpu.models import moe
 
     def loss_fn(params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
-        logits = moe.forward(cfg, params, tokens, mesh)
-        aux = moe.load_balance_loss(cfg, params, tokens)
+        logits, (f, p) = moe.forward(cfg, params, tokens, mesh, return_aux=True)
+        aux = moe.load_balance_loss_from_stats(cfg, f, p)
         return next_token_nll(logits, tokens) + cfg.aux_loss_coef * aux
 
     return _make_step(loss_fn, optimizer)

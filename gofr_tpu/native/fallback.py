@@ -1,0 +1,207 @@
+"""Pure-Python fallbacks for the native runtime (same semantics as
+``native/runtime/gofr_runtime.cc``), used when no C++ toolchain is
+available. The test suite runs both implementations against the same
+scenarios so the contract stays pinned."""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+
+
+class OutOfBlocks(RuntimeError):
+    pass
+
+
+class PyBlockAllocator:
+    """Ref-counted paged KV block allocator with copy-on-write forks."""
+
+    def __init__(self, num_blocks: int, block_size: int) -> None:
+        if num_blocks <= 0 or block_size <= 0:
+            raise ValueError("num_blocks and block_size must be positive")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._refcount = [0] * num_blocks
+        self._free = list(range(num_blocks - 1, -1, -1))
+        self._seqs: dict[int, tuple[list[int], int]] = {}  # id -> (blocks, length)
+        self._alloc_failures = 0
+        self._mu = threading.Lock()
+
+    def _needed(self, tokens: int) -> int:
+        return (tokens + self.block_size - 1) // self.block_size
+
+    def _take(self) -> int:
+        b = self._free.pop()
+        self._refcount[b] = 1
+        return b
+
+    def _drop(self, b: int) -> None:
+        self._refcount[b] -= 1
+        if self._refcount[b] == 0:
+            self._free.append(b)
+
+    def alloc(self, seq_id: int, tokens: int) -> None:
+        with self._mu:
+            if seq_id in self._seqs:
+                raise KeyError(f"sequence {seq_id} exists")
+            need = self._needed(tokens)
+            if len(self._free) < need:
+                self._alloc_failures += 1
+                raise OutOfBlocks(f"need {need} blocks, {len(self._free)} free")
+            self._seqs[seq_id] = ([self._take() for _ in range(need)], tokens)
+
+    def extend(self, seq_id: int, new_length: int) -> tuple[int, int]:
+        """Grow to new_length; returns (cow_src, cow_dst) block ids or (-1,-1)."""
+        with self._mu:
+            blocks, length = self._seqs[seq_id]
+            if new_length < length:
+                raise ValueError("cannot shrink")
+            cow = (-1, -1)
+            if (blocks and length % self.block_size != 0
+                    and self._refcount[blocks[-1]] > 1 and new_length > length):
+                if not self._free:
+                    self._alloc_failures += 1
+                    raise OutOfBlocks("no block for copy-on-write")
+                fresh = self._take()
+                self._drop(blocks[-1])
+                cow = (blocks[-1], fresh)
+                blocks[-1] = fresh
+            need = self._needed(new_length)
+            if need > len(blocks):
+                if len(self._free) < need - len(blocks):
+                    self._alloc_failures += 1
+                    raise OutOfBlocks("extend")
+                blocks.extend(self._take() for _ in range(need - len(blocks)))
+            self._seqs[seq_id] = (blocks, new_length)
+            return cow
+
+    def fork(self, src_id: int, dst_id: int, shared_tokens: int) -> int:
+        with self._mu:
+            blocks, length = self._seqs[src_id]
+            if dst_id in self._seqs:
+                raise KeyError(f"sequence {dst_id} exists")
+            full = min(min(shared_tokens, length) // self.block_size, len(blocks))
+            shared = blocks[:full]
+            for b in shared:
+                self._refcount[b] += 1
+            self._seqs[dst_id] = (list(shared), full * self.block_size)
+            return full * self.block_size
+
+    def free(self, seq_id: int) -> None:
+        with self._mu:
+            blocks, _ = self._seqs.pop(seq_id)
+            for b in blocks:
+                self._drop(b)
+
+    def block_table(self, seq_id: int) -> list[int]:
+        with self._mu:
+            return list(self._seqs[seq_id][0])
+
+    def seq_length(self, seq_id: int) -> int:
+        with self._mu:
+            return self._seqs[seq_id][1]
+
+    def stats(self) -> dict[str, int]:
+        with self._mu:
+            return {
+                "free_blocks": len(self._free),
+                "total_blocks": self.num_blocks,
+                "sequences": len(self._seqs),
+                "alloc_failures": self._alloc_failures,
+            }
+
+    def close(self) -> None:
+        pass
+
+
+class QueueFull(RuntimeError):
+    pass
+
+
+class PyScheduler:
+    """Priority + FIFO admission scheduler with a prefill token budget."""
+
+    def __init__(self, max_slots: int, max_queue: int, prefill_token_budget: int) -> None:
+        if max_slots <= 0 or max_queue <= 0 or prefill_token_budget <= 0:
+            raise ValueError("all scheduler sizes must be positive")
+        self.max_slots = max_slots
+        self.max_queue = max_queue
+        self.prefill_token_budget = prefill_token_budget
+        self._slots: list[int | None] = [None] * max_slots
+        self._queues: OrderedDict[int, deque] = OrderedDict()
+        self._meta: dict[int, dict] = {}
+        self._total_admitted = 0
+        self._total_canceled = 0
+        self._mu = threading.Lock()
+
+    def submit(self, req_id: int, prompt_len: int, max_new_tokens: int,
+               priority: int = 0) -> None:
+        with self._mu:
+            if req_id in self._meta:
+                raise KeyError(f"request {req_id} exists")
+            if sum(len(q) for q in self._queues.values()) >= self.max_queue:
+                raise QueueFull()
+            meta = {"prompt_len": prompt_len, "max_new": max_new_tokens,
+                    "priority": priority, "canceled": False}
+            self._meta[req_id] = meta
+            self._queues.setdefault(priority, deque()).append(req_id)
+            # keep priorities sorted (lower first) like the C++ std::map
+            self._queues = OrderedDict(sorted(self._queues.items()))
+
+    def cancel(self, req_id: int) -> None:
+        with self._mu:
+            self._meta[req_id]["canceled"] = True
+            self._total_canceled += 1
+
+    def admit(self, cap: int) -> tuple[list[tuple[int, int]], list[int]]:
+        """Returns ([(req_id, slot)...], [canceled_req_ids...])."""
+        with self._mu:
+            admitted: list[tuple[int, int]] = []
+            canceled: list[int] = []
+            budget = self.prefill_token_budget
+            for priority in list(self._queues):
+                q = self._queues[priority]
+                while q and len(admitted) < cap:
+                    rid = q[0]
+                    meta = self._meta[rid]
+                    if meta["canceled"]:
+                        canceled.append(rid)
+                        del self._meta[rid]
+                        q.popleft()
+                        continue
+                    if admitted and meta["prompt_len"] > budget:
+                        break  # next priority may hold shorter prompts
+                    try:
+                        slot = self._slots.index(None)
+                    except ValueError:
+                        return admitted, canceled
+                    self._slots[slot] = rid
+                    admitted.append((rid, slot))
+                    budget -= meta["prompt_len"]
+                    self._total_admitted += 1
+                    del self._meta[rid]
+                    q.popleft()
+                    if budget <= 0:
+                        return admitted, canceled
+                if len(admitted) >= cap:
+                    break
+            return admitted, canceled
+
+    def release(self, slot: int) -> None:
+        with self._mu:
+            if self._slots[slot] is None:
+                raise KeyError(f"slot {slot} already free")
+            self._slots[slot] = None
+
+    def stats(self) -> dict[str, int]:
+        with self._mu:
+            return {
+                "queue_depth": sum(len(q) for q in self._queues.values()),
+                "busy_slots": sum(1 for s in self._slots if s is not None),
+                "max_slots": self.max_slots,
+                "total_admitted": self._total_admitted,
+                "total_canceled": self._total_canceled,
+            }
+
+    def close(self) -> None:
+        pass

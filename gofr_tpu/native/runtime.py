@@ -1,0 +1,227 @@
+"""High-level wrappers over the native runtime (block allocator +
+scheduler), dispatching to the C++ library when buildable and the
+pure-Python fallback otherwise. The interface is identical either way;
+``BlockAllocator(...).backend`` reports which one is live."""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+
+from gofr_tpu.native import (
+    GOFR_E_EXISTS,
+    GOFR_E_NOMEM,
+    GOFR_E_NOTFOUND,
+    GOFR_E_QUEUEFULL,
+    NativeError,
+    load_runtime,
+)
+from gofr_tpu.native.fallback import (
+    OutOfBlocks,
+    PyBlockAllocator,
+    PyScheduler,
+    QueueFull,
+)
+
+__all__ = ["BlockAllocator", "Scheduler", "OutOfBlocks", "QueueFull"]
+
+
+def _check(code: int, what: str) -> int:
+    if code >= 0:
+        return code
+    if code == GOFR_E_NOMEM:
+        raise OutOfBlocks(what)
+    if code == GOFR_E_QUEUEFULL:
+        raise QueueFull(what)
+    if code in (GOFR_E_NOTFOUND, GOFR_E_EXISTS):
+        raise KeyError(f"{what}: {code}")
+    raise NativeError(code, what)
+
+
+class BlockAllocator:
+    """Paged KV block allocator. See native/runtime/gofr_runtime.cc."""
+
+    def __init__(self, num_blocks: int, block_size: int, *, force_python: bool = False):
+        self._lib = None if force_python else load_runtime()
+        if self._lib is None:
+            self._py = PyBlockAllocator(num_blocks, block_size)
+            self.backend = "python"
+        else:
+            h = self._lib.gofr_ba_create(num_blocks, block_size)
+            _check(int(h), "ba_create")
+            self._h = h
+            self.backend = "native"
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._closed = False
+        self._mu = threading.Lock()
+
+    def alloc(self, seq_id: int, tokens: int) -> None:
+        if self._lib is None:
+            return self._py.alloc(seq_id, tokens)
+        _check(self._lib.gofr_ba_alloc(self._h, seq_id, tokens), f"alloc seq {seq_id}")
+
+    def extend(self, seq_id: int, new_length: int) -> tuple[int, int]:
+        if self._lib is None:
+            return self._py.extend(seq_id, new_length)
+        src = ctypes.c_int32(-1)
+        dst = ctypes.c_int32(-1)
+        _check(
+            self._lib.gofr_ba_extend(
+                self._h, seq_id, new_length, ctypes.byref(src), ctypes.byref(dst)
+            ),
+            f"extend seq {seq_id}",
+        )
+        return int(src.value), int(dst.value)
+
+    def fork(self, src_id: int, dst_id: int, shared_tokens: int) -> int:
+        if self._lib is None:
+            return self._py.fork(src_id, dst_id, shared_tokens)
+        return _check(
+            int(self._lib.gofr_ba_fork(self._h, src_id, dst_id, shared_tokens)),
+            f"fork {src_id}->{dst_id}",
+        )
+
+    def free(self, seq_id: int) -> None:
+        if self._lib is None:
+            return self._py.free(seq_id)
+        _check(self._lib.gofr_ba_free(self._h, seq_id), f"free seq {seq_id}")
+
+    def block_table(self, seq_id: int) -> list[int]:
+        if self._lib is None:
+            return self._py.block_table(seq_id)
+        cap = self.num_blocks
+        buf = (ctypes.c_int32 * cap)()
+        n = _check(
+            self._lib.gofr_ba_block_table(self._h, seq_id, buf, cap),
+            f"block_table seq {seq_id}",
+        )
+        return list(buf[:n])
+
+    def seq_length(self, seq_id: int) -> int:
+        if self._lib is None:
+            return self._py.seq_length(seq_id)
+        return _check(int(self._lib.gofr_ba_seq_length(self._h, seq_id)), "seq_length")
+
+    def stats(self) -> dict[str, int]:
+        if self._lib is None:
+            return self._py.stats()
+        out = (ctypes.c_int64 * 4)()
+        _check(self._lib.gofr_ba_stats(self._h, out), "ba_stats")
+        return {
+            "free_blocks": out[0],
+            "total_blocks": out[1],
+            "sequences": out[2],
+            "alloc_failures": out[3],
+        }
+
+    def close(self) -> None:
+        with self._mu:
+            if self._closed:
+                return
+            self._closed = True
+        if self._lib is not None:
+            self._lib.gofr_ba_destroy(self._h)
+
+    def __del__(self) -> None:  # best-effort; explicit close preferred
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class Scheduler:
+    """Continuous-batching admission scheduler (priority + FIFO + budget)."""
+
+    def __init__(self, max_slots: int, max_queue: int, prefill_token_budget: int,
+                 *, force_python: bool = False):
+        self._lib = None if force_python else load_runtime()
+        if self._lib is None:
+            self._py = PyScheduler(max_slots, max_queue, prefill_token_budget)
+            self.backend = "python"
+        else:
+            h = self._lib.gofr_sched_create(max_slots, max_queue, prefill_token_budget)
+            _check(int(h), "sched_create")
+            self._h = h
+            self.backend = "native"
+        self.max_slots = max_slots
+        self._closed = False
+        self._mu = threading.Lock()
+
+    def _ensure_open(self) -> None:
+        if self._closed:
+            raise RuntimeError("scheduler closed")
+
+    def submit(self, req_id: int, prompt_len: int, max_new_tokens: int,
+               priority: int = 0) -> None:
+        self._ensure_open()
+        if self._lib is None:
+            return self._py.submit(req_id, prompt_len, max_new_tokens, priority)
+        _check(
+            self._lib.gofr_sched_submit(self._h, req_id, prompt_len, max_new_tokens, priority),
+            f"submit req {req_id}",
+        )
+
+    def cancel(self, req_id: int) -> None:
+        if self._lib is None:
+            return self._py.cancel(req_id)
+        _check(self._lib.gofr_sched_cancel(self._h, req_id), f"cancel req {req_id}")
+
+    def admit(self, cap: int) -> tuple[list[tuple[int, int]], list[int]]:
+        if self._lib is None:
+            return self._py.admit(cap)
+        ids = (ctypes.c_int64 * cap)()
+        slots = (ctypes.c_int32 * cap)()
+        canceled = (ctypes.c_int64 * 64)()
+        n_canceled = ctypes.c_int32(0)
+        n = _check(
+            self._lib.gofr_sched_admit(
+                self._h, ids, slots, cap, canceled, 64, ctypes.byref(n_canceled)
+            ),
+            "admit",
+        )
+        return (
+            [(int(ids[i]), int(slots[i])) for i in range(n)],
+            [int(canceled[i]) for i in range(n_canceled.value)],
+        )
+
+    def release(self, slot: int) -> None:
+        if self._lib is None:
+            return self._py.release(slot)
+        _check(self._lib.gofr_sched_release(self._h, slot), f"release slot {slot}")
+
+    def stats(self) -> dict[str, int]:
+        if self._closed:  # post-shutdown health checks must not hit a dead handle
+            return dict(self._last_stats)
+        if self._lib is None:
+            return self._py.stats()
+        out = (ctypes.c_int64 * 5)()
+        _check(self._lib.gofr_sched_stats(self._h, out), "sched_stats")
+        return {
+            "queue_depth": out[0],
+            "busy_slots": out[1],
+            "max_slots": out[2],
+            "total_admitted": out[3],
+            "total_canceled": out[4],
+        }
+
+    def close(self) -> None:
+        with self._mu:
+            if self._closed:
+                return
+            try:
+                self._last_stats = self.stats()
+            except Exception:
+                self._last_stats = {
+                    "queue_depth": 0, "busy_slots": 0, "max_slots": self.max_slots,
+                    "total_admitted": 0, "total_canceled": 0,
+                }
+            self._closed = True
+        if self._lib is not None:
+            self._lib.gofr_sched_destroy(self._h)
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass

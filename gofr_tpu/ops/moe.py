@@ -31,14 +31,29 @@ def router_topk(
     x: jnp.ndarray,  # [T, D]
     w_router: jnp.ndarray,  # [D, E]
     k: int,
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Top-k gating: returns (expert_idx [T, k], gate_weights [T, k]);
-    weights are softmax probs renormalized over the selected k."""
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-k gating: returns (expert_idx [T, k], gate_weights [T, k],
+    full_probs [T, E]); weights are softmax probs renormalized over the
+    selected k; full_probs feed the load-balance aux loss."""
     logits = (x @ w_router).astype(jnp.float32)  # [T, E]
     probs = jax.nn.softmax(logits, axis=-1)
     top_p, top_i = jax.lax.top_k(probs, k)
     top_p = top_p / (jnp.sum(top_p, axis=-1, keepdims=True) + 1e-9)
-    return top_i, top_p
+    return top_i, top_p, probs
+
+
+def switch_aux_stats(
+    top_i: jnp.ndarray,  # [T, k]
+    probs: jnp.ndarray,  # [T, E]
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-expert (f_e, P_e) from the ACTUAL routing decisions: f_e is the
+    fraction of tokens whose top-1 choice is e, P_e the mean router prob —
+    the two factors of the Switch-transformer load-balance loss."""
+    n_experts = probs.shape[-1]
+    top1 = top_i[:, 0]
+    f = jnp.mean(jax.nn.one_hot(top1, n_experts, dtype=jnp.float32), axis=0)
+    p = jnp.mean(probs, axis=0)
+    return f, p
 
 
 def _dispatch_combine(
@@ -95,16 +110,21 @@ def moe_ffn_reference(
     w_down: jnp.ndarray,
     *,
     top_k: int = 2,
-) -> jnp.ndarray:
+    return_stats: bool = False,
+) -> jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Dense reference (no capacity drops, no EP): every expert computes
     every token, combined by the top-k gates. O(E·T·D·F) — test/debug only."""
-    top_i, top_p = router_topk(x, w_router, top_k)
+    top_i, top_p, probs = router_topk(x, w_router, top_k)
     all_out = expert_ffn(
         jnp.broadcast_to(x, (w_gate.shape[0], *x.shape)), w_gate, w_up, w_down
     )  # [E, T, D]
     onehot = jax.nn.one_hot(top_i, w_gate.shape[0], dtype=jnp.float32)  # [T,k,E]
     weights = jnp.einsum("tke,tk->te", onehot, top_p)  # [T, E]
-    return jnp.einsum("etd,te->td", all_out.astype(jnp.float32), weights).astype(x.dtype)
+    y = jnp.einsum("etd,te->td", all_out.astype(jnp.float32), weights).astype(x.dtype)
+    if return_stats:
+        f, p = switch_aux_stats(top_i, probs)
+        return y, f, p
+    return y
 
 
 def moe_ffn_ep_sharded(
@@ -119,12 +139,16 @@ def moe_ffn_ep_sharded(
     n_experts: int,
     top_k: int,
     capacity: int,
-) -> jnp.ndarray:
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Per-device body: route locally, all_to_all tokens to expert owners,
-    run local experts, all_to_all back, combine."""
+    run local experts, all_to_all back, combine. Also returns the global
+    (pmean over the axis) per-expert (f_e, P_e) aux-loss stats."""
     n = axis_size
     e_loc = n_experts // n
-    top_i, top_p = router_topk(x, w_router, top_k)
+    top_i, top_p, probs = router_topk(x, w_router, top_k)
+    f_loc, p_loc = switch_aux_stats(top_i, probs)
+    f = jax.lax.pmean(f_loc, axis_name)
+    p = jax.lax.pmean(p_loc, axis_name)
     dispatch, combine = _dispatch_combine(top_i, top_p, n_experts, capacity)
 
     # [t, E, C] x [t, D] -> [E, C, D], grouped by owning device
@@ -138,7 +162,8 @@ def moe_ffn_ep_sharded(
     out = out.reshape(e_loc, n, capacity, -1).transpose(1, 0, 2, 3)  # [n, E_loc, C, D]
     back = jax.lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0, tiled=True)
     back = back.reshape(n_experts, capacity, -1)  # [E, C, D] for this group
-    return jnp.einsum("ecd,tec->td", back.astype(jnp.float32), combine).astype(x.dtype)
+    y = jnp.einsum("ecd,tec->td", back.astype(jnp.float32), combine).astype(x.dtype)
+    return y, f, p
 
 
 def moe_ffn_ep(
@@ -153,9 +178,11 @@ def moe_ffn_ep(
     top_k: int = 2,
     capacity_factor: float = 1.25,
     capacity: int | None = None,
-) -> jnp.ndarray:
+    return_stats: bool = False,
+) -> jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Expert-parallel MoE FFN: tokens grouped on ``axis``, experts sharded
-    on ``axis``, two all_to_all transposes over ICI."""
+    on ``axis``, two all_to_all transposes over ICI. With ``return_stats``
+    also returns the global per-expert (f_e, P_e) for the aux loss."""
     n = mesh.shape[axis]
     T = x.shape[0]
     E = w_gate.shape[0]
@@ -173,10 +200,11 @@ def moe_ffn_ep(
         capacity=cap,
     )
     espec = P(axis)
-    return jax.shard_map(
+    out, f, p = jax.shard_map(
         fn,
         mesh=mesh,
         in_specs=(P(axis), P(), espec, espec, espec),
-        out_specs=P(axis),
+        out_specs=(P(axis), P(), P()),
         axis_names={axis},
     )(x, w_router, w_gate, w_up, w_down)
+    return (out, f, p) if return_stats else out

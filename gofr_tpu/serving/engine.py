@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
-import queue as queue_mod
 import threading
 import time
 from typing import Any, Callable
@@ -28,6 +27,7 @@ import numpy as np
 
 from gofr_tpu.http.errors import ErrorTooManyRequests
 from gofr_tpu.models import llama
+from gofr_tpu.native.runtime import QueueFull, Scheduler
 from gofr_tpu.serving import batch as batch_ops
 from gofr_tpu.serving.tokenizer import ByteTokenizer, Tokenizer
 
@@ -42,6 +42,7 @@ class EngineConfig:
     max_queue: int = 256
     prefill_buckets: tuple[int, ...] = DEFAULT_BUCKETS
     admission_per_step: int = 4  # prefills between decode steps (TTFT vs TPOT)
+    prefill_token_budget: int = 4096  # prompt tokens admitted per step
     idle_sleep_s: float = 0.002
 
     @classmethod
@@ -50,6 +51,9 @@ class EngineConfig:
             max_slots=int(config.get_or_default("TPU_BATCH_MAX_SLOTS", "8")),
             max_seq_len=int(config.get_or_default("TPU_BATCH_MAX_TOKENS", "1024")),
             max_queue=int(config.get_or_default("TPU_BATCH_MAX_QUEUE", "256")),
+            prefill_token_budget=int(
+                config.get_or_default("TPU_BATCH_PREFILL_BUDGET", "4096")
+            ),
         )
 
 
@@ -124,8 +128,14 @@ class ServingEngine:
         self.slots: list[_Request | None] = [None] * B
         self.rng = jax.random.PRNGKey(seed)
 
-        self._pending: queue_mod.Queue[_Request] = queue_mod.Queue()
-        self._pending_count = 0
+        # admission policy lives in the native scheduler (native/runtime/
+        # gofr_runtime.cc; Python fallback when no toolchain): priority +
+        # FIFO queue, free-slot assignment, per-step prefill token budget
+        self._sched = Scheduler(
+            self.config.max_slots, self.config.max_queue,
+            self.config.prefill_token_budget,
+        )
+        self._by_id: dict[int, _Request] = {}  # queued + active, by request id
         self._count_lock = threading.Lock()
         self._next_id = 0
         self._running = False
@@ -151,15 +161,19 @@ class ServingEngine:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        self._sched.close()
 
     def health_check(self) -> dict[str, Any]:
         active = sum(1 for s in self.slots if s is not None)
+        stats = self._sched.stats()
         return {
             "status": "UP" if self._running else "DOWN",
             "details": {
                 "slots_active": active,
                 "slots_total": self.config.max_slots,
-                "queue_depth": self._pending_count,
+                "queue_depth": stats["queue_depth"],
+                "scheduler_backend": self._sched.backend,
+                "total_admitted": stats["total_admitted"],
             },
         }
 
@@ -172,17 +186,15 @@ class ServingEngine:
         temperature: float = 0.0,
         top_k: int = 0,
         top_p: float = 1.0,
+        priority: int = 0,
         stream_cb: Callable[[int, str, bool], None] | None = None,
-    ) -> "queue_mod.Queue | Any":
+    ) -> Any:
         """Thread-safe submit. Returns a concurrent Future resolving to
         GenerationResult. ``stream_cb(token_id, text_piece, done)`` fires per
-        token from the engine thread."""
+        token from the engine thread. Lower ``priority`` runs first."""
         import concurrent.futures
 
         with self._count_lock:
-            if self._pending_count >= self.config.max_queue:
-                raise ErrorTooManyRequests()
-            self._pending_count += 1
             self._next_id += 1
             rid = self._next_id
 
@@ -200,7 +212,14 @@ class ServingEngine:
             rid, prompt_ids, max_new, temperature, top_k, top_p, stream_cb, future,
             stop_ids={self.tokenizer.eos_id},
         )
-        self._pending.put(req)
+        with self._count_lock:
+            self._by_id[rid] = req
+        try:
+            self._sched.submit(rid, len(prompt_ids), max_new, priority)
+        except QueueFull:
+            with self._count_lock:
+                self._by_id.pop(rid, None)
+            raise ErrorTooManyRequests() from None
         self._observe_queue()
         self._wake.set()
         return future
@@ -236,15 +255,17 @@ class ServingEngine:
                 self.cancel(future.request_id)
 
     def cancel(self, request_id: int) -> None:
-        """Mark a queued or running request canceled; its slot frees on the
-        next step."""
-        for req in list(self.slots):
-            if req is not None and req.id == request_id:
-                req.canceled = True
-        # also cover requests still waiting in the admission queue
-        for req in list(self._pending.queue):
-            if req.id == request_id:
-                req.canceled = True
+        """Mark a queued or running request canceled; a running one frees
+        its slot on the next step, a queued one resolves at admission."""
+        with self._count_lock:
+            req = self._by_id.get(request_id)
+        if req is not None:
+            req.canceled = True
+        try:
+            self._sched.cancel(request_id)  # no-op if already admitted
+        except KeyError:
+            pass
+        self._wake.set()
 
     # ------------------------------------------------------------- the loop
     def _loop(self) -> None:
@@ -271,24 +292,42 @@ class ServingEngine:
 
     # -- admission -------------------------------------------------------------
     def _admit(self) -> bool:
-        admitted = False
-        for _ in range(self.config.admission_per_step):
-            free = [i for i, s in enumerate(self.slots) if s is None]
-            if not free:
-                break
-            try:
-                req = self._pending.get_nowait()
-            except queue_mod.Empty:
-                break
+        pairs, canceled_ids = self._sched.admit(self.config.admission_per_step)
+        for rid in canceled_ids:
             with self._count_lock:
-                self._pending_count -= 1
-            if req.canceled:
+                req = self._by_id.pop(rid, None)
+            if req is not None:
+                self._finish(req, "cancel")
+        for rid, slot in pairs:
+            with self._count_lock:
+                req = self._by_id.get(rid)
+            if req is None:  # should not happen; release the slot defensively
+                self._sched.release(slot)
+                continue
+            if req.canceled:  # canceled between admit() and here
+                self._sched.release(slot)
+                with self._count_lock:
+                    self._by_id.pop(rid, None)
                 self._finish(req, "cancel")
                 continue
-            self._prefill_into(free[0], req)
-            admitted = True
+            try:
+                self._prefill_into(slot, req)
+            except Exception as exc:
+                # a failed prefill must not leak the slot or hang the client
+                self.slots[slot] = None
+                self.cache_len[slot] = 0
+                try:
+                    self._sched.release(slot)
+                except KeyError:
+                    pass
+                with self._count_lock:
+                    self._by_id.pop(rid, None)
+                if not req.future.done():
+                    req.future.set_exception(exc)
+                if self._logger:
+                    self._logger.error(f"prefill failed for request {rid}: {exc}")
         self._observe_queue()
-        return admitted
+        return bool(pairs or canceled_ids)
 
     def _prefill_into(self, slot: int, req: _Request) -> None:
         cfg = self.model_cfg
@@ -399,7 +438,13 @@ class ServingEngine:
         req = self.slots[slot]
         self.slots[slot] = None
         self.cache_len[slot] = 0
+        try:
+            self._sched.release(slot)
+        except KeyError:
+            pass
         if req is not None:
+            with self._count_lock:
+                self._by_id.pop(req.id, None)
             self._finish(req, reason)
 
     def _finish(self, req: _Request, reason: str) -> None:
@@ -428,6 +473,12 @@ class ServingEngine:
             if req is not None:
                 self.slots[slot] = None
                 self.cache_len[slot] = 0
+                try:
+                    self._sched.release(slot)
+                except KeyError:
+                    pass
+                with self._count_lock:
+                    self._by_id.pop(req.id, None)
                 if not req.future.done():
                     req.future.set_exception(exc)
 
@@ -438,7 +489,9 @@ class ServingEngine:
 
     def _observe_queue(self) -> None:
         if self._metrics:
-            self._metrics.set_gauge("app_batch_queue_depth", self._pending_count)
+            self._metrics.set_gauge(
+                "app_batch_queue_depth", self._sched.stats()["queue_depth"]
+            )
 
     def _span(self, name: str):
         import contextlib

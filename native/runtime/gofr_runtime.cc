@@ -1,0 +1,462 @@
+// gofr_tpu native serving runtime: paged KV-cache block allocator and
+// continuous-batching admission scheduler.
+//
+// Role in the framework (SURVEY.md §2.9 "Native components", §5.7): the
+// reference (sllt/gofr) is pure Go, but a TPU serving stack keeps its
+// hot host-side bookkeeping — KV block tables, refcounts, admission
+// policy — in native code so the per-step scheduler work is O(µs) and
+// never contends with the Python interpreter while device steps run.
+// Python drives the device (JAX dispatch); this library owns the
+// book-keeping state and is called through ctypes (no pybind11 in the
+// image — plain C ABI below).
+//
+// Thread-safety: each handle carries its own mutex; any thread may call
+// any function. All functions return 0/positive on success, negative
+// GOFR_E_* on failure, and never throw across the C boundary.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+#define GOFR_API extern "C" __attribute__((visibility("default")))
+
+enum GofrError : int32_t {
+  GOFR_OK = 0,
+  GOFR_E_BADHANDLE = -1,
+  GOFR_E_NOMEM = -2,      // out of KV blocks
+  GOFR_E_NOTFOUND = -3,   // unknown sequence / request id
+  GOFR_E_EXISTS = -4,     // duplicate id
+  GOFR_E_QUEUEFULL = -5,  // admission queue at capacity
+  GOFR_E_ARG = -6,        // bad argument
+  GOFR_E_CAP = -7,        // output buffer too small
+};
+
+// ---------------------------------------------------------------------------
+// Paged KV block allocator
+// ---------------------------------------------------------------------------
+// Blocks are fixed-size pages of the device KV cache (block_size tokens).
+// Sequences own ordered lists of block ids; blocks are refcounted so a
+// fork (prefix sharing between a parent prompt and its continuations)
+// shares fully-covered blocks copy-on-write style: the LAST, partially
+// filled block is never shared — the forker gets a fresh copy target.
+
+namespace {
+
+struct Sequence {
+  std::vector<int32_t> blocks;
+  int64_t length = 0;  // tokens currently stored
+};
+
+struct BlockAllocator {
+  std::mutex mu;
+  int32_t num_blocks;
+  int32_t block_size;
+  std::vector<int32_t> refcount;     // per block
+  std::vector<int32_t> free_list;    // LIFO for locality
+  std::unordered_map<int64_t, Sequence> seqs;
+  int64_t alloc_failures = 0;
+
+  BlockAllocator(int32_t nb, int32_t bs) : num_blocks(nb), block_size(bs) {
+    refcount.assign(nb, 0);
+    free_list.reserve(nb);
+    for (int32_t i = nb - 1; i >= 0; --i) free_list.push_back(i);
+  }
+
+  int32_t take_block() {
+    if (free_list.empty()) return -1;
+    int32_t b = free_list.back();
+    free_list.pop_back();
+    refcount[b] = 1;
+    return b;
+  }
+
+  void drop_block(int32_t b) {
+    if (--refcount[b] == 0) free_list.push_back(b);
+  }
+
+  int32_t blocks_needed(int64_t tokens) const {
+    return static_cast<int32_t>((tokens + block_size - 1) / block_size);
+  }
+};
+
+std::mutex g_ba_mu;
+std::unordered_map<int64_t, BlockAllocator*> g_allocators;
+int64_t g_next_ba = 1;
+
+BlockAllocator* ba_get(int64_t h) {
+  std::lock_guard<std::mutex> g(g_ba_mu);
+  auto it = g_allocators.find(h);
+  return it == g_allocators.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+GOFR_API int64_t gofr_ba_create(int32_t num_blocks, int32_t block_size) {
+  if (num_blocks <= 0 || block_size <= 0) return GOFR_E_ARG;
+  auto* ba = new BlockAllocator(num_blocks, block_size);
+  std::lock_guard<std::mutex> g(g_ba_mu);
+  int64_t h = g_next_ba++;
+  g_allocators[h] = ba;
+  return h;
+}
+
+GOFR_API int32_t gofr_ba_destroy(int64_t h) {
+  BlockAllocator* ba = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_ba_mu);
+    auto it = g_allocators.find(h);
+    if (it == g_allocators.end()) return GOFR_E_BADHANDLE;
+    ba = it->second;
+    g_allocators.erase(it);
+  }
+  delete ba;
+  return GOFR_OK;
+}
+
+// Allocate a sequence with room for `tokens` tokens. Fails atomically
+// (no partial allocation) when not enough free blocks remain.
+GOFR_API int32_t gofr_ba_alloc(int64_t h, int64_t seq_id, int64_t tokens) {
+  BlockAllocator* ba = ba_get(h);
+  if (!ba) return GOFR_E_BADHANDLE;
+  if (tokens < 0) return GOFR_E_ARG;
+  std::lock_guard<std::mutex> g(ba->mu);
+  if (ba->seqs.count(seq_id)) return GOFR_E_EXISTS;
+  int32_t need = ba->blocks_needed(tokens);
+  if (static_cast<int32_t>(ba->free_list.size()) < need) {
+    ba->alloc_failures++;
+    return GOFR_E_NOMEM;
+  }
+  Sequence s;
+  s.length = tokens;
+  s.blocks.reserve(need);
+  for (int32_t i = 0; i < need; ++i) s.blocks.push_back(ba->take_block());
+  ba->seqs.emplace(seq_id, std::move(s));
+  return GOFR_OK;
+}
+
+// Grow a sequence to new_length tokens (decode appends). Allocates new
+// blocks as page boundaries are crossed. If the tail block is shared
+// (forked), it is copied-on-write: a fresh block replaces it and
+// *out_cow_src/*out_cow_dst tell the caller which device-side page copy
+// to issue (-1/-1 when no copy is needed).
+GOFR_API int32_t gofr_ba_extend(int64_t h, int64_t seq_id, int64_t new_length,
+                                int32_t* out_cow_src, int32_t* out_cow_dst) {
+  if (out_cow_src) *out_cow_src = -1;
+  if (out_cow_dst) *out_cow_dst = -1;
+  BlockAllocator* ba = ba_get(h);
+  if (!ba) return GOFR_E_BADHANDLE;
+  std::lock_guard<std::mutex> g(ba->mu);
+  auto it = ba->seqs.find(seq_id);
+  if (it == ba->seqs.end()) return GOFR_E_NOTFOUND;
+  Sequence& s = it->second;
+  if (new_length < s.length) return GOFR_E_ARG;
+
+  // copy-on-write the tail block if shared and we're about to write into it
+  // (a full shared tail is read-only: new tokens land in fresh blocks)
+  if (!s.blocks.empty() && s.length % ba->block_size != 0) {
+    int32_t tail = s.blocks.back();
+    if (ba->refcount[tail] > 1 && new_length > s.length) {
+      int32_t fresh = ba->take_block();
+      if (fresh < 0) {
+        ba->alloc_failures++;
+        return GOFR_E_NOMEM;
+      }
+      ba->drop_block(tail);
+      s.blocks.back() = fresh;
+      if (out_cow_src) *out_cow_src = tail;
+      if (out_cow_dst) *out_cow_dst = fresh;
+    }
+  }
+
+  int32_t need = ba->blocks_needed(new_length);
+  int32_t have = static_cast<int32_t>(s.blocks.size());
+  if (need > have) {
+    if (static_cast<int32_t>(ba->free_list.size()) < need - have) {
+      ba->alloc_failures++;
+      return GOFR_E_NOMEM;
+    }
+    for (int32_t i = have; i < need; ++i) s.blocks.push_back(ba->take_block());
+  }
+  s.length = new_length;
+  return GOFR_OK;
+}
+
+// Fork: dst shares src's fully-covered prefix blocks (refcount++), up to
+// shared_tokens. The partial tail block is NOT shared; dst must re-prefill
+// tokens beyond the last full block boundary. Returns the number of tokens
+// actually shared (multiple of block_size), or negative error.
+GOFR_API int64_t gofr_ba_fork(int64_t h, int64_t src_id, int64_t dst_id,
+                              int64_t shared_tokens) {
+  BlockAllocator* ba = ba_get(h);
+  if (!ba) return GOFR_E_BADHANDLE;
+  std::lock_guard<std::mutex> g(ba->mu);
+  auto it = ba->seqs.find(src_id);
+  if (it == ba->seqs.end()) return GOFR_E_NOTFOUND;
+  if (ba->seqs.count(dst_id)) return GOFR_E_EXISTS;
+  Sequence& src = it->second;
+  int64_t shareable = std::min<int64_t>(shared_tokens, src.length);
+  int32_t full_blocks = static_cast<int32_t>(shareable / ba->block_size);
+  full_blocks = std::min<int32_t>(full_blocks, static_cast<int32_t>(src.blocks.size()));
+  Sequence dst;
+  dst.length = static_cast<int64_t>(full_blocks) * ba->block_size;
+  dst.blocks.assign(src.blocks.begin(), src.blocks.begin() + full_blocks);
+  for (int32_t b : dst.blocks) ba->refcount[b]++;
+  ba->seqs.emplace(dst_id, std::move(dst));
+  return static_cast<int64_t>(full_blocks) * ba->block_size;
+}
+
+GOFR_API int32_t gofr_ba_free(int64_t h, int64_t seq_id) {
+  BlockAllocator* ba = ba_get(h);
+  if (!ba) return GOFR_E_BADHANDLE;
+  std::lock_guard<std::mutex> g(ba->mu);
+  auto it = ba->seqs.find(seq_id);
+  if (it == ba->seqs.end()) return GOFR_E_NOTFOUND;
+  for (int32_t b : it->second.blocks) ba->drop_block(b);
+  ba->seqs.erase(it);
+  return GOFR_OK;
+}
+
+// Write the sequence's block table into out (device-side gather indices).
+// Returns number of entries, or negative error. GOFR_E_CAP if cap too small.
+GOFR_API int32_t gofr_ba_block_table(int64_t h, int64_t seq_id, int32_t* out,
+                                     int32_t cap) {
+  BlockAllocator* ba = ba_get(h);
+  if (!ba) return GOFR_E_BADHANDLE;
+  std::lock_guard<std::mutex> g(ba->mu);
+  auto it = ba->seqs.find(seq_id);
+  if (it == ba->seqs.end()) return GOFR_E_NOTFOUND;
+  const auto& blocks = it->second.blocks;
+  if (static_cast<int32_t>(blocks.size()) > cap) return GOFR_E_CAP;
+  std::memcpy(out, blocks.data(), blocks.size() * sizeof(int32_t));
+  return static_cast<int32_t>(blocks.size());
+}
+
+GOFR_API int64_t gofr_ba_seq_length(int64_t h, int64_t seq_id) {
+  BlockAllocator* ba = ba_get(h);
+  if (!ba) return GOFR_E_BADHANDLE;
+  std::lock_guard<std::mutex> g(ba->mu);
+  auto it = ba->seqs.find(seq_id);
+  if (it == ba->seqs.end()) return GOFR_E_NOTFOUND;
+  return it->second.length;
+}
+
+// stats: out[0]=free blocks, out[1]=total, out[2]=live sequences,
+// out[3]=alloc failures since creation
+GOFR_API int32_t gofr_ba_stats(int64_t h, int64_t* out4) {
+  BlockAllocator* ba = ba_get(h);
+  if (!ba) return GOFR_E_BADHANDLE;
+  std::lock_guard<std::mutex> g(ba->mu);
+  out4[0] = static_cast<int64_t>(ba->free_list.size());
+  out4[1] = ba->num_blocks;
+  out4[2] = static_cast<int64_t>(ba->seqs.size());
+  out4[3] = ba->alloc_failures;
+  return GOFR_OK;
+}
+
+// ---------------------------------------------------------------------------
+// Continuous-batching admission scheduler
+// ---------------------------------------------------------------------------
+// Policy engine for the engine loop (gofr_tpu/serving/engine.py): requests
+// queue with a priority + FIFO order; `admit` hands out (request, slot)
+// pairs bounded by (a) free slots, (b) a per-step prefill token budget so
+// a burst of long prompts cannot starve decode (TTFT/TPOT tradeoff the
+// reference never faces — its unit of work is one goroutine per request,
+// handler.go:55-113).
+
+namespace {
+
+struct SchedRequest {
+  int64_t id;
+  int32_t prompt_len;
+  int32_t max_new_tokens;
+  int32_t priority;  // lower runs first
+  uint64_t seqno;    // FIFO tiebreak
+  bool canceled = false;
+};
+
+struct Scheduler {
+  std::mutex mu;
+  int32_t max_slots;
+  int32_t max_queue;
+  int32_t prefill_token_budget;  // per admit() call
+  std::vector<int64_t> slot_req;  // -1 = free
+  // priority -> FIFO deque. std::map keeps priorities ordered.
+  std::map<int32_t, std::deque<SchedRequest>> queues;
+  std::unordered_map<int64_t, SchedRequest*> by_id;
+  uint64_t next_seqno = 0;
+  int64_t total_admitted = 0;
+  int64_t total_canceled = 0;
+
+  Scheduler(int32_t slots, int32_t mq, int32_t budget)
+      : max_slots(slots), max_queue(mq), prefill_token_budget(budget) {
+    slot_req.assign(slots, -1);
+  }
+
+  int32_t queue_depth_locked() const {
+    int32_t n = 0;
+    for (const auto& [p, q] : queues) n += static_cast<int32_t>(q.size());
+    return n;
+  }
+};
+
+std::mutex g_sc_mu;
+std::unordered_map<int64_t, Scheduler*> g_scheds;
+int64_t g_next_sc = 1;
+
+Scheduler* sc_get(int64_t h) {
+  std::lock_guard<std::mutex> g(g_sc_mu);
+  auto it = g_scheds.find(h);
+  return it == g_scheds.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+GOFR_API int64_t gofr_sched_create(int32_t max_slots, int32_t max_queue,
+                                   int32_t prefill_token_budget) {
+  if (max_slots <= 0 || max_queue <= 0 || prefill_token_budget <= 0)
+    return GOFR_E_ARG;
+  auto* sc = new Scheduler(max_slots, max_queue, prefill_token_budget);
+  std::lock_guard<std::mutex> g(g_sc_mu);
+  int64_t h = g_next_sc++;
+  g_scheds[h] = sc;
+  return h;
+}
+
+GOFR_API int32_t gofr_sched_destroy(int64_t h) {
+  Scheduler* sc = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_sc_mu);
+    auto it = g_scheds.find(h);
+    if (it == g_scheds.end()) return GOFR_E_BADHANDLE;
+    sc = it->second;
+    g_scheds.erase(it);
+  }
+  delete sc;
+  return GOFR_OK;
+}
+
+GOFR_API int32_t gofr_sched_submit(int64_t h, int64_t req_id,
+                                   int32_t prompt_len, int32_t max_new_tokens,
+                                   int32_t priority) {
+  Scheduler* sc = sc_get(h);
+  if (!sc) return GOFR_E_BADHANDLE;
+  if (prompt_len < 0 || max_new_tokens < 0) return GOFR_E_ARG;
+  std::lock_guard<std::mutex> g(sc->mu);
+  if (sc->by_id.count(req_id)) return GOFR_E_EXISTS;
+  if (sc->queue_depth_locked() >= sc->max_queue) return GOFR_E_QUEUEFULL;
+  SchedRequest r{req_id, prompt_len, max_new_tokens, priority, sc->next_seqno++};
+  auto& q = sc->queues[priority];
+  q.push_back(r);
+  sc->by_id[req_id] = &q.back();
+  // deque push_back can reallocate iterators? std::deque never invalidates
+  // pointers to *other* elements on push_back, but may on push_front /
+  // middle erase — we only push_back and pop_front, and rebuild by_id on
+  // pop, so stored pointers stay valid for queued elements.
+  return GOFR_OK;
+}
+
+GOFR_API int32_t gofr_sched_cancel(int64_t h, int64_t req_id) {
+  Scheduler* sc = sc_get(h);
+  if (!sc) return GOFR_E_BADHANDLE;
+  std::lock_guard<std::mutex> g(sc->mu);
+  auto it = sc->by_id.find(req_id);
+  if (it == sc->by_id.end()) return GOFR_E_NOTFOUND;
+  it->second->canceled = true;
+  sc->total_canceled++;
+  return GOFR_OK;
+}
+
+// Admit up to `cap` requests: fills out_req_ids/out_slots pairwise and
+// returns the count. Honors free slots and the prefill token budget;
+// canceled requests are silently dropped from the queue (their ids are
+// reported through out_canceled/out_canceled_cap so the host can resolve
+// futures). A request longer than the whole budget admits alone (never
+// starves).
+GOFR_API int32_t gofr_sched_admit(int64_t h, int64_t* out_req_ids,
+                                  int32_t* out_slots, int32_t cap,
+                                  int64_t* out_canceled,
+                                  int32_t canceled_cap,
+                                  int32_t* out_n_canceled) {
+  if (out_n_canceled) *out_n_canceled = 0;
+  Scheduler* sc = sc_get(h);
+  if (!sc) return GOFR_E_BADHANDLE;
+  std::lock_guard<std::mutex> g(sc->mu);
+  int32_t admitted = 0;
+  int32_t budget = sc->prefill_token_budget;
+  int32_t n_canceled = 0;
+
+  for (auto qit = sc->queues.begin();
+       qit != sc->queues.end() && admitted < cap;) {
+    auto& q = qit->second;
+    while (!q.empty() && admitted < cap) {
+      SchedRequest& front = q.front();
+      if (front.canceled) {
+        // report-or-keep: a canceled request is only dequeued if its id
+        // fits the report buffer — overflow stays queued for the next
+        // admit() so the host can always resolve its future.
+        if (n_canceled >= canceled_cap) goto done;
+        if (out_canceled) out_canceled[n_canceled] = front.id;
+        n_canceled++;
+        sc->by_id.erase(front.id);
+        q.pop_front();
+        continue;
+      }
+      // budget check: first admission of the call always passes
+      if (admitted > 0 && front.prompt_len > budget) goto next_queue;
+      // find a free slot
+      {
+        int32_t slot = -1;
+        for (int32_t s = 0; s < sc->max_slots; ++s)
+          if (sc->slot_req[s] < 0) { slot = s; break; }
+        if (slot < 0) goto done;
+        sc->slot_req[slot] = front.id;
+        out_req_ids[admitted] = front.id;
+        out_slots[admitted] = slot;
+        admitted++;
+        budget -= front.prompt_len;
+        sc->total_admitted++;
+        sc->by_id.erase(front.id);
+        q.pop_front();
+        if (budget <= 0) goto done;
+      }
+    }
+  next_queue:
+    ++qit;
+  }
+done:
+  if (out_n_canceled) *out_n_canceled = std::min(n_canceled, canceled_cap);
+  return admitted;
+}
+
+GOFR_API int32_t gofr_sched_release(int64_t h, int32_t slot) {
+  Scheduler* sc = sc_get(h);
+  if (!sc) return GOFR_E_BADHANDLE;
+  std::lock_guard<std::mutex> g(sc->mu);
+  if (slot < 0 || slot >= sc->max_slots) return GOFR_E_ARG;
+  if (sc->slot_req[slot] < 0) return GOFR_E_NOTFOUND;
+  sc->slot_req[slot] = -1;
+  return GOFR_OK;
+}
+
+// stats: out[0]=queue depth, out[1]=busy slots, out[2]=max slots,
+// out[3]=total admitted, out[4]=total canceled
+GOFR_API int32_t gofr_sched_stats(int64_t h, int64_t* out5) {
+  Scheduler* sc = sc_get(h);
+  if (!sc) return GOFR_E_BADHANDLE;
+  std::lock_guard<std::mutex> g(sc->mu);
+  out5[0] = sc->queue_depth_locked();
+  int32_t busy = 0;
+  for (int64_t r : sc->slot_req) busy += (r >= 0);
+  out5[1] = busy;
+  out5[2] = sc->max_slots;
+  out5[3] = sc->total_admitted;
+  out5[4] = sc->total_canceled;
+  return GOFR_OK;
+}
+
+GOFR_API const char* gofr_runtime_version() { return "gofr-native-runtime 1.0"; }

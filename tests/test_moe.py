@@ -67,6 +67,29 @@ def test_load_balance_loss_finite_and_positive():
     assert np.isfinite(float(aux)) and float(aux) > 0
 
 
+def test_aux_stats_use_per_layer_hidden_states():
+    """Layer-1 router stats must come from the residual stream it actually
+    routes on, not the embeddings (regression: aux loss previously fed every
+    layer's router the embedding output)."""
+    cfg = moe.MoeConfig.tiny()
+    params = moe.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    _, (f, p) = moe.forward(cfg, params, tokens, return_aux=True)
+    assert f.shape == (cfg.n_layers, cfg.n_experts)
+    # what the (buggy) embedding-based computation would produce for layer 1
+    from gofr_tpu.ops.moe import router_topk, switch_aux_stats
+    from gofr_tpu.ops.norms import rms_norm
+
+    x = params["embedding"][tokens].astype(cfg.dtype).reshape(-1, cfg.d_model)
+    x = rms_norm(x, params["layers"]["mlp_norm"][1], cfg.norm_eps)
+    ti, _, probs = router_topk(x, params["layers"]["w_router"][1], cfg.top_k)
+    _, p_embed = switch_aux_stats(ti, probs)
+    assert not np.allclose(np.asarray(p[1]), np.asarray(p_embed), atol=1e-5)
+    # each layer's P_e sums to 1 (true softmax means)
+    np.testing.assert_allclose(np.asarray(p).sum(-1), 1.0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(f).sum(-1), 1.0, atol=1e-5)
+
+
 def test_moe_grads_flow_through_ep(ep_mesh):
     """value_and_grad through the all_to_all dispatch produces finite,
     nonzero expert grads."""
@@ -75,7 +98,7 @@ def test_moe_grads_flow_through_ep(ep_mesh):
     tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
 
     def loss(p):
-        logits = moe._forward_jit(cfg, p, tokens, ep_mesh)
+        logits, _ = moe._forward_jit(cfg, p, tokens, ep_mesh)
         logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
         return -jnp.mean(jnp.take_along_axis(logp, tokens[:, 1:, None], axis=-1))
 

@@ -1,0 +1,256 @@
+"""Native C++ runtime (block allocator + scheduler) — both backends run
+the same scenarios so the native library and the Python fallback stay
+contract-identical (the mock-vs-real tier discipline of SURVEY §4)."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from gofr_tpu.native import native_available
+from gofr_tpu.native.runtime import BlockAllocator, OutOfBlocks, QueueFull, Scheduler
+
+BACKENDS = ["python"] + (["native"] if native_available() else [])
+
+
+@pytest.fixture(params=BACKENDS)
+def backend(request):
+    return request.param
+
+
+def make_ba(backend, num_blocks=16, block_size=4):
+    ba = BlockAllocator(num_blocks, block_size, force_python=(backend == "python"))
+    assert ba.backend == backend
+    return ba
+
+
+def make_sched(backend, max_slots=4, max_queue=8, budget=64):
+    sc = Scheduler(max_slots, max_queue, budget, force_python=(backend == "python"))
+    assert sc.backend == backend
+    return sc
+
+
+def test_native_library_builds():
+    # the image bakes g++; the native path must actually be exercised in CI
+    assert native_available(), "native runtime failed to build — check g++"
+
+
+class TestBlockAllocator:
+    def test_alloc_free_roundtrip(self, backend):
+        ba = make_ba(backend)
+        ba.alloc(1, 10)  # 10 tokens / bs 4 -> 3 blocks
+        assert len(ba.block_table(1)) == 3
+        assert ba.seq_length(1) == 10
+        s = ba.stats()
+        assert s["free_blocks"] == 13 and s["sequences"] == 1
+        ba.free(1)
+        assert ba.stats()["free_blocks"] == 16
+        ba.close()
+
+    def test_extend_crosses_page_boundary(self, backend):
+        ba = make_ba(backend)
+        ba.alloc(1, 4)
+        assert len(ba.block_table(1)) == 1
+        cow = ba.extend(1, 5)
+        assert cow == (-1, -1)
+        assert len(ba.block_table(1)) == 2
+        ba.extend(1, 8)
+        assert len(ba.block_table(1)) == 2
+        ba.close()
+
+    def test_atomic_alloc_failure(self, backend):
+        ba = make_ba(backend, num_blocks=4)
+        ba.alloc(1, 8)  # 2 blocks
+        with pytest.raises(OutOfBlocks):
+            ba.alloc(2, 100)  # would need 25
+        # failure must not leak blocks
+        assert ba.stats()["free_blocks"] == 2
+        assert ba.stats()["alloc_failures"] == 1
+        ba.alloc(3, 8)
+        ba.close()
+
+    def test_fork_shares_full_blocks_only(self, backend):
+        ba = make_ba(backend)
+        ba.alloc(1, 10)  # blocks: [b0 full, b1 full, b2 partial(2)]
+        shared = ba.fork(1, 2, 10)
+        assert shared == 8  # only the two full blocks
+        t1, t2 = ba.block_table(1), ba.block_table(2)
+        assert t2 == t1[:2]
+        # 3 (seq1) + 0 new for seq2 -> still 13 free
+        assert ba.stats()["free_blocks"] == 13
+        ba.close()
+
+    def test_fork_copy_on_write_on_extend(self, backend):
+        ba = make_ba(backend, num_blocks=16, block_size=4)
+        ba.alloc(1, 8)  # two FULL blocks -> both shareable
+        ba.fork(1, 2, 8)
+        assert ba.block_table(2) == ba.block_table(1)
+        # seq 2 writes into the shared tail -> must COW
+        cow_src, cow_dst = ba.extend(2, 9)
+        # growing 8->9 crosses into a NEW block; tail b1 stays shared? No:
+        # extend grows from the shared tail. The COW only triggers when the
+        # tail block itself will be written. 8->9 needs a new 3rd block, the
+        # shared ones are full and read-only -> no COW required.
+        assert (cow_src, cow_dst) == (-1, -1)
+        assert len(ba.block_table(2)) == 3
+        assert ba.block_table(2)[:2] == ba.block_table(1)[:2]
+        ba.close()
+
+    def test_cow_on_partial_shared_tail(self, backend):
+        # Force a shared PARTIAL tail: fork at a block boundary then extend
+        # the parent so its tail is the shared block... simpler: fork shares
+        # only full blocks by design, so a shared tail is always full; COW
+        # then fires when a fork extends INTO its own tail that is shared
+        # and full — which never needs a write. The COW path still guards
+        # refcounted tails after double-fork + free patterns:
+        ba = make_ba(backend)
+        ba.alloc(1, 4)   # one full block b0
+        ba.fork(1, 2, 4)  # share b0
+        ba.free(1)        # b0 refcount back to 1, owned by seq2
+        cow = ba.extend(2, 6)
+        assert cow == (-1, -1)  # sole owner again: no COW
+        assert ba.stats()["free_blocks"] == 14
+        ba.close()
+
+    def test_many_sequences_churn(self, backend):
+        ba = make_ba(backend, num_blocks=64, block_size=16)
+        for wave in range(8):
+            for i in range(8):
+                ba.alloc(wave * 100 + i, 100)  # 7 blocks each
+                ba.extend(wave * 100 + i, 128)  # 8 blocks
+            for i in range(8):
+                ba.free(wave * 100 + i)
+        s = ba.stats()
+        assert s["free_blocks"] == 64 and s["sequences"] == 0
+        ba.close()
+
+    def test_unknown_sequence_raises(self, backend):
+        ba = make_ba(backend)
+        with pytest.raises(KeyError):
+            ba.block_table(99)
+        with pytest.raises(KeyError):
+            ba.free(99)
+        ba.alloc(1, 4)
+        with pytest.raises(KeyError):
+            ba.alloc(1, 4)
+        ba.close()
+
+
+class TestScheduler:
+    def test_fifo_admission(self, backend):
+        sc = make_sched(backend)
+        for rid in (10, 11, 12):
+            sc.submit(rid, prompt_len=8, max_new_tokens=16)
+        admitted, canceled = sc.admit(2)
+        assert [r for r, _ in admitted] == [10, 11]
+        assert canceled == []
+        admitted, _ = sc.admit(4)
+        assert [r for r, _ in admitted] == [12]
+        # distinct slots
+        slots = {s for _, s in admitted}
+        assert len(slots) == 1
+        sc.close()
+
+    def test_priority_order(self, backend):
+        sc = make_sched(backend)
+        sc.submit(1, 8, 8, priority=5)
+        sc.submit(2, 8, 8, priority=0)
+        sc.submit(3, 8, 8, priority=5)
+        admitted, _ = sc.admit(3)
+        assert [r for r, _ in admitted] == [2, 1, 3]
+        sc.close()
+
+    def test_slot_exhaustion_and_release(self, backend):
+        sc = make_sched(backend, max_slots=2)
+        for rid in range(4):
+            sc.submit(rid, 4, 4)
+        admitted, _ = sc.admit(10)
+        assert len(admitted) == 2
+        assert sc.stats()["busy_slots"] == 2
+        sc.release(admitted[0][1])
+        admitted2, _ = sc.admit(10)
+        assert len(admitted2) == 1
+        assert admitted2[0][1] == admitted[0][1]  # reuses the freed slot
+        sc.close()
+
+    def test_prefill_token_budget(self, backend):
+        sc = make_sched(backend, max_slots=8, budget=100)
+        sc.submit(1, 60, 8)
+        sc.submit(2, 60, 8)
+        sc.submit(3, 60, 8)
+        admitted, _ = sc.admit(8)
+        # 60 + 60 > 100: second admits (budget hits 40<60? no —
+        # first consumes 60, leaving 40; second's 60 > 40 -> stops at 1
+        assert [r for r, _ in admitted] == [1]
+        admitted, _ = sc.admit(8)
+        assert [r for r, _ in admitted] == [2]
+        sc.close()
+
+    def test_oversized_prompt_never_starves(self, backend):
+        sc = make_sched(backend, budget=10)
+        sc.submit(1, 500, 8)  # way over budget
+        admitted, _ = sc.admit(8)
+        assert [r for r, _ in admitted] == [1]
+        sc.close()
+
+    def test_queue_full(self, backend):
+        sc = make_sched(backend, max_queue=2)
+        sc.submit(1, 4, 4)
+        sc.submit(2, 4, 4)
+        with pytest.raises(QueueFull):
+            sc.submit(3, 4, 4)
+        sc.close()
+
+    def test_cancel_queued(self, backend):
+        sc = make_sched(backend)
+        sc.submit(1, 4, 4)
+        sc.submit(2, 4, 4)
+        sc.cancel(1)
+        admitted, canceled = sc.admit(8)
+        assert canceled == [1]
+        assert [r for r, _ in admitted] == [2]
+        assert sc.stats()["total_canceled"] == 1
+        sc.close()
+
+    def test_thread_safety_smoke(self, backend):
+        sc = make_sched(backend, max_slots=8, max_queue=10_000, budget=1 << 30)
+        ba = make_ba(backend, num_blocks=256, block_size=16)
+        errors: list[Exception] = []
+
+        def producer(base):
+            try:
+                for i in range(200):
+                    sc.submit(base + i, 16, 16)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        def consumer():
+            try:
+                drained = 0
+                while drained < 600:
+                    admitted, _ = sc.admit(8)
+                    for rid, slot in admitted:
+                        ba.alloc(rid, 16)
+                        ba.extend(rid, 32)
+                        ba.free(rid)
+                        sc.release(slot)
+                        drained += 1
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=producer, args=(b,)) for b in (0, 1000, 2000)]
+        ct = threading.Thread(target=consumer)
+        ct.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        ct.join(timeout=60)
+        assert not ct.is_alive(), "consumer wedged"
+        assert not errors
+        assert sc.stats()["queue_depth"] == 0
+        assert sc.stats()["total_admitted"] == 600
+        assert ba.stats()["free_blocks"] == 256
+        sc.close()
+        ba.close()

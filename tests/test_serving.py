@@ -135,6 +135,60 @@ def test_cancellation_frees_slot(engine_setup):
         engine.stop()
 
 
+def test_prefill_failure_releases_slot(engine_setup):
+    """A prefill exception must fail the future AND release the scheduler
+    slot (regression: leaked slots made the engine permanently full)."""
+    cfg, params = engine_setup
+    engine = make_engine(cfg, params)
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected prefill failure")
+
+    engine._prefill_into = boom
+    engine.start()
+    try:
+        futs = [engine.submit(f"req {i}") for i in range(3)]
+        for f in futs:
+            with pytest.raises(RuntimeError, match="injected"):
+                f.result(timeout=60)
+        stats = engine._sched.stats()
+        assert stats["busy_slots"] == 0
+        assert engine.health_check()["details"]["slots_active"] == 0
+    finally:
+        engine.stop()
+    # health after stop must stay well-formed, not raise (native handle gone)
+    assert engine.health_check()["status"] == "DOWN"
+
+
+def test_priority_admission(engine_setup):
+    """Lower priority value admits first when both are queued."""
+    cfg, params = engine_setup
+    engine = make_engine(cfg, params)
+    order = []
+    done = threading.Event()
+
+    real_prefill = engine._prefill_into
+
+    def spy(slot, req):
+        order.append(req.id)
+        if len(order) >= 2:
+            done.set()
+        return real_prefill(slot, req)
+
+    engine._prefill_into = spy
+    fut_low = engine.submit("low priority", priority=10, max_new_tokens=2)
+    fut_high = engine.submit("high priority", priority=0, max_new_tokens=2)
+    engine.start()
+    try:
+        assert done.wait(timeout=60)
+        assert order[0] == fut_high.request_id
+        assert order[1] == fut_low.request_id
+        fut_low.result(timeout=60)
+        fut_high.result(timeout=60)
+    finally:
+        engine.stop()
+
+
 def test_max_seq_len_budget(engine_setup):
     """A prompt near max_seq_len gets its token budget clamped."""
     cfg, params = engine_setup
