@@ -56,6 +56,7 @@ class TPUClient:
         self._busy_ns = 0
         self._window_start = time.monotonic()
         self._last_error: str | None = None
+        self._native_info: dict[str, Any] | None = None
 
     @classmethod
     def from_config(cls, config: Any) -> "TPUClient":
@@ -79,6 +80,7 @@ class TPUClient:
         if self.compile_cache_dir:
             jax.config.update("jax_compilation_cache_dir", self.compile_cache_dir)
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        self._probe_native_binding()
         self._devices = jax.devices(self.platform) if self.platform else jax.devices()
         spec = self.mesh_spec
         if isinstance(spec, str):
@@ -179,6 +181,27 @@ class TPUClient:
                 type="tpu_execute", executable=name,
             )
 
+    def _probe_native_binding(self) -> None:
+        """Best-effort probe of the native PJRT C-API binding (native/pjrt):
+        confirms the plugin .so is loadable outside the JAX process model
+        and records its negotiated API version for health reporting. Only
+        probes REAL plugins ($TPU_PJRT_PLUGIN / libtpu) — never compiles
+        the test stub on the connect path; loads are memoized process-wide."""
+        try:
+            from gofr_tpu.native.pjrt import PjrtPlugin, probe_plugin_path
+
+            path = probe_plugin_path()
+            if path is None:
+                return
+            plugin = PjrtPlugin.load(path)
+            major, minor = plugin.api_version
+            self._native_info = {
+                "plugin": path,
+                "pjrt_c_api": f"{major}.{minor}",
+            }
+        except Exception as exc:  # native path is supplementary; JAX is primary
+            self._native_info = {"error": str(exc)}
+
     # -- memory / health -------------------------------------------------------
     def hbm_stats(self) -> dict[str, Any]:
         per_device = []
@@ -215,6 +238,7 @@ class TPUClient:
             "mesh": dict(zip(self._mesh.axis_names, self._mesh.devices.shape)) if self._mesh else None,
             "executables": sorted(self._executables),
             "hbm": self.hbm_stats()["devices"],
+            "native_pjrt": self._native_info,
         }
         if self._last_error:
             details["last_error"] = self._last_error

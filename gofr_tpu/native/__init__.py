@@ -195,8 +195,9 @@ def _declare_pjrt(lib: ctypes.CDLL) -> None:
         "gofr_pjrt_device_count": (i32, [i64]),
         "gofr_pjrt_addressable_device_count": (i32, [i64]),
         "gofr_pjrt_device_ids": (i32, [i64, p_i64, i32]),
-        "gofr_pjrt_compile": (i64, [i64, vp, i64, cp]),
+        "gofr_pjrt_compile": (i64, [i64, vp, i64, cp, vp, i64]),
         "gofr_pjrt_executable_destroy": (i32, [i64]),
+        "gofr_pjrt_unload": (i32, [i64]),
         "gofr_pjrt_execute_f32": (
             i32,
             [i64, i64, ctypes.POINTER(ctypes.c_float), i64,

@@ -1,0 +1,206 @@
+"""Python face of the native PJRT C-API binding (native/pjrt/pjrt_dl.cc).
+
+``PjrtPlugin.load(path)`` dlopens a PJRT plugin — ``libtpu.so`` on TPU
+hosts, the built-in test stub otherwise — and exposes clients, device
+topology, AOT compile, and a single-device f32 execute used to validate
+the full buffer lifecycle. This is the native integration layer SURVEY.md
+§2.9 requires; the JAX path stays primary for compute, while this binding
+lets the runtime own executables without Python in the loop.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Any
+
+from gofr_tpu.native import (
+    ERROR_NAMES,
+    NativeError,
+    build_stub_plugin,
+    load_pjrt,
+)
+
+
+class PjrtError(RuntimeError):
+    pass
+
+
+_cache_lock = threading.Lock()
+_plugin_cache: dict[str, "PjrtPlugin"] = {}
+
+
+def _lib() -> Any:
+    lib = load_pjrt()
+    if lib is None:
+        raise PjrtError("native PJRT binding unavailable (no toolchain/headers)")
+    return lib
+
+
+_PJRT_CODES = (-8, -9)  # GOFR_E_PJRT / GOFR_E_DLOPEN carry a detail string
+
+
+def _check(lib: Any, code: int, what: str) -> int:
+    if code >= 0:
+        return code
+    if code in _PJRT_CODES:  # other codes would read a stale thread-local
+        detail = lib.gofr_pjrt_last_error().decode() or str(code)
+    else:
+        detail = ERROR_NAMES.get(code, str(code))
+    raise PjrtError(f"{what}: {detail}")
+
+
+def probe_plugin_path() -> str | None:
+    """Resolve a REAL PJRT plugin .so only: $TPU_PJRT_PLUGIN, then libtpu.
+    Never falls back to the test stub — production health must not report
+    a stub as a validated binding, and building the stub costs a compile."""
+    env = os.environ.get("TPU_PJRT_PLUGIN")
+    if env and os.path.exists(env):
+        return env
+    try:
+        import libtpu
+
+        path = os.path.join(os.path.dirname(libtpu.__file__), "libtpu.so")
+        if os.path.exists(path):
+            return path
+    except ImportError:
+        pass
+    return None
+
+
+def default_plugin_path() -> str | None:
+    """Like :func:`probe_plugin_path` but falls back to building the test
+    stub (dev/test convenience; CI's fake-PJRT tier)."""
+    return probe_plugin_path() or build_stub_plugin()
+
+
+class PjrtExecutable:
+    def __init__(self, client: "PjrtClient", handle: int) -> None:
+        self._client = client
+        self._h = handle
+        self._destroyed = False
+
+    def execute_f32(self, values: list[float], out_cap: int = 1 << 16) -> list[float]:
+        lib = self._client._lib
+        arr = (ctypes.c_float * len(values))(*values)
+        out = (ctypes.c_float * out_cap)()
+        n_out = ctypes.c_int64(0)
+        _check(
+            lib,
+            lib.gofr_pjrt_execute_f32(
+                self._client._h, self._h, arr, len(values), out, out_cap,
+                ctypes.byref(n_out),
+            ),
+            "execute",
+        )
+        return list(out[: n_out.value])
+
+    def destroy(self) -> None:
+        if not self._destroyed:
+            self._destroyed = True
+            self._client._lib.gofr_pjrt_executable_destroy(self._h)
+
+
+class PjrtClient:
+    def __init__(self, lib: Any, handle: int) -> None:
+        self._lib = lib
+        self._h = handle
+        self._closed = False
+
+    @property
+    def platform_name(self) -> str:
+        buf = ctypes.create_string_buffer(256)
+        _check(self._lib, self._lib.gofr_pjrt_platform_name(self._h, buf, 256),
+               "platform name")
+        return buf.value.decode()
+
+    @property
+    def device_count(self) -> int:
+        return _check(self._lib, self._lib.gofr_pjrt_device_count(self._h),
+                      "device count")
+
+    @property
+    def addressable_device_count(self) -> int:
+        return _check(
+            self._lib, self._lib.gofr_pjrt_addressable_device_count(self._h),
+            "addressable device count",
+        )
+
+    def device_ids(self) -> list[int]:
+        cap = max(self.device_count, 1)
+        buf = (ctypes.c_int64 * cap)()
+        n = _check(self._lib, self._lib.gofr_pjrt_device_ids(self._h, buf, cap),
+                   "device ids")
+        return list(buf[:n])
+
+    def compile(self, code: bytes, fmt: str = "mlir",
+                compile_options: bytes = b"") -> PjrtExecutable:
+        h = self._lib.gofr_pjrt_compile(
+            self._h, code, len(code), fmt.encode(),
+            compile_options or None, len(compile_options),
+        )
+        _check(self._lib, int(h), "compile")
+        return PjrtExecutable(self, int(h))
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._lib.gofr_pjrt_client_destroy(self._h)
+
+
+class PjrtPlugin:
+    """A loaded PJRT plugin (shared object exporting GetPjrtApi)."""
+
+    def __init__(self, lib: Any, handle: int, path: str) -> None:
+        self._lib = lib
+        self._h = handle
+        self.path = path
+
+    @classmethod
+    def load(cls, path: str | None = None) -> "PjrtPlugin":
+        """Load (or return the cached) plugin at ``path``. Loads are
+        memoized per path: a plugin stays resident for the process (dlopen
+        handles are not refcount-churned by reconnects)."""
+        lib = _lib()
+        resolved = path or default_plugin_path()
+        if resolved is None:
+            raise PjrtError("no PJRT plugin found (set TPU_PJRT_PLUGIN)")
+        with _cache_lock:
+            cached = _plugin_cache.get(resolved)
+            if cached is not None:
+                return cached
+            h = lib.gofr_pjrt_load(resolved.encode())
+            _check(lib, int(h), f"load plugin {resolved}")
+            plugin = cls(lib, int(h), resolved)
+            _plugin_cache[resolved] = plugin
+            return plugin
+
+    @property
+    def api_version(self) -> tuple[int, int]:
+        major = ctypes.c_int32(0)
+        minor = ctypes.c_int32(0)
+        _check(
+            self._lib,
+            self._lib.gofr_pjrt_api_version(
+                self._h, ctypes.byref(major), ctypes.byref(minor)
+            ),
+            "api version",
+        )
+        return major.value, minor.value
+
+    def create_client(self) -> PjrtClient:
+        h = self._lib.gofr_pjrt_client_create(self._h)
+        _check(self._lib, int(h), "client create")
+        return PjrtClient(self._lib, int(h))
+
+
+__all__ = [
+    "NativeError",
+    "PjrtClient",
+    "PjrtError",
+    "PjrtExecutable",
+    "PjrtPlugin",
+    "default_plugin_path",
+    "probe_plugin_path",
+]

@@ -1,0 +1,486 @@
+// Native PJRT C-API binding for the gofr_tpu `tpu` datasource.
+//
+// This is the component SURVEY.md §2.9 requires to be real native code:
+// a C++ binding that dlopens a PJRT plugin (libtpu.so on TPU hosts, the
+// test stub in CI — SURVEY §4's "fake PJRT client" tier), negotiates the
+// C API, and drives the full client lifecycle: client create, device
+// topology enumeration, program compile (StableHLO/MLIR or HLO bytes),
+// device buffer upload, execute, and result download. Python reaches it
+// over a flat C ABI via ctypes (gofr_tpu/native/__init__.py); the JAX
+// compute path is unaffected — this exists so the serving runtime can own
+// executables without a Python interpreter in the loop (and it is the
+// load-bearing integration for non-JAX frontends).
+//
+// Error model: functions return negative codes (matching gofr_runtime.cc)
+// or handles > 0; the PJRT error text of the most recent failure on the
+// calling thread is available via gofr_pjrt_last_error().
+
+#include <dlfcn.h>
+
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+#define GOFR_API extern "C" __attribute__((visibility("default")))
+
+enum GofrError : int32_t {
+  GOFR_OK = 0,
+  GOFR_E_BADHANDLE = -1,
+  GOFR_E_NOMEM = -2,
+  GOFR_E_NOTFOUND = -3,
+  GOFR_E_EXISTS = -4,
+  GOFR_E_QUEUEFULL = -5,
+  GOFR_E_ARG = -6,
+  GOFR_E_CAP = -7,
+  GOFR_E_PJRT = -8,    // PJRT call failed; see gofr_pjrt_last_error
+  GOFR_E_DLOPEN = -9,  // plugin load / symbol resolution failed
+};
+
+namespace {
+
+thread_local std::string g_last_error;
+
+struct Lib {
+  void* dl = nullptr;
+  const PJRT_Api* api = nullptr;
+};
+
+// Client/Exec live in shared_ptrs so a concurrent destroy cannot free the
+// struct under an in-flight call; `mu` serializes PJRT use vs. destroy and
+// `alive` turns use-after-destroy into a clean error instead of a UAF.
+struct Client {
+  std::mutex mu;
+  bool alive = true;
+  const PJRT_Api* api = nullptr;
+  PJRT_Client* client = nullptr;
+  std::vector<PJRT_Device*> devices;
+  std::vector<PJRT_Device*> addressable;
+};
+
+struct Exec {
+  std::mutex mu;
+  bool alive = true;
+  const PJRT_Api* api = nullptr;
+  PJRT_Client* client = nullptr;
+  PJRT_Device* device = nullptr;  // first addressable (single-device execute)
+  PJRT_LoadedExecutable* exec = nullptr;
+};
+
+std::mutex g_mu;
+std::unordered_map<int64_t, Lib> g_libs;
+std::unordered_map<int64_t, std::shared_ptr<Client>> g_clients;
+std::unordered_map<int64_t, std::shared_ptr<Exec>> g_execs;
+int64_t g_next = 1;
+
+// Converts a PJRT_Error (if any) into g_last_error; frees it. True on error.
+bool take_error(const PJRT_Api* api, PJRT_Error* err, const char* what) {
+  if (err == nullptr) return false;
+  PJRT_Error_Message_Args msg;
+  std::memset(&msg, 0, sizeof(msg));
+  msg.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  msg.error = err;
+  api->PJRT_Error_Message(&msg);
+  g_last_error = std::string(what) + ": " + std::string(msg.message, msg.message_size);
+  PJRT_Error_Destroy_Args d;
+  std::memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  d.error = err;
+  api->PJRT_Error_Destroy(&d);
+  return true;
+}
+
+// Awaits and destroys an event, capturing any error. True on error.
+bool await_event(const PJRT_Api* api, PJRT_Event* ev, const char* what) {
+  if (ev == nullptr) return false;
+  PJRT_Event_Await_Args aw;
+  std::memset(&aw, 0, sizeof(aw));
+  aw.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+  aw.event = ev;
+  PJRT_Error* err = api->PJRT_Event_Await(&aw);
+  bool failed = take_error(api, err, what);
+  PJRT_Event_Destroy_Args d;
+  std::memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+  d.event = ev;
+  api->PJRT_Event_Destroy(&d);
+  return failed;
+}
+
+void destroy_buffer(const PJRT_Api* api, PJRT_Buffer* buf) {
+  if (buf == nullptr) return;
+  PJRT_Buffer_Destroy_Args d;
+  std::memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+  d.buffer = buf;
+  take_error(api, api->PJRT_Buffer_Destroy(&d), "buffer destroy");
+}
+
+Lib* get_lib(int64_t h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_libs.find(h);
+  return it == g_libs.end() ? nullptr : &it->second;
+}
+
+std::shared_ptr<Client> get_client(int64_t h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_clients.find(h);
+  return it == g_clients.end() ? nullptr : it->second;
+}
+
+std::shared_ptr<Exec> get_exec(int64_t h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_execs.find(h);
+  return it == g_execs.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+GOFR_API const char* gofr_pjrt_last_error() { return g_last_error.c_str(); }
+
+// Load a PJRT plugin shared object and initialize it. Returns lib handle.
+GOFR_API int64_t gofr_pjrt_load(const char* path) {
+  void* dl = dlopen(path, RTLD_NOW | RTLD_LOCAL);
+  if (dl == nullptr) {
+    g_last_error = std::string("dlopen: ") + dlerror();
+    return GOFR_E_DLOPEN;
+  }
+  using GetPjrtApiFn = const PJRT_Api* (*)();
+  auto get_api = reinterpret_cast<GetPjrtApiFn>(dlsym(dl, "GetPjrtApi"));
+  if (get_api == nullptr) {
+    g_last_error = std::string("dlsym(GetPjrtApi): ") + dlerror();
+    dlclose(dl);
+    return GOFR_E_DLOPEN;
+  }
+  const PJRT_Api* api = get_api();
+  if (api == nullptr) {
+    g_last_error = "GetPjrtApi returned null";
+    dlclose(dl);
+    return GOFR_E_DLOPEN;
+  }
+  if (api->pjrt_api_version.major_version != PJRT_API_MAJOR) {
+    g_last_error = "PJRT major version mismatch: plugin " +
+                   std::to_string(api->pjrt_api_version.major_version) +
+                   " vs binding " + std::to_string(PJRT_API_MAJOR);
+    dlclose(dl);
+    return GOFR_E_PJRT;
+  }
+  PJRT_Plugin_Initialize_Args init;
+  std::memset(&init, 0, sizeof(init));
+  init.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+  if (take_error(api, api->PJRT_Plugin_Initialize(&init), "plugin init")) {
+    dlclose(dl);
+    return GOFR_E_PJRT;
+  }
+  std::lock_guard<std::mutex> g(g_mu);
+  int64_t h = g_next++;
+  g_libs[h] = Lib{dl, api};
+  return h;
+}
+
+GOFR_API int32_t gofr_pjrt_api_version(int64_t lib_h, int32_t* major, int32_t* minor) {
+  Lib* lib = get_lib(lib_h);
+  if (lib == nullptr) return GOFR_E_BADHANDLE;
+  if (major) *major = lib->api->pjrt_api_version.major_version;
+  if (minor) *minor = lib->api->pjrt_api_version.minor_version;
+  return GOFR_OK;
+}
+
+// Release a loaded plugin (dlclose). Any clients created from it must be
+// destroyed first; the caller owns that ordering.
+GOFR_API int32_t gofr_pjrt_unload(int64_t lib_h) {
+  Lib lib;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_libs.find(lib_h);
+    if (it == g_libs.end()) return GOFR_E_BADHANDLE;
+    lib = it->second;
+    g_libs.erase(it);
+  }
+  dlclose(lib.dl);
+  return GOFR_OK;
+}
+
+// Create a client on the loaded plugin. Returns client handle.
+GOFR_API int64_t gofr_pjrt_client_create(int64_t lib_h) {
+  Lib* lib = get_lib(lib_h);
+  if (lib == nullptr) return GOFR_E_BADHANDLE;
+  const PJRT_Api* api = lib->api;
+
+  PJRT_Client_Create_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  if (take_error(api, api->PJRT_Client_Create(&args), "client create"))
+    return GOFR_E_PJRT;
+
+  auto c = std::make_shared<Client>();
+  c->api = api;
+  c->client = args.client;
+
+  PJRT_Client_Devices_Args dv;
+  std::memset(&dv, 0, sizeof(dv));
+  dv.struct_size = PJRT_Client_Devices_Args_STRUCT_SIZE;
+  dv.client = c->client;
+  if (take_error(api, api->PJRT_Client_Devices(&dv), "devices")) return GOFR_E_PJRT;
+  c->devices.assign(dv.devices, dv.devices + dv.num_devices);
+
+  PJRT_Client_AddressableDevices_Args ad;
+  std::memset(&ad, 0, sizeof(ad));
+  ad.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  ad.client = c->client;
+  if (take_error(api, api->PJRT_Client_AddressableDevices(&ad), "addressable"))
+    return GOFR_E_PJRT;
+  c->addressable.assign(ad.addressable_devices,
+                        ad.addressable_devices + ad.num_addressable_devices);
+
+  std::lock_guard<std::mutex> g(g_mu);
+  int64_t h = g_next++;
+  g_clients[h] = std::move(c);
+  return h;
+}
+
+GOFR_API int32_t gofr_pjrt_client_destroy(int64_t client_h) {
+  std::shared_ptr<Client> c;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_clients.find(client_h);
+    if (it == g_clients.end()) return GOFR_E_BADHANDLE;
+    c = it->second;
+    g_clients.erase(it);
+  }
+  std::lock_guard<std::mutex> lk(c->mu);  // waits out in-flight calls
+  if (!c->alive) return GOFR_OK;
+  c->alive = false;
+  PJRT_Client_Destroy_Args d;
+  std::memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+  d.client = c->client;
+  if (take_error(c->api, c->api->PJRT_Client_Destroy(&d), "client destroy"))
+    return GOFR_E_PJRT;
+  return GOFR_OK;
+}
+
+GOFR_API int32_t gofr_pjrt_platform_name(int64_t client_h, char* out, int32_t cap) {
+  auto c = get_client(client_h);
+  if (c == nullptr) return GOFR_E_BADHANDLE;
+  std::lock_guard<std::mutex> lk(c->mu);
+  if (!c->alive) return GOFR_E_BADHANDLE;
+  PJRT_Client_PlatformName_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_PlatformName_Args_STRUCT_SIZE;
+  args.client = c->client;
+  if (take_error(c->api, c->api->PJRT_Client_PlatformName(&args), "platform name"))
+    return GOFR_E_PJRT;
+  if (static_cast<int32_t>(args.platform_name_size) + 1 > cap) return GOFR_E_CAP;
+  std::memcpy(out, args.platform_name, args.platform_name_size);
+  out[args.platform_name_size] = '\0';
+  return static_cast<int32_t>(args.platform_name_size);
+}
+
+GOFR_API int32_t gofr_pjrt_device_count(int64_t client_h) {
+  auto c = get_client(client_h);
+  if (c == nullptr) return GOFR_E_BADHANDLE;
+  std::lock_guard<std::mutex> lk(c->mu);
+  return c->alive ? static_cast<int32_t>(c->devices.size()) : GOFR_E_BADHANDLE;
+}
+
+GOFR_API int32_t gofr_pjrt_addressable_device_count(int64_t client_h) {
+  auto c = get_client(client_h);
+  if (c == nullptr) return GOFR_E_BADHANDLE;
+  std::lock_guard<std::mutex> lk(c->mu);
+  return c->alive ? static_cast<int32_t>(c->addressable.size()) : GOFR_E_BADHANDLE;
+}
+
+GOFR_API int32_t gofr_pjrt_device_ids(int64_t client_h, int64_t* out, int32_t cap) {
+  auto c = get_client(client_h);
+  if (c == nullptr) return GOFR_E_BADHANDLE;
+  std::lock_guard<std::mutex> lk(c->mu);
+  if (!c->alive) return GOFR_E_BADHANDLE;
+  if (static_cast<int32_t>(c->devices.size()) > cap) return GOFR_E_CAP;
+  const PJRT_Api* api = c->api;
+  int32_t n = 0;
+  for (PJRT_Device* dev : c->devices) {
+    PJRT_Device_GetDescription_Args gd;
+    std::memset(&gd, 0, sizeof(gd));
+    gd.struct_size = PJRT_Device_GetDescription_Args_STRUCT_SIZE;
+    gd.device = dev;
+    if (take_error(api, api->PJRT_Device_GetDescription(&gd), "device description"))
+      return GOFR_E_PJRT;
+    PJRT_DeviceDescription_Id_Args id;
+    std::memset(&id, 0, sizeof(id));
+    id.struct_size = PJRT_DeviceDescription_Id_Args_STRUCT_SIZE;
+    id.device_description = gd.device_description;
+    if (take_error(api, api->PJRT_DeviceDescription_Id(&id), "device id"))
+      return GOFR_E_PJRT;
+    out[n++] = id.id;
+  }
+  return n;
+}
+
+// Compile a program. `format` is "mlir" (StableHLO bytecode/text) or "hlo"
+// (serialized HloModuleProto); `options`/`options_size` carry a serialized
+// CompileOptionsProto (may be empty for plugins that accept defaults, e.g.
+// the test stub). Returns executable handle.
+GOFR_API int64_t gofr_pjrt_compile(int64_t client_h, const void* code,
+                                   int64_t code_size, const char* format,
+                                   const void* options, int64_t options_size) {
+  auto c = get_client(client_h);
+  if (c == nullptr) return GOFR_E_BADHANDLE;
+  if (code == nullptr || code_size <= 0 || format == nullptr) return GOFR_E_ARG;
+  std::lock_guard<std::mutex> lk(c->mu);
+  if (!c->alive) return GOFR_E_BADHANDLE;
+  if (c->addressable.empty()) {
+    g_last_error = "no addressable devices";
+    return GOFR_E_PJRT;
+  }
+  PJRT_Program program;
+  std::memset(&program, 0, sizeof(program));
+  program.struct_size = PJRT_Program_STRUCT_SIZE;
+  program.code = const_cast<char*>(static_cast<const char*>(code));
+  program.code_size = static_cast<size_t>(code_size);
+  program.format = format;
+  program.format_size = std::strlen(format);
+
+  PJRT_Client_Compile_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  args.client = c->client;
+  args.program = &program;
+  args.compile_options = static_cast<const char*>(options);
+  args.compile_options_size = static_cast<size_t>(options_size);
+  if (take_error(c->api, c->api->PJRT_Client_Compile(&args), "compile"))
+    return GOFR_E_PJRT;
+
+  auto e = std::make_shared<Exec>();
+  e->api = c->api;
+  e->client = c->client;
+  e->device = c->addressable[0];
+  e->exec = args.executable;
+  std::lock_guard<std::mutex> g(g_mu);
+  int64_t h = g_next++;
+  g_execs[h] = std::move(e);
+  return h;
+}
+
+GOFR_API int32_t gofr_pjrt_executable_destroy(int64_t exec_h) {
+  std::shared_ptr<Exec> e;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_execs.find(exec_h);
+    if (it == g_execs.end()) return GOFR_E_BADHANDLE;
+    e = it->second;
+    g_execs.erase(it);
+  }
+  std::lock_guard<std::mutex> lk(e->mu);  // waits out in-flight executes
+  if (!e->alive) return GOFR_OK;
+  e->alive = false;
+  PJRT_LoadedExecutable_Destroy_Args d;
+  std::memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+  d.executable = e->exec;
+  if (take_error(e->api, e->api->PJRT_LoadedExecutable_Destroy(&d), "exec destroy"))
+    return GOFR_E_PJRT;
+  return GOFR_OK;
+}
+
+// Single-device execute of a 1-D f32 program: uploads `input[n_in]` to the
+// first addressable device, runs, downloads the (single) output into
+// `output[out_cap]`, sets *n_out. The general multi-arg path stays inside
+// XLA executables; this entry point exercises and proves the full buffer
+// lifecycle (host->device, execute, event await, device->host, destroy).
+GOFR_API int32_t gofr_pjrt_execute_f32(int64_t client_h, int64_t exec_h,
+                                       const float* input, int64_t n_in,
+                                       float* output, int64_t out_cap,
+                                       int64_t* n_out) {
+  if (n_out) *n_out = 0;
+  auto c = get_client(client_h);
+  auto e = get_exec(exec_h);
+  if (c == nullptr || e == nullptr) return GOFR_E_BADHANDLE;
+  if (input == nullptr || n_in <= 0 || output == nullptr) return GOFR_E_ARG;
+  // lock order: client before exec (matches every other path; destroys each
+  // take a single lock, so holding both here serializes against them)
+  std::lock_guard<std::mutex> lkc(c->mu);
+  std::lock_guard<std::mutex> lke(e->mu);
+  if (!c->alive || !e->alive) return GOFR_E_BADHANDLE;
+  const PJRT_Api* api = e->api;
+
+  // 1. host -> device
+  int64_t dims[1] = {n_in};
+  PJRT_Client_BufferFromHostBuffer_Args up;
+  std::memset(&up, 0, sizeof(up));
+  up.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  up.client = c->client;
+  up.data = input;
+  up.type = PJRT_Buffer_Type_F32;
+  up.dims = dims;
+  up.num_dims = 1;
+  up.host_buffer_semantics = PJRT_HostBufferSemantics_kImmutableOnlyDuringCall;
+  up.device = e->device;
+  if (take_error(api, api->PJRT_Client_BufferFromHostBuffer(&up), "upload"))
+    return GOFR_E_PJRT;
+  if (await_event(api, up.done_with_host_buffer, "upload event")) {
+    destroy_buffer(api, up.buffer);
+    return GOFR_E_PJRT;
+  }
+
+  // 2. execute (1 device, 1 arg, 1 output)
+  PJRT_ExecuteOptions opts;
+  std::memset(&opts, 0, sizeof(opts));
+  opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+
+  PJRT_Buffer* arg_list[1] = {up.buffer};
+  PJRT_Buffer* const* argument_lists[1] = {arg_list};
+  PJRT_Buffer* out_list[1] = {nullptr};
+  PJRT_Buffer** output_lists[1] = {out_list};
+  PJRT_Event* done[1] = {nullptr};
+
+  PJRT_LoadedExecutable_Execute_Args ex;
+  std::memset(&ex, 0, sizeof(ex));
+  ex.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  ex.executable = e->exec;
+  ex.options = &opts;
+  ex.argument_lists = argument_lists;
+  ex.num_devices = 1;
+  ex.num_args = 1;
+  ex.output_lists = output_lists;
+  ex.device_complete_events = done;
+  ex.execute_device = e->device;
+  bool failed = take_error(api, api->PJRT_LoadedExecutable_Execute(&ex), "execute");
+  destroy_buffer(api, up.buffer);
+  if (failed) return GOFR_E_PJRT;
+  if (await_event(api, done[0], "execute event")) {
+    destroy_buffer(api, out_list[0]);
+    return GOFR_E_PJRT;
+  }
+
+  // 3. device -> host (query size, then copy)
+  PJRT_Buffer_ToHostBuffer_Args dn;
+  std::memset(&dn, 0, sizeof(dn));
+  dn.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+  dn.src = out_list[0];
+  dn.dst = nullptr;
+  if (take_error(api, api->PJRT_Buffer_ToHostBuffer(&dn), "output size")) {
+    destroy_buffer(api, out_list[0]);
+    return GOFR_E_PJRT;
+  }
+  if (dn.event != nullptr) await_event(api, dn.event, "size query event");
+  size_t need = dn.dst_size;
+  dn.event = nullptr;
+  if (need > static_cast<size_t>(out_cap) * sizeof(float)) {
+    destroy_buffer(api, out_list[0]);
+    return GOFR_E_CAP;
+  }
+  dn.dst = output;
+  dn.dst_size = need;
+  failed = take_error(api, api->PJRT_Buffer_ToHostBuffer(&dn), "download");
+  if (!failed) failed = await_event(api, dn.event, "download event");
+  destroy_buffer(api, out_list[0]);
+  if (failed) return GOFR_E_PJRT;
+  if (n_out) *n_out = static_cast<int64_t>(need / sizeof(float));
+  return GOFR_OK;
+}

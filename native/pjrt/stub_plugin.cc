@@ -1,0 +1,244 @@
+// Test-only stub PJRT plugin (SURVEY.md §4: the "fake PJRT client" test
+// tier — CI must exercise the native binding's full lifecycle without TPU
+// hardware, the way the reference tests run against gomock fakes).
+//
+// Implements exactly the slice of the PJRT C API that pjrt_dl.cc drives:
+// plugin init, client create/destroy, device enumeration (GOFR_STUB_DEVICES,
+// default 8), compile (program bytes are retained; any format accepted),
+// buffer upload/download, and execute with deterministic semantics:
+// the single f32 output is the single f32 input with every element
+// multiplied by 2 — so a test can prove bytes really crossed the
+// host->device->execute->host path rather than being echoed.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+// The stub owns the opaque types the header forward-declares.
+struct PJRT_Error {
+  std::string message;
+  PJRT_Error_Code code = PJRT_Error_Code_UNKNOWN;
+};
+
+struct PJRT_DeviceDescription {
+  int id = 0;
+};
+
+struct PJRT_Device {
+  PJRT_DeviceDescription desc;
+};
+
+struct PJRT_Client {
+  std::vector<PJRT_Device> device_storage;
+  std::vector<PJRT_Device*> devices;
+  std::string platform = "gofr_stub";
+};
+
+struct PJRT_LoadedExecutable {
+  std::string code;
+  std::string format;
+};
+
+struct PJRT_Buffer {
+  std::vector<float> data;
+  std::vector<int64_t> dims;
+};
+
+struct PJRT_Event {
+  PJRT_Error* error = nullptr;  // ownership transferred on Await
+};
+
+namespace {
+
+PJRT_Error* make_error(const char* msg) {
+  auto* e = new PJRT_Error();
+  e->message = msg;
+  return e;
+}
+
+// ---- error / event -------------------------------------------------------
+void ErrorDestroy(PJRT_Error_Destroy_Args* args) { delete args->error; }
+
+void ErrorMessage(PJRT_Error_Message_Args* args) {
+  args->message = args->error->message.c_str();
+  args->message_size = args->error->message.size();
+}
+
+PJRT_Error* ErrorGetCode(PJRT_Error_GetCode_Args* args) {
+  args->code = args->error->code;
+  return nullptr;
+}
+
+PJRT_Error* PluginInitialize(PJRT_Plugin_Initialize_Args*) { return nullptr; }
+
+PJRT_Error* EventDestroy(PJRT_Event_Destroy_Args* args) {
+  if (args->event != nullptr) delete args->event->error;
+  delete args->event;
+  return nullptr;
+}
+
+PJRT_Error* EventIsReady(PJRT_Event_IsReady_Args* args) {
+  args->is_ready = true;
+  return nullptr;
+}
+
+PJRT_Error* EventAwait(PJRT_Event_Await_Args* args) {
+  PJRT_Error* err = args->event->error;
+  args->event->error = nullptr;  // caller frees via Error_Destroy
+  return err;
+}
+
+// ---- client --------------------------------------------------------------
+PJRT_Error* ClientCreate(PJRT_Client_Create_Args* args) {
+  auto* c = new PJRT_Client();
+  int n = 8;
+  if (const char* env = std::getenv("GOFR_STUB_DEVICES")) n = std::atoi(env);
+  if (n <= 0) n = 1;
+  c->device_storage.resize(n);
+  for (int i = 0; i < n; ++i) {
+    c->device_storage[i].desc.id = i;
+    c->devices.push_back(&c->device_storage[i]);
+  }
+  args->client = c;
+  return nullptr;
+}
+
+PJRT_Error* ClientDestroy(PJRT_Client_Destroy_Args* args) {
+  delete args->client;
+  return nullptr;
+}
+
+PJRT_Error* ClientPlatformName(PJRT_Client_PlatformName_Args* args) {
+  args->platform_name = args->client->platform.c_str();
+  args->platform_name_size = args->client->platform.size();
+  return nullptr;
+}
+
+PJRT_Error* ClientDevices(PJRT_Client_Devices_Args* args) {
+  args->devices = args->client->devices.data();
+  args->num_devices = args->client->devices.size();
+  return nullptr;
+}
+
+PJRT_Error* ClientAddressableDevices(PJRT_Client_AddressableDevices_Args* args) {
+  args->addressable_devices = args->client->devices.data();
+  args->num_addressable_devices = args->client->devices.size();
+  return nullptr;
+}
+
+PJRT_Error* ClientCompile(PJRT_Client_Compile_Args* args) {
+  if (args->program == nullptr || args->program->code_size == 0)
+    return make_error("stub compile: empty program");
+  auto* e = new PJRT_LoadedExecutable();
+  e->code.assign(args->program->code, args->program->code_size);
+  e->format.assign(args->program->format, args->program->format_size);
+  args->executable = e;
+  return nullptr;
+}
+
+PJRT_Error* ClientBufferFromHostBuffer(PJRT_Client_BufferFromHostBuffer_Args* args) {
+  if (args->type != PJRT_Buffer_Type_F32)
+    return make_error("stub supports only F32 buffers");
+  int64_t n = 1;
+  for (size_t i = 0; i < args->num_dims; ++i) n *= args->dims[i];
+  auto* b = new PJRT_Buffer();
+  b->dims.assign(args->dims, args->dims + args->num_dims);
+  b->data.resize(n);
+  std::memcpy(b->data.data(), args->data, n * sizeof(float));
+  args->buffer = b;
+  args->done_with_host_buffer = new PJRT_Event();
+  return nullptr;
+}
+
+// ---- device --------------------------------------------------------------
+PJRT_Error* DeviceGetDescription(PJRT_Device_GetDescription_Args* args) {
+  args->device_description = &args->device->desc;
+  return nullptr;
+}
+
+PJRT_Error* DeviceDescriptionId(PJRT_DeviceDescription_Id_Args* args) {
+  args->id = args->device_description->id;
+  return nullptr;
+}
+
+// ---- executable ----------------------------------------------------------
+PJRT_Error* LoadedExecutableDestroy(PJRT_LoadedExecutable_Destroy_Args* args) {
+  delete args->executable;
+  return nullptr;
+}
+
+PJRT_Error* LoadedExecutableExecute(PJRT_LoadedExecutable_Execute_Args* args) {
+  if (args->num_devices != 1 || args->num_args != 1)
+    return make_error("stub executes 1 device x 1 arg only");
+  const PJRT_Buffer* in = args->argument_lists[0][0];
+  auto* out = new PJRT_Buffer();
+  out->dims = in->dims;
+  out->data.resize(in->data.size());
+  for (size_t i = 0; i < in->data.size(); ++i) out->data[i] = in->data[i] * 2.0f;
+  args->output_lists[0][0] = out;
+  if (args->device_complete_events != nullptr)
+    args->device_complete_events[0] = new PJRT_Event();
+  return nullptr;
+}
+
+// ---- buffer --------------------------------------------------------------
+PJRT_Error* BufferDestroy(PJRT_Buffer_Destroy_Args* args) {
+  delete args->buffer;
+  return nullptr;
+}
+
+PJRT_Error* BufferToHostBuffer(PJRT_Buffer_ToHostBuffer_Args* args) {
+  size_t need = args->src->data.size() * sizeof(float);
+  if (args->dst == nullptr) {
+    args->dst_size = need;
+    args->event = nullptr;
+    return nullptr;
+  }
+  if (args->dst_size < need) return make_error("stub download: dst too small");
+  std::memcpy(args->dst, args->src->data.data(), need);
+  args->event = new PJRT_Event();
+  return nullptr;
+}
+
+PJRT_Api make_api() {
+  PJRT_Api api;
+  std::memset(&api, 0, sizeof(api));
+  api.struct_size = PJRT_Api_STRUCT_SIZE;
+  api.pjrt_api_version.struct_size = PJRT_Api_Version_STRUCT_SIZE;
+  api.pjrt_api_version.major_version = PJRT_API_MAJOR;
+  api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+
+  api.PJRT_Error_Destroy = ErrorDestroy;
+  api.PJRT_Error_Message = ErrorMessage;
+  api.PJRT_Error_GetCode = ErrorGetCode;
+  api.PJRT_Plugin_Initialize = PluginInitialize;
+  api.PJRT_Event_Destroy = EventDestroy;
+  api.PJRT_Event_IsReady = EventIsReady;
+  api.PJRT_Event_Await = EventAwait;
+  api.PJRT_Client_Create = ClientCreate;
+  api.PJRT_Client_Destroy = ClientDestroy;
+  api.PJRT_Client_PlatformName = ClientPlatformName;
+  api.PJRT_Client_Devices = ClientDevices;
+  api.PJRT_Client_AddressableDevices = ClientAddressableDevices;
+  api.PJRT_Client_Compile = ClientCompile;
+  api.PJRT_Client_BufferFromHostBuffer = ClientBufferFromHostBuffer;
+  api.PJRT_Device_GetDescription = DeviceGetDescription;
+  api.PJRT_DeviceDescription_Id = DeviceDescriptionId;
+  api.PJRT_LoadedExecutable_Destroy = LoadedExecutableDestroy;
+  api.PJRT_LoadedExecutable_Execute = LoadedExecutableExecute;
+  api.PJRT_Buffer_Destroy = BufferDestroy;
+  api.PJRT_Buffer_ToHostBuffer = BufferToHostBuffer;
+  return api;
+}
+
+PJRT_Api g_api = make_api();
+
+}  // namespace
+
+extern "C" __attribute__((visibility("default"))) const PJRT_Api* GetPjrtApi() {
+  return &g_api;
+}
