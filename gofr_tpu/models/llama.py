@@ -306,6 +306,74 @@ def decode_step(
     return logits, cache
 
 
+@partial(jax.jit, static_argnums=0, donate_argnums=(3, 4))
+def decode_step_paged(
+    cfg: LlamaConfig,
+    params: dict,
+    tokens: jnp.ndarray,  # [B] last sampled token per row
+    k_pool: jnp.ndarray,  # [L, N_pages, Hkv, page, Dh] donated
+    v_pool: jnp.ndarray,  # donated
+    block_tables: jnp.ndarray,  # [B, M] int32
+    seq_lens: jnp.ndarray,  # [B] length INCLUDING this token's position
+    active: jnp.ndarray,  # [B] bool — inactive rows must not write live pages
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One decode step over the paged KV pool (serving/kv_cache.py):
+    appends this step's K/V into each active row's current page slot and
+    attends through the block tables (ops/paged_attention.py). Inactive
+    rows write into the pool's LAST page (the trash page the cache manager
+    reserves) so the scatter never collides with a live page, and their
+    attention output is garbage the host ignores."""
+    B = tokens.shape[0]
+    page = k_pool.shape[3]
+    trash_page = k_pool.shape[1] - 1  # reserved by PagedKVCache
+    H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    x = params["embedding"][tokens][:, None, :].astype(cfg.dtype)  # [B, 1, D]
+    pos = jnp.maximum(seq_lens - 1, 0)  # [B]
+    positions = pos[:, None]
+    sin, cos = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    b_idx = jnp.arange(B)
+    pages = jnp.where(active, block_tables[b_idx, pos // page], trash_page)  # [B]
+    offsets = jnp.where(active, pos % page, 0)
+
+    use_kernel = jax.default_backend() == "tpu"
+
+    def body(h, xs):
+        lp, kc, vc = xs  # kc/vc: [N_pages, Hkv, page, Dh]
+        hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps)
+        q = (hn @ lp["wq"]).reshape(B, 1, H, Dh)
+        k = (hn @ lp["wk"]).reshape(B, 1, Hkv, Dh)
+        v = (hn @ lp["wv"]).reshape(B, 1, Hkv, Dh)
+        q = apply_rope(q, positions, sin, cos)[:, 0]  # [B, H, Dh]
+        k = apply_rope(k, positions, sin, cos)[:, 0]  # [B, Hkv, Dh]
+        v = v[:, 0]
+
+        # append: inactive rows were redirected to the trash page, so the
+        # scatter is conflict-free across rows (each active row's decode
+        # position is a distinct (page, offset)).
+        # kc.at[pages, :, offsets] (advanced idx split by a slice) -> [B, Hkv, Dh]
+        kc = kc.at[pages, :, offsets].set(k)
+        vc = vc.at[pages, :, offsets].set(v)
+
+        if use_kernel:
+            from gofr_tpu.ops.paged_attention import paged_decode_attention
+
+            attn = paged_decode_attention(q, kc, vc, block_tables, seq_lens)
+        else:
+            from gofr_tpu.ops.paged_attention import paged_decode_attention_ref
+
+            attn = paged_decode_attention_ref(q, kc, vc, block_tables, seq_lens)
+
+        h = h + attn.reshape(B, 1, H * Dh) @ lp["wo"]
+        hn = rms_norm(h, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu((hn @ lp["w_gate"]).astype(jnp.float32)).astype(hn.dtype)
+        h = h + (gate * (hn @ lp["w_up"])) @ lp["w_down"]
+        return h, (kc, vc)
+
+    x, (k_pool, v_pool) = jax.lax.scan(body, x, (params["layers"], k_pool, v_pool))
+    logits = _logits(cfg, params, x)[:, 0]  # [B, V]
+    return logits, k_pool, v_pool
+
+
 def greedy_generate(
     cfg: LlamaConfig,
     params: dict,

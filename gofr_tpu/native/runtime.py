@@ -104,6 +104,8 @@ class BlockAllocator:
         return _check(int(self._lib.gofr_ba_seq_length(self._h, seq_id)), "seq_length")
 
     def stats(self) -> dict[str, int]:
+        if self._closed:  # post-shutdown health checks must not hit a dead handle
+            return dict(self._last_stats)
         if self._lib is None:
             return self._py.stats()
         out = (ctypes.c_int64 * 4)()
@@ -119,6 +121,13 @@ class BlockAllocator:
         with self._mu:
             if self._closed:
                 return
+            try:
+                self._last_stats = self.stats()
+            except Exception:
+                self._last_stats = {
+                    "free_blocks": 0, "total_blocks": self.num_blocks,
+                    "sequences": 0, "alloc_failures": 0,
+                }
             self._closed = True
         if self._lib is not None:
             self._lib.gofr_ba_destroy(self._h)

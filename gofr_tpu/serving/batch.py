@@ -83,6 +83,34 @@ def decode_and_sample(
     return next_token, cache, rng
 
 
+@partial(jax.jit, static_argnums=0, donate_argnums=(2, 3))
+def decode_and_sample_paged(
+    cfg: llama.LlamaConfig,
+    params: dict,
+    k_pool: jnp.ndarray,  # [L, N_pages, page, Hkv, Dh] donated
+    v_pool: jnp.ndarray,  # donated
+    block_tables: jnp.ndarray,  # [B, M]
+    seq_lens: jnp.ndarray,  # [B] length incl. this token (>=1 when active)
+    last_token: jnp.ndarray,  # [B]
+    active: jnp.ndarray,  # [B] bool
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+    rng: jax.Array,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jax.Array]:
+    """Paged-cache twin of :func:`decode_and_sample`: one step over the
+    page pool (llama.decode_step_paged), per-slot sampling."""
+    step_len = jnp.where(active, jnp.maximum(seq_lens, 1), 1)
+    logits, k_pool, v_pool = llama.decode_step_paged(
+        cfg, params, last_token, k_pool, v_pool, block_tables, step_len, active
+    )
+    rng, sample_key = jax.random.split(rng)
+    next_token = sample_logits(
+        logits, sample_key, temperature=temperature, top_k=top_k, top_p=top_p
+    )
+    return next_token, k_pool, v_pool, rng
+
+
 def pad_bucket(length: int, buckets: tuple[int, ...]) -> int:
     """Smallest bucket ≥ length (prompt padding, limits recompiles)."""
     for b in buckets:

@@ -44,9 +44,15 @@ class EngineConfig:
     admission_per_step: int = 4  # prefills between decode steps (TTFT vs TPOT)
     prefill_token_budget: int = 4096  # prompt tokens admitted per step
     idle_sleep_s: float = 0.002
+    # KV layout: "dense" reserves [slots, max_seq] rows; "paged" commits HBM
+    # by resident tokens through the pooled page table (serving/kv_cache.py)
+    kv_layout: str = "dense"
+    kv_page_size: int = 16
+    kv_num_pages: int | None = None  # default: slots*max_seq worth of pages
 
     @classmethod
     def from_config(cls, config: Any) -> "EngineConfig":
+        num_pages = config.get("TPU_KV_NUM_PAGES")
         return cls(
             max_slots=int(config.get_or_default("TPU_BATCH_MAX_SLOTS", "8")),
             max_seq_len=int(config.get_or_default("TPU_BATCH_MAX_TOKENS", "1024")),
@@ -54,6 +60,9 @@ class EngineConfig:
             prefill_token_budget=int(
                 config.get_or_default("TPU_BATCH_PREFILL_BUDGET", "4096")
             ),
+            kv_layout=config.get_or_default("TPU_KV_LAYOUT", "dense"),
+            kv_page_size=int(config.get_or_default("TPU_KV_PAGE_SIZE", "16")),
+            kv_num_pages=int(num_pages) if num_pages else None,
         )
 
 
@@ -69,11 +78,16 @@ class GenerationResult:
     duration_s: float
 
 
+class _RequeueRequest(Exception):
+    """Raised inside _prefill_into when a transient resource (KV pages) is
+    unavailable: the request goes back to the queue head, not to an error."""
+
+
 class _Request:
     __slots__ = (
         "id", "prompt_ids", "max_new_tokens", "temperature", "top_k", "top_p",
         "stream_cb", "future", "created", "first_token_at", "tokens", "slot",
-        "canceled", "stop_ids",
+        "canceled", "stop_ids", "priority",
     )
 
     def __init__(self, rid: int, prompt_ids: list[int], max_new_tokens: int,
@@ -93,6 +107,7 @@ class _Request:
         self.slot: int | None = None
         self.canceled = False
         self.stop_ids = stop_ids
+        self.priority = 0
 
 
 class ServingEngine:
@@ -119,7 +134,19 @@ class ServingEngine:
         self._tracer = tracer
 
         B, S = self.config.max_slots, self.config.max_seq_len
-        self.cache = llama.KVCache.create(cfg, B, max_len=S)
+        if self.config.kv_layout == "paged":
+            from gofr_tpu.serving.kv_cache import PagedKVCache
+
+            page = self.config.kv_page_size
+            num_pages = self.config.kv_num_pages or (B * S + page - 1) // page
+            self.paged_cache = PagedKVCache(
+                cfg, num_pages=num_pages, page_size=page,
+                max_slots=B, max_seq_len=S,
+            )
+            self.cache = None
+        else:
+            self.paged_cache = None
+            self.cache = llama.KVCache.create(cfg, B, max_len=S)
         self.cache_len = np.zeros(B, np.int32)  # host copy (authoritative)
         self.last_token = np.zeros(B, np.int32)
         self.temperature = np.ones(B, np.float32)
@@ -162,20 +189,23 @@ class ServingEngine:
             self._thread.join(timeout=10)
             self._thread = None
         self._sched.close()
+        if self.paged_cache is not None:
+            self.paged_cache.close()
 
     def health_check(self) -> dict[str, Any]:
         active = sum(1 for s in self.slots if s is not None)
         stats = self._sched.stats()
-        return {
-            "status": "UP" if self._running else "DOWN",
-            "details": {
-                "slots_active": active,
-                "slots_total": self.config.max_slots,
-                "queue_depth": stats["queue_depth"],
-                "scheduler_backend": self._sched.backend,
-                "total_admitted": stats["total_admitted"],
-            },
+        details: dict[str, Any] = {
+            "slots_active": active,
+            "slots_total": self.config.max_slots,
+            "queue_depth": stats["queue_depth"],
+            "scheduler_backend": self._sched.backend,
+            "total_admitted": stats["total_admitted"],
+            "kv_layout": self.config.kv_layout,
         }
+        if self.paged_cache is not None and self._running:
+            details["kv_pages"] = self.paged_cache.stats()
+        return {"status": "UP" if self._running else "DOWN", "details": details}
 
     # ------------------------------------------------------------- submission
     def submit(
@@ -212,6 +242,7 @@ class ServingEngine:
             rid, prompt_ids, max_new, temperature, top_k, top_p, stream_cb, future,
             stop_ids={self.tokenizer.eos_id},
         )
+        req.priority = priority
         with self._count_lock:
             self._by_id[rid] = req
         try:
@@ -312,10 +343,33 @@ class ServingEngine:
                 continue
             try:
                 self._prefill_into(slot, req)
+            except _RequeueRequest:
+                # transient (KV pages exhausted): this request goes back to
+                # the queue at its priority; the REST of the admitted batch
+                # still proceeds — their slots are already claimed and the
+                # scheduler never re-delivers an admitted pair
+                self._sched.release(slot)
+                try:
+                    self._sched.submit(
+                        rid, len(req.prompt_ids), req.max_new_tokens, req.priority
+                    )
+                except Exception:
+                    with self._count_lock:
+                        self._by_id.pop(rid, None)
+                    if not req.future.done():
+                        req.future.set_exception(
+                            ErrorTooManyRequests()
+                        )
             except Exception as exc:
-                # a failed prefill must not leak the slot or hang the client
+                # a failed prefill must not leak the slot, its KV pages, or
+                # hang the client
                 self.slots[slot] = None
                 self.cache_len[slot] = 0
+                if self.paged_cache is not None:
+                    try:
+                        self.paged_cache.free_slot(slot)
+                    except Exception:
+                        pass
                 try:
                     self._sched.release(slot)
                 except KeyError:
@@ -337,14 +391,30 @@ class ServingEngine:
         tokens[0, :S] = req.prompt_ids
         seq_len = jnp.array([S], jnp.int32)
 
+        if self.paged_cache is not None:
+            # page reservation first: OutOfBlocks must requeue BEFORE any
+            # device work (the request keeps its place; pool pressure is a
+            # transient, not an error)
+            from gofr_tpu.serving.kv_cache import OutOfBlocks
+
+            try:
+                self.paged_cache.alloc_slot(
+                    slot, seq_id=req.id, prompt_len=S, reserve_tokens=bucket
+                )
+            except OutOfBlocks:
+                raise _RequeueRequest() from None
+
         span = self._span(f"serve.prefill b{bucket}")
         with span:
             last_logits, k_slab, v_slab = batch_ops.prefill_compute(
                 cfg, self.params, jnp.asarray(tokens), seq_len
             )
-            self.cache.k, self.cache.v = batch_ops.insert_slot(
-                self.cache.k, self.cache.v, k_slab, v_slab, jnp.int32(slot)
-            )
+            if self.paged_cache is not None:
+                self.paged_cache.write_prefill(slot, k_slab, v_slab)
+            else:
+                self.cache.k, self.cache.v = batch_ops.insert_slot(
+                    self.cache.k, self.cache.v, k_slab, v_slab, jnp.int32(slot)
+                )
             # sample the first token with this request's params
             self.rng, key = jax.random.split(self.rng)
             from gofr_tpu.ops.sampling import sample_logits
@@ -379,21 +449,60 @@ class ServingEngine:
     # -- decode ----------------------------------------------------------------
     def _decode_step(self) -> None:
         cfg = self.model_cfg
-        active_mask = np.array([s is not None for s in self.slots])
         step_start = time.perf_counter()
 
-        next_token, self.cache, self.rng = batch_ops.decode_and_sample(
-            cfg,
-            self.params,
-            self.cache,
-            jnp.asarray(self.last_token),
-            jnp.asarray(np.maximum(self.cache_len, 1)),
-            jnp.asarray(active_mask),
-            jnp.asarray(self.temperature),
-            jnp.asarray(self.top_k),
-            jnp.asarray(self.top_p),
-            self.rng,
-        )
+        if self.paged_cache is not None:
+            # account the new position first; a pool-exhausted row retires
+            # with what it has (finish_reason "length") instead of stalling
+            # the whole batch
+            from gofr_tpu.serving.kv_cache import OutOfBlocks
+
+            for slot, req in enumerate(self.slots):
+                if req is None:
+                    continue
+                try:
+                    self.paged_cache.extend_slot(slot)
+                except OutOfBlocks:
+                    if self._logger:
+                        self._logger.warn(
+                            f"KV pool exhausted; retiring request {req.id} early"
+                        )
+                    self._retire(slot, "length")
+            active_mask = np.array([s is not None for s in self.slots])
+            if not active_mask.any():
+                return
+            pc = self.paged_cache
+            (next_token, pc.k_pool, pc.v_pool, self.rng) = (
+                batch_ops.decode_and_sample_paged(
+                    cfg,
+                    self.params,
+                    pc.k_pool,
+                    pc.v_pool,
+                    pc.tables_device(),
+                    pc.seq_lens_device(),
+                    jnp.asarray(self.last_token),
+                    jnp.asarray(active_mask),
+                    jnp.asarray(self.temperature),
+                    jnp.asarray(self.top_k),
+                    jnp.asarray(self.top_p),
+                    self.rng,
+                )
+            )
+            self.cache_len = np.array(pc.seq_lens)
+        else:
+            active_mask = np.array([s is not None for s in self.slots])
+            next_token, self.cache, self.rng = batch_ops.decode_and_sample(
+                cfg,
+                self.params,
+                self.cache,
+                jnp.asarray(self.last_token),
+                jnp.asarray(np.maximum(self.cache_len, 1)),
+                jnp.asarray(active_mask),
+                jnp.asarray(self.temperature),
+                jnp.asarray(self.top_k),
+                jnp.asarray(self.top_p),
+                self.rng,
+            )
         next_ids = np.asarray(next_token)
         step_time = time.perf_counter() - step_start
 
@@ -402,7 +511,8 @@ class ServingEngine:
             if req is None:
                 continue
             n_active += 1
-            self.cache_len[slot] += 1
+            if self.paged_cache is None:
+                self.cache_len[slot] += 1
             token_id = int(next_ids[slot])
             self.last_token[slot] = token_id
             self._emit_token(req, token_id)
@@ -438,6 +548,8 @@ class ServingEngine:
         req = self.slots[slot]
         self.slots[slot] = None
         self.cache_len[slot] = 0
+        if self.paged_cache is not None:
+            self.paged_cache.free_slot(slot)
         try:
             self._sched.release(slot)
         except KeyError:
@@ -473,6 +585,11 @@ class ServingEngine:
             if req is not None:
                 self.slots[slot] = None
                 self.cache_len[slot] = 0
+                if self.paged_cache is not None:
+                    try:
+                        self.paged_cache.free_slot(slot)
+                    except Exception:
+                        pass
                 try:
                     self._sched.release(slot)
                 except KeyError:

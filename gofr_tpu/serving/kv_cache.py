@@ -1,0 +1,163 @@
+"""Paged KV cache: pooled pages + native block-table accounting.
+
+Replaces per-slot dense KV rows ([slots, max_seq] preallocation) with a
+shared page pool ([L, N_pages, page, Hkv, Dh]): sequences own pages
+through the native BlockAllocator (native/runtime/gofr_runtime.cc — the
+refcounted allocator with copy-on-write forks), so HBM is committed by
+tokens actually resident, not by worst-case slots. SURVEY §5.7 lever (a).
+
+Host side (this class): page accounting, block tables, seq lens.
+Device side (jitted helpers below): scatter prefilled slabs into owned
+pages, append one token per active row per decode step, and the paged
+attention read path (ops/paged_attention.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gofr_tpu.native.runtime import BlockAllocator, OutOfBlocks
+
+__all__ = ["PagedKVCache", "OutOfBlocks"]
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _write_pages(
+    k_pool: jnp.ndarray,  # [L, N, Hkv, page, Dh] donated
+    v_pool: jnp.ndarray,
+    k_slab: jnp.ndarray,  # [L, S_pad, Hkv, Dh] (S_pad = n_pages*page)
+    v_slab: jnp.ndarray,
+    page_ids: jnp.ndarray,  # [n_pages] int32
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    L, S_pad, Hkv, Dh = k_slab.shape
+    n_pages = page_ids.shape[0]
+    page = S_pad // n_pages
+    # [L, n_pages, Hkv, page, Dh] to match the pool's kernel-friendly layout
+    k_pages = k_slab.reshape(L, n_pages, page, Hkv, Dh).transpose(0, 1, 3, 2, 4)
+    v_pages = v_slab.reshape(L, n_pages, page, Hkv, Dh).transpose(0, 1, 3, 2, 4)
+    return (
+        k_pool.at[:, page_ids].set(k_pages),
+        v_pool.at[:, page_ids].set(v_pages),
+    )
+
+
+class PagedKVCache:
+    """Owns the device page pool + host page accounting for up to
+    ``max_slots`` concurrent sequences."""
+
+    def __init__(
+        self,
+        cfg: Any,  # LlamaConfig-shaped (n_layers, n_kv_heads, head_dim)
+        *,
+        num_pages: int,
+        page_size: int = 16,
+        max_slots: int = 8,
+        max_seq_len: int = 1024,
+        dtype: Any = None,
+    ) -> None:
+        self.cfg = cfg
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.max_slots = max_slots
+        self.max_seq_len = max_seq_len
+        self.max_pages_per_seq = (max_seq_len + page_size - 1) // page_size
+        dtype = dtype or cfg.dtype
+        # [L, N+1, Hkv, page, Dh]: trailing (page, Dh) are full dims in the
+        # pallas BlockSpecs (ops/paged_attention.py) — Mosaic tiling rule.
+        # The extra LAST page is the trash page: inactive rows' decode
+        # appends are redirected there (llama.decode_step_paged), so the
+        # scatter never has conflicting writes to a live page.
+        shape = (cfg.n_layers, num_pages + 1, cfg.n_kv_heads, page_size, cfg.head_dim)
+        self.k_pool = jnp.zeros(shape, dtype)
+        self.v_pool = jnp.zeros(shape, dtype)
+        self.allocator = BlockAllocator(num_pages, page_size)
+        # host mirrors (authoritative): per-slot block table + length
+        self.tables = np.zeros((max_slots, self.max_pages_per_seq), np.int32)
+        self.seq_lens = np.zeros(max_slots, np.int32)
+        self._slot_seq: list[int | None] = [None] * max_slots
+
+    # ------------------------------------------------------------- accounting
+    def alloc_slot(
+        self, slot: int, seq_id: int, prompt_len: int,
+        reserve_tokens: int | None = None,
+    ) -> None:
+        """Reserve pages for a prompt (``reserve_tokens`` ≥ prompt_len when
+        prefill buckets pad past the prompt). Raises OutOfBlocks (caller
+        keeps the request queued) without touching slot state on failure.
+        The allocator tracks RESERVED capacity; true length lives in
+        ``seq_lens``."""
+        if self._slot_seq[slot] is not None:
+            raise KeyError(f"slot {slot} busy")
+        self.allocator.alloc(seq_id, max(prompt_len, reserve_tokens or 0))
+        table = self.allocator.block_table(seq_id)
+        self._slot_seq[slot] = seq_id
+        self.tables[slot, : len(table)] = table
+        self.tables[slot, len(table):] = 0
+        self.seq_lens[slot] = prompt_len
+
+    def extend_slot(self, slot: int) -> None:
+        """Account one appended token (decode). Raises OutOfBlocks when the
+        pool is exhausted — the engine must retire or spill a sequence."""
+        seq_id = self._slot_seq[slot]
+        assert seq_id is not None
+        new_len = int(self.seq_lens[slot]) + 1
+        if new_len > self.allocator.seq_length(seq_id):
+            self.allocator.extend(seq_id, new_len)
+            table = self.allocator.block_table(seq_id)
+            self.tables[slot, : len(table)] = table
+        self.seq_lens[slot] = new_len
+
+    def free_slot(self, slot: int) -> None:
+        seq_id = self._slot_seq[slot]
+        if seq_id is None:
+            return
+        self.allocator.free(seq_id)
+        self._slot_seq[slot] = None
+        self.tables[slot] = 0
+        self.seq_lens[slot] = 0
+
+    def pages_needed(self, tokens: int) -> int:
+        return (tokens + self.page_size - 1) // self.page_size
+
+    def stats(self) -> dict[str, int]:
+        s = self.allocator.stats()
+        s["page_size"] = self.page_size
+        return s
+
+    # ------------------------------------------------------------- device ops
+    def write_prefill(self, slot: int, k_slab: jnp.ndarray, v_slab: jnp.ndarray) -> None:
+        """Scatter a prefilled slab [L, S_bucket, Hkv, Dh] into the slot's
+        pages (S_bucket rounded up to whole pages; surplus pages of the
+        bucket beyond the owned table are masked by seq_lens at read)."""
+        seq_id = self._slot_seq[slot]
+        assert seq_id is not None
+        L, S, Hkv, Dh = k_slab.shape
+        n_pages = self.pages_needed(S)
+        pad = n_pages * self.page_size - S
+        if pad:
+            k_slab = jnp.pad(k_slab, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            v_slab = jnp.pad(v_slab, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        owned = self.allocator.block_table(seq_id)
+        if n_pages > len(owned):
+            # bucket padding spilled past the reservation: grow it
+            self.allocator.extend(seq_id, n_pages * self.page_size)
+            owned = self.allocator.block_table(seq_id)
+            self.tables[slot, : len(owned)] = owned
+        page_ids = jnp.asarray(owned[:n_pages], jnp.int32)
+        self.k_pool, self.v_pool = _write_pages(
+            self.k_pool, self.v_pool, k_slab, v_slab, page_ids
+        )
+
+    def tables_device(self) -> jnp.ndarray:
+        return jnp.asarray(self.tables)
+
+    def seq_lens_device(self) -> jnp.ndarray:
+        return jnp.asarray(self.seq_lens)
+
+    def close(self) -> None:
+        self.allocator.close()

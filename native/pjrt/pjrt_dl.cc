@@ -46,7 +46,12 @@ namespace {
 
 thread_local std::string g_last_error;
 
+// Lib shares the same lifetime discipline as Client/Exec: shared_ptr keeps
+// the struct alive across in-flight calls; `mu` + `alive` serialize use vs
+// unload so dlclose can never unmap code under a running call.
 struct Lib {
+  std::mutex mu;
+  bool alive = true;
   void* dl = nullptr;
   const PJRT_Api* api = nullptr;
 };
@@ -73,7 +78,7 @@ struct Exec {
 };
 
 std::mutex g_mu;
-std::unordered_map<int64_t, Lib> g_libs;
+std::unordered_map<int64_t, std::shared_ptr<Lib>> g_libs;
 std::unordered_map<int64_t, std::shared_ptr<Client>> g_clients;
 std::unordered_map<int64_t, std::shared_ptr<Exec>> g_execs;
 int64_t g_next = 1;
@@ -121,10 +126,10 @@ void destroy_buffer(const PJRT_Api* api, PJRT_Buffer* buf) {
   take_error(api, api->PJRT_Buffer_Destroy(&d), "buffer destroy");
 }
 
-Lib* get_lib(int64_t h) {
+std::shared_ptr<Lib> get_lib(int64_t h) {
   std::lock_guard<std::mutex> g(g_mu);
   auto it = g_libs.find(h);
-  return it == g_libs.end() ? nullptr : &it->second;
+  return it == g_libs.end() ? nullptr : it->second;
 }
 
 std::shared_ptr<Client> get_client(int64_t h) {
@@ -177,15 +182,20 @@ GOFR_API int64_t gofr_pjrt_load(const char* path) {
     dlclose(dl);
     return GOFR_E_PJRT;
   }
+  auto lib = std::make_shared<Lib>();
+  lib->dl = dl;
+  lib->api = api;
   std::lock_guard<std::mutex> g(g_mu);
   int64_t h = g_next++;
-  g_libs[h] = Lib{dl, api};
+  g_libs[h] = std::move(lib);
   return h;
 }
 
 GOFR_API int32_t gofr_pjrt_api_version(int64_t lib_h, int32_t* major, int32_t* minor) {
-  Lib* lib = get_lib(lib_h);
+  auto lib = get_lib(lib_h);
   if (lib == nullptr) return GOFR_E_BADHANDLE;
+  std::lock_guard<std::mutex> lk(lib->mu);
+  if (!lib->alive) return GOFR_E_BADHANDLE;
   if (major) *major = lib->api->pjrt_api_version.major_version;
   if (minor) *minor = lib->api->pjrt_api_version.minor_version;
   return GOFR_OK;
@@ -194,7 +204,7 @@ GOFR_API int32_t gofr_pjrt_api_version(int64_t lib_h, int32_t* major, int32_t* m
 // Release a loaded plugin (dlclose). Any clients created from it must be
 // destroyed first; the caller owns that ordering.
 GOFR_API int32_t gofr_pjrt_unload(int64_t lib_h) {
-  Lib lib;
+  std::shared_ptr<Lib> lib;
   {
     std::lock_guard<std::mutex> g(g_mu);
     auto it = g_libs.find(lib_h);
@@ -202,14 +212,19 @@ GOFR_API int32_t gofr_pjrt_unload(int64_t lib_h) {
     lib = it->second;
     g_libs.erase(it);
   }
-  dlclose(lib.dl);
+  std::lock_guard<std::mutex> lk(lib->mu);  // waits out in-flight calls
+  if (!lib->alive) return GOFR_OK;
+  lib->alive = false;
+  dlclose(lib->dl);
   return GOFR_OK;
 }
 
 // Create a client on the loaded plugin. Returns client handle.
 GOFR_API int64_t gofr_pjrt_client_create(int64_t lib_h) {
-  Lib* lib = get_lib(lib_h);
+  auto lib = get_lib(lib_h);
   if (lib == nullptr) return GOFR_E_BADHANDLE;
+  std::lock_guard<std::mutex> lklib(lib->mu);
+  if (!lib->alive) return GOFR_E_BADHANDLE;
   const PJRT_Api* api = lib->api;
 
   PJRT_Client_Create_Args args;
@@ -217,6 +232,19 @@ GOFR_API int64_t gofr_pjrt_client_create(int64_t lib_h) {
   args.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
   if (take_error(api, api->PJRT_Client_Create(&args), "client create"))
     return GOFR_E_PJRT;
+
+  auto destroy_client = [&]() {
+    PJRT_Client_Destroy_Args d;
+    std::memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+    d.client = args.client;
+    PJRT_Error* err = api->PJRT_Client_Destroy(&d);
+    if (err != nullptr) {
+      std::string keep = g_last_error;  // preserve the original failure
+      take_error(api, err, "client destroy (cleanup)");
+      g_last_error = keep;
+    }
+  };
 
   auto c = std::make_shared<Client>();
   c->api = api;
@@ -226,15 +254,20 @@ GOFR_API int64_t gofr_pjrt_client_create(int64_t lib_h) {
   std::memset(&dv, 0, sizeof(dv));
   dv.struct_size = PJRT_Client_Devices_Args_STRUCT_SIZE;
   dv.client = c->client;
-  if (take_error(api, api->PJRT_Client_Devices(&dv), "devices")) return GOFR_E_PJRT;
+  if (take_error(api, api->PJRT_Client_Devices(&dv), "devices")) {
+    destroy_client();
+    return GOFR_E_PJRT;
+  }
   c->devices.assign(dv.devices, dv.devices + dv.num_devices);
 
   PJRT_Client_AddressableDevices_Args ad;
   std::memset(&ad, 0, sizeof(ad));
   ad.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
   ad.client = c->client;
-  if (take_error(api, api->PJRT_Client_AddressableDevices(&ad), "addressable"))
+  if (take_error(api, api->PJRT_Client_AddressableDevices(&ad), "addressable")) {
+    destroy_client();
     return GOFR_E_PJRT;
+  }
   c->addressable.assign(ad.addressable_devices,
                         ad.addressable_devices + ad.num_addressable_devices);
 
