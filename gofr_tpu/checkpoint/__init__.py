@@ -1,0 +1,24 @@
+"""Checkpoint / resume subsystem.
+
+The reference has no model state at all — its nearest analogues are the
+versioned migration bookkeeping (migration/migration.go:50-98, the
+``gofr_migration`` table with skip-below-last-version resume) and
+commit-after-success Pub/Sub (SURVEY §5.4). This module carries those
+semantics over to model weights:
+
+- every save is a monotonically numbered **step** recorded in a
+  ``MANIFEST.json`` written with tmp-file + atomic-rename (the transactional
+  commit); a crash mid-save leaves the previous manifest intact and the
+  half-written step invisible — exactly the migration table's guarantee;
+- restore defaults to the newest committed step (resume);
+- old steps are pruned to ``keep`` (weights are large);
+- restore can place arrays straight onto a ``jax.sharding`` pytree so a
+  multi-chip server never materializes full weights on one host.
+
+Backends: orbax (async-capable, the JAX-native standard) when available,
+and a dependency-free npz+json fallback with identical on-disk manifest.
+"""
+
+from gofr_tpu.checkpoint.manager import CheckpointError, CheckpointManager
+
+__all__ = ["CheckpointError", "CheckpointManager"]

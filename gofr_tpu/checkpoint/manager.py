@@ -1,0 +1,267 @@
+"""CheckpointManager: versioned, transactional weight checkpointing."""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any
+
+import jax
+import numpy as np
+
+MANIFEST = "MANIFEST.json"
+
+
+class CheckpointError(RuntimeError):
+    pass
+
+
+def _flatten(tree: Any) -> tuple[list[np.ndarray], Any]:
+    leaves, treedef = jax.tree.flatten(tree)
+    return [np.asarray(jax.device_get(leaf)) for leaf in leaves], treedef
+
+
+class CheckpointManager:
+    """Save/restore pytrees of arrays under ``directory`` with
+    migration-style manifest bookkeeping (see package docstring).
+
+    Layout::
+
+        <dir>/MANIFEST.json            {"steps": [{"step", "ts", "backend",
+                                        "metadata"}...]}
+        <dir>/step_000042/ ...         orbax tree OR weights.npz+tree.json
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        backend: str = "auto",  # "auto" | "orbax" | "npz"
+        keep: int = 3,
+        logger: Any = None,
+        metrics: Any = None,
+    ) -> None:
+        self.directory = os.path.abspath(directory)
+        self.keep = keep
+        self._logger = logger
+        self._metrics = metrics
+        os.makedirs(self.directory, exist_ok=True)
+        if backend == "auto":
+            try:
+                import orbax.checkpoint  # noqa: F401
+
+                backend = "orbax"
+            except ImportError:
+                backend = "npz"
+        self.backend = backend
+
+    # ------------------------------------------------------------- manifest
+    def _manifest_path(self) -> str:
+        return os.path.join(self.directory, MANIFEST)
+
+    def _read_manifest(self) -> dict:
+        path = self._manifest_path()
+        if not os.path.exists(path):
+            return {"steps": []}
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (json.JSONDecodeError, OSError) as exc:
+            raise CheckpointError(f"corrupt manifest at {path}: {exc}") from exc
+
+    def _commit_manifest(self, manifest: dict) -> None:
+        """tmp + atomic rename: the transactional commit point (the
+        reference's commitMigration, migration.go:68-97)."""
+        tmp = self._manifest_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._manifest_path())
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step:09d}")
+
+    # ------------------------------------------------------------- save
+    def save(self, step: int, tree: Any, metadata: dict | None = None) -> None:
+        """Write ``tree`` as ``step``. Monotonicity enforced: saving a step
+        ≤ the newest committed step is an error (resume must never silently
+        rewind — migration.go's skip-below-last-version rule)."""
+        manifest = self._read_manifest()
+        last = self.latest_step()
+        if last is not None and step <= last:
+            raise CheckpointError(
+                f"step {step} is not past the last committed step {last}"
+            )
+        start = time.perf_counter()
+        step_dir = self._step_dir(step)
+        if os.path.exists(step_dir):  # uncommitted debris from a crash
+            shutil.rmtree(step_dir)
+
+        if self.backend == "orbax":
+            self._save_orbax(step_dir, tree)
+        else:
+            self._save_npz(step_dir, tree)
+
+        manifest["steps"].append(
+            {
+                "step": step,
+                "ts": time.time(),
+                "backend": self.backend,
+                "metadata": metadata or {},
+            }
+        )
+        self._commit_manifest(manifest)  # step becomes visible HERE
+        self._prune(manifest)
+        elapsed = time.perf_counter() - start
+        if self._logger:
+            self._logger.info(f"checkpoint step {step} saved in {elapsed:.2f}s")
+        if self._metrics:
+            self._metrics.record_histogram("app_checkpoint_save_seconds", elapsed)
+
+    def _save_orbax(self, step_dir: str, tree: Any) -> None:
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(step_dir, tree)
+
+    def _save_npz(self, step_dir: str, tree: Any) -> None:
+        os.makedirs(step_dir, exist_ok=True)
+        leaves, treedef = _flatten(tree)
+        np.savez(
+            os.path.join(step_dir, "weights.npz"),
+            **{f"leaf_{i}": leaf for i, leaf in enumerate(leaves)},
+        )
+        with open(os.path.join(step_dir, "tree.json"), "w") as f:
+            json.dump({"treedef": str(treedef), "n_leaves": len(leaves)}, f)
+
+    # ------------------------------------------------------------- restore
+    def latest_step(self) -> int | None:
+        steps = [entry["step"] for entry in self._read_manifest()["steps"]]
+        return max(steps) if steps else None
+
+    def all_steps(self) -> list[int]:
+        return sorted(entry["step"] for entry in self._read_manifest()["steps"])
+
+    def metadata(self, step: int) -> dict:
+        for entry in self._read_manifest()["steps"]:
+            if entry["step"] == step:
+                return entry["metadata"]
+        raise CheckpointError(f"step {step} not in manifest")
+
+    def restore(
+        self,
+        abstract_tree: Any,
+        step: int | None = None,
+        *,
+        sharding: Any = None,
+    ) -> Any:
+        """Restore a committed step (newest when ``step`` is None).
+
+        ``abstract_tree`` supplies structure/shape/dtype (a params pytree or
+        ``jax.eval_shape`` result). ``sharding``: optional pytree (or single
+        sharding) of ``jax.sharding.Sharding`` — arrays are placed onto it
+        directly, so each host/device only holds its shard."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise CheckpointError(f"no committed checkpoints in {self.directory}")
+        entries = {e["step"]: e for e in self._read_manifest()["steps"]}
+        if step not in entries:
+            raise CheckpointError(
+                f"step {step} is not committed (have {sorted(entries)})"
+            )
+        step_dir = self._step_dir(step)
+        backend = entries[step]["backend"]
+        if backend == "orbax":
+            tree = self._restore_orbax(step_dir, abstract_tree, sharding)
+        else:
+            tree = self._restore_npz(step_dir, abstract_tree)
+            if sharding is not None:
+                shardings = (
+                    sharding
+                    if jax.tree.structure(sharding, is_leaf=_is_sharding)
+                    == jax.tree.structure(tree)
+                    else jax.tree.map(lambda _: sharding, tree)
+                )
+                tree = jax.tree.map(
+                    lambda x, s: jax.device_put(x, s), tree, shardings
+                )
+        if self._logger:
+            self._logger.info(f"restored checkpoint step {step}")
+        return tree
+
+    def _restore_orbax(self, step_dir: str, abstract_tree: Any, sharding: Any):
+        import orbax.checkpoint as ocp
+
+        def to_abstract(leaf, shard):
+            arr = jax.eval_shape(lambda: leaf) if not hasattr(leaf, "shape") else leaf
+            return jax.ShapeDtypeStruct(arr.shape, arr.dtype, sharding=shard)
+
+        if sharding is None:
+            abstract = jax.tree.map(
+                lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype),
+                abstract_tree,
+            )
+        else:
+            shardings = (
+                sharding
+                if jax.tree.structure(sharding, is_leaf=_is_sharding)
+                == jax.tree.structure(abstract_tree)
+                else jax.tree.map(lambda _: sharding, abstract_tree)
+            )
+            abstract = jax.tree.map(to_abstract, abstract_tree, shardings)
+        with ocp.StandardCheckpointer() as ckptr:
+            return ckptr.restore(step_dir, abstract)
+
+    def _restore_npz(self, step_dir: str, abstract_tree: Any):
+        path = os.path.join(step_dir, "weights.npz")
+        if not os.path.exists(path):
+            raise CheckpointError(f"missing weights at {path}")
+        data = np.load(path)
+        leaves, treedef = jax.tree.flatten(abstract_tree)
+        if len(leaves) != len(data.files):
+            raise CheckpointError(
+                f"leaf count mismatch: tree has {len(leaves)}, "
+                f"checkpoint has {len(data.files)}"
+            )
+        restored = [data[f"leaf_{i}"] for i in range(len(leaves))]
+        for i, (leaf, arr) in enumerate(zip(leaves, restored)):
+            if tuple(getattr(leaf, "shape", arr.shape)) != arr.shape:
+                raise CheckpointError(
+                    f"leaf {i} shape mismatch: expected {leaf.shape}, got {arr.shape}"
+                )
+        return jax.tree.unflatten(treedef, restored)
+
+    # ------------------------------------------------------------- pruning
+    def _prune(self, manifest: dict) -> None:
+        steps = sorted(e["step"] for e in manifest["steps"])
+        excess = steps[: -self.keep] if self.keep > 0 else []
+        if not excess:
+            return
+        manifest["steps"] = [e for e in manifest["steps"] if e["step"] not in excess]
+        self._commit_manifest(manifest)  # drop from manifest BEFORE rm
+        for step in excess:
+            shutil.rmtree(self._step_dir(step), ignore_errors=True)
+
+    def health_check(self) -> dict[str, Any]:
+        try:
+            steps = self.all_steps()
+            return {
+                "status": "UP",
+                "details": {
+                    "directory": self.directory,
+                    "backend": self.backend,
+                    "steps": steps[-self.keep:],
+                    "latest": steps[-1] if steps else None,
+                },
+            }
+        except CheckpointError as exc:
+            return {"status": "DEGRADED", "details": {"error": str(exc)}}
+
+
+def _is_sharding(x: Any) -> bool:
+    from jax.sharding import Sharding
+
+    return isinstance(x, Sharding)

@@ -169,6 +169,7 @@ def _declare_runtime(lib: ctypes.CDLL) -> None:
         "gofr_sched_create": (i64, [i32, i32, i32]),
         "gofr_sched_destroy": (i32, [i64]),
         "gofr_sched_submit": (i32, [i64, i64, i32, i32, i32]),
+        "gofr_sched_submit_front": (i32, [i64, i64, i32, i32, i32]),
         "gofr_sched_cancel": (i32, [i64, i64]),
         "gofr_sched_admit": (i32, [i64, p_i64, p_i32, i32, p_i64, i32, p_i32]),
         "gofr_sched_release": (i32, [i64, i32]),

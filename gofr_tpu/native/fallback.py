@@ -135,7 +135,7 @@ class PyScheduler:
         self._mu = threading.Lock()
 
     def submit(self, req_id: int, prompt_len: int, max_new_tokens: int,
-               priority: int = 0) -> None:
+               priority: int = 0, front: bool = False) -> None:
         with self._mu:
             if req_id in self._meta:
                 raise KeyError(f"request {req_id} exists")
@@ -144,7 +144,8 @@ class PyScheduler:
             meta = {"prompt_len": prompt_len, "max_new": max_new_tokens,
                     "priority": priority, "canceled": False}
             self._meta[req_id] = meta
-            self._queues.setdefault(priority, deque()).append(req_id)
+            q = self._queues.setdefault(priority, deque())
+            q.appendleft(req_id) if front else q.append(req_id)
             # keep priorities sorted (lower first) like the C++ std::map
             self._queues = OrderedDict(sorted(self._queues.items()))
 

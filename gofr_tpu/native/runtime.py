@@ -103,9 +103,7 @@ class BlockAllocator:
             return self._py.seq_length(seq_id)
         return _check(int(self._lib.gofr_ba_seq_length(self._h, seq_id)), "seq_length")
 
-    def stats(self) -> dict[str, int]:
-        if self._closed:  # post-shutdown health checks must not hit a dead handle
-            return dict(self._last_stats)
+    def _stats_unlocked(self) -> dict[str, int]:
         if self._lib is None:
             return self._py.stats()
         out = (ctypes.c_int64 * 4)()
@@ -117,20 +115,28 @@ class BlockAllocator:
             "alloc_failures": out[3],
         }
 
+    def stats(self) -> dict[str, int]:
+        # the whole read happens under _mu so a racing close() cannot
+        # destroy the handle between the _closed check and the native call
+        with self._mu:
+            if self._closed:
+                return dict(self._last_stats)
+            return self._stats_unlocked()
+
     def close(self) -> None:
         with self._mu:
             if self._closed:
                 return
             try:
-                self._last_stats = self.stats()
+                self._last_stats = self._stats_unlocked()
             except Exception:
                 self._last_stats = {
                     "free_blocks": 0, "total_blocks": self.num_blocks,
                     "sequences": 0, "alloc_failures": 0,
                 }
+            if self._lib is not None:
+                self._lib.gofr_ba_destroy(self._h)
             self._closed = True
-        if self._lib is not None:
-            self._lib.gofr_ba_destroy(self._h)
 
     def __del__(self) -> None:  # best-effort; explicit close preferred
         try:
@@ -162,12 +168,15 @@ class Scheduler:
             raise RuntimeError("scheduler closed")
 
     def submit(self, req_id: int, prompt_len: int, max_new_tokens: int,
-               priority: int = 0) -> None:
+               priority: int = 0, front: bool = False) -> None:
+        """Queue a request; ``front=True`` re-inserts at the head of its
+        priority class (requeue after a transient admission failure)."""
         self._ensure_open()
         if self._lib is None:
-            return self._py.submit(req_id, prompt_len, max_new_tokens, priority)
+            return self._py.submit(req_id, prompt_len, max_new_tokens, priority, front)
+        fn = self._lib.gofr_sched_submit_front if front else self._lib.gofr_sched_submit
         _check(
-            self._lib.gofr_sched_submit(self._h, req_id, prompt_len, max_new_tokens, priority),
+            fn(self._h, req_id, prompt_len, max_new_tokens, priority),
             f"submit req {req_id}",
         )
 
@@ -199,9 +208,7 @@ class Scheduler:
             return self._py.release(slot)
         _check(self._lib.gofr_sched_release(self._h, slot), f"release slot {slot}")
 
-    def stats(self) -> dict[str, int]:
-        if self._closed:  # post-shutdown health checks must not hit a dead handle
-            return dict(self._last_stats)
+    def _stats_unlocked(self) -> dict[str, int]:
         if self._lib is None:
             return self._py.stats()
         out = (ctypes.c_int64 * 5)()
@@ -214,20 +221,26 @@ class Scheduler:
             "total_canceled": out[4],
         }
 
+    def stats(self) -> dict[str, int]:
+        with self._mu:  # see BlockAllocator.stats — same close race
+            if self._closed:
+                return dict(self._last_stats)
+            return self._stats_unlocked()
+
     def close(self) -> None:
         with self._mu:
             if self._closed:
                 return
             try:
-                self._last_stats = self.stats()
+                self._last_stats = self._stats_unlocked()
             except Exception:
                 self._last_stats = {
                     "queue_depth": 0, "busy_slots": 0, "max_slots": self.max_slots,
                     "total_admitted": 0, "total_canceled": 0,
                 }
+            if self._lib is not None:
+                self._lib.gofr_sched_destroy(self._h)
             self._closed = True
-        if self._lib is not None:
-            self._lib.gofr_sched_destroy(self._h)
 
     def __del__(self) -> None:
         try:

@@ -87,7 +87,7 @@ def decode_and_sample(
 def decode_and_sample_paged(
     cfg: llama.LlamaConfig,
     params: dict,
-    k_pool: jnp.ndarray,  # [L, N_pages, page, Hkv, Dh] donated
+    k_pool: jnp.ndarray,  # [L, N_pages+1, Hkv, page, Dh] donated (+1: trash page)
     v_pool: jnp.ndarray,  # donated
     block_tables: jnp.ndarray,  # [B, M]
     seq_lens: jnp.ndarray,  # [B] length incl. this token (>=1 when active)

@@ -169,6 +169,38 @@ class ServingEngine:
         self._thread: threading.Thread | None = None
         self._wake = threading.Event()
 
+    @classmethod
+    def from_checkpoint(
+        cls,
+        cfg: llama.LlamaConfig,
+        checkpoint_dir: str,
+        *,
+        step: int | None = None,
+        sharding: Any = None,
+        seed_key: Any = None,
+        **kw: Any,
+    ) -> "ServingEngine":
+        """Warm restart (SURVEY §5.4): build an engine whose weights come
+        from the newest committed checkpoint step (or ``step``), optionally
+        placed straight onto a sharding pytree. Falls back to random init
+        only when ``seed_key`` is given and no checkpoint exists."""
+        from gofr_tpu.checkpoint import CheckpointError, CheckpointManager
+
+        mgr = CheckpointManager(checkpoint_dir)
+        abstract = jax.eval_shape(lambda: llama.init_params(cfg, jax.random.PRNGKey(0)))
+        if mgr.latest_step() is None:  # raises on a corrupt manifest
+            if seed_key is None:
+                raise CheckpointError(
+                    f"no committed checkpoints in {checkpoint_dir} "
+                    "(pass seed_key for random-init fallback)"
+                )
+            params = llama.init_params(cfg, seed_key)
+        else:
+            # corruption in an EXISTING checkpoint propagates: silently
+            # serving random weights would be worse than failing startup
+            params = mgr.restore(abstract, step=step, sharding=sharding)
+        return cls(cfg, params, **kw)
+
     # ------------------------------------------------------------- lifecycle
     def start(self) -> None:
         if self._running:
@@ -344,14 +376,16 @@ class ServingEngine:
             try:
                 self._prefill_into(slot, req)
             except _RequeueRequest:
-                # transient (KV pages exhausted): this request goes back to
-                # the queue at its priority; the REST of the admitted batch
-                # still proceeds — their slots are already claimed and the
-                # scheduler never re-delivers an admitted pair
+                # transient (KV pages exhausted): back to the HEAD of its
+                # priority class (it keeps its FIFO position — later smaller
+                # requests must not starve it); the REST of the admitted
+                # batch still proceeds — their slots are already claimed and
+                # the scheduler never re-delivers an admitted pair
                 self._sched.release(slot)
                 try:
                     self._sched.submit(
-                        rid, len(req.prompt_ids), req.max_new_tokens, req.priority
+                        rid, len(req.prompt_ids), req.max_new_tokens,
+                        req.priority, front=True,
                     )
                 except Exception:
                     with self._count_lock:
@@ -394,9 +428,15 @@ class ServingEngine:
         if self.paged_cache is not None:
             # page reservation first: OutOfBlocks must requeue BEFORE any
             # device work (the request keeps its place; pool pressure is a
-            # transient, not an error)
+            # transient, not an error) — unless the prompt can NEVER fit,
+            # which must fail the request, not livelock the admit loop
             from gofr_tpu.serving.kv_cache import OutOfBlocks
 
+            if self.paged_cache.pages_needed(bucket) > self.paged_cache.num_pages:
+                raise ErrorTooManyRequests(
+                    f"prompt needs {self.paged_cache.pages_needed(bucket)} KV pages; "
+                    f"pool has {self.paged_cache.num_pages}"
+                )
             try:
                 self.paged_cache.alloc_slot(
                     slot, seq_id=req.id, prompt_len=S, reserve_tokens=bucket

@@ -1,15 +1,16 @@
 """Paged KV cache: pooled pages + native block-table accounting.
 
 Replaces per-slot dense KV rows ([slots, max_seq] preallocation) with a
-shared page pool ([L, N_pages, page, Hkv, Dh]): sequences own pages
-through the native BlockAllocator (native/runtime/gofr_runtime.cc — the
-refcounted allocator with copy-on-write forks), so HBM is committed by
-tokens actually resident, not by worst-case slots. SURVEY §5.7 lever (a).
+shared page pool ([L, N_pages+1, Hkv, page, Dh] — the +1 is a trash page
+for inactive rows' redirected writes): sequences own pages through the
+native BlockAllocator (native/runtime/gofr_runtime.cc — the refcounted
+allocator with copy-on-write forks), so HBM is committed by tokens
+actually resident, not by worst-case slots. SURVEY §5.7 lever (a).
 
 Host side (this class): page accounting, block tables, seq lens.
-Device side (jitted helpers below): scatter prefilled slabs into owned
-pages, append one token per active row per decode step, and the paged
-attention read path (ops/paged_attention.py).
+Device side: scatter prefilled slabs into owned pages (_write_pages); the
+decode-step append lives inside llama.decode_step_paged (per layer), and
+the read path is ops/paged_attention.py.
 """
 
 from __future__ import annotations

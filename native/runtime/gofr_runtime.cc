@@ -19,6 +19,7 @@
 #include <cstring>
 #include <deque>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <unordered_map>
 #include <vector>
@@ -85,10 +86,12 @@ struct BlockAllocator {
 };
 
 std::mutex g_ba_mu;
-std::unordered_map<int64_t, BlockAllocator*> g_allocators;
+// shared_ptr: a concurrent destroy erases the map entry but cannot free the
+// object under an in-flight call still holding a reference.
+std::unordered_map<int64_t, std::shared_ptr<BlockAllocator>> g_allocators;
 int64_t g_next_ba = 1;
 
-BlockAllocator* ba_get(int64_t h) {
+std::shared_ptr<BlockAllocator> ba_get(int64_t h) {
   std::lock_guard<std::mutex> g(g_ba_mu);
   auto it = g_allocators.find(h);
   return it == g_allocators.end() ? nullptr : it->second;
@@ -98,30 +101,22 @@ BlockAllocator* ba_get(int64_t h) {
 
 GOFR_API int64_t gofr_ba_create(int32_t num_blocks, int32_t block_size) {
   if (num_blocks <= 0 || block_size <= 0) return GOFR_E_ARG;
-  auto* ba = new BlockAllocator(num_blocks, block_size);
+  auto ba = std::make_shared<BlockAllocator>(num_blocks, block_size);
   std::lock_guard<std::mutex> g(g_ba_mu);
   int64_t h = g_next_ba++;
-  g_allocators[h] = ba;
+  g_allocators[h] = std::move(ba);
   return h;
 }
 
 GOFR_API int32_t gofr_ba_destroy(int64_t h) {
-  BlockAllocator* ba = nullptr;
-  {
-    std::lock_guard<std::mutex> g(g_ba_mu);
-    auto it = g_allocators.find(h);
-    if (it == g_allocators.end()) return GOFR_E_BADHANDLE;
-    ba = it->second;
-    g_allocators.erase(it);
-  }
-  delete ba;
-  return GOFR_OK;
+  std::lock_guard<std::mutex> g(g_ba_mu);
+  return g_allocators.erase(h) ? GOFR_OK : GOFR_E_BADHANDLE;
 }
 
 // Allocate a sequence with room for `tokens` tokens. Fails atomically
 // (no partial allocation) when not enough free blocks remain.
 GOFR_API int32_t gofr_ba_alloc(int64_t h, int64_t seq_id, int64_t tokens) {
-  BlockAllocator* ba = ba_get(h);
+  auto ba = ba_get(h);
   if (!ba) return GOFR_E_BADHANDLE;
   if (tokens < 0) return GOFR_E_ARG;
   std::lock_guard<std::mutex> g(ba->mu);
@@ -148,7 +143,7 @@ GOFR_API int32_t gofr_ba_extend(int64_t h, int64_t seq_id, int64_t new_length,
                                 int32_t* out_cow_src, int32_t* out_cow_dst) {
   if (out_cow_src) *out_cow_src = -1;
   if (out_cow_dst) *out_cow_dst = -1;
-  BlockAllocator* ba = ba_get(h);
+  auto ba = ba_get(h);
   if (!ba) return GOFR_E_BADHANDLE;
   std::lock_guard<std::mutex> g(ba->mu);
   auto it = ba->seqs.find(seq_id);
@@ -192,7 +187,7 @@ GOFR_API int32_t gofr_ba_extend(int64_t h, int64_t seq_id, int64_t new_length,
 // actually shared (multiple of block_size), or negative error.
 GOFR_API int64_t gofr_ba_fork(int64_t h, int64_t src_id, int64_t dst_id,
                               int64_t shared_tokens) {
-  BlockAllocator* ba = ba_get(h);
+  auto ba = ba_get(h);
   if (!ba) return GOFR_E_BADHANDLE;
   std::lock_guard<std::mutex> g(ba->mu);
   auto it = ba->seqs.find(src_id);
@@ -211,7 +206,7 @@ GOFR_API int64_t gofr_ba_fork(int64_t h, int64_t src_id, int64_t dst_id,
 }
 
 GOFR_API int32_t gofr_ba_free(int64_t h, int64_t seq_id) {
-  BlockAllocator* ba = ba_get(h);
+  auto ba = ba_get(h);
   if (!ba) return GOFR_E_BADHANDLE;
   std::lock_guard<std::mutex> g(ba->mu);
   auto it = ba->seqs.find(seq_id);
@@ -225,7 +220,7 @@ GOFR_API int32_t gofr_ba_free(int64_t h, int64_t seq_id) {
 // Returns number of entries, or negative error. GOFR_E_CAP if cap too small.
 GOFR_API int32_t gofr_ba_block_table(int64_t h, int64_t seq_id, int32_t* out,
                                      int32_t cap) {
-  BlockAllocator* ba = ba_get(h);
+  auto ba = ba_get(h);
   if (!ba) return GOFR_E_BADHANDLE;
   std::lock_guard<std::mutex> g(ba->mu);
   auto it = ba->seqs.find(seq_id);
@@ -237,7 +232,7 @@ GOFR_API int32_t gofr_ba_block_table(int64_t h, int64_t seq_id, int32_t* out,
 }
 
 GOFR_API int64_t gofr_ba_seq_length(int64_t h, int64_t seq_id) {
-  BlockAllocator* ba = ba_get(h);
+  auto ba = ba_get(h);
   if (!ba) return GOFR_E_BADHANDLE;
   std::lock_guard<std::mutex> g(ba->mu);
   auto it = ba->seqs.find(seq_id);
@@ -248,7 +243,7 @@ GOFR_API int64_t gofr_ba_seq_length(int64_t h, int64_t seq_id) {
 // stats: out[0]=free blocks, out[1]=total, out[2]=live sequences,
 // out[3]=alloc failures since creation
 GOFR_API int32_t gofr_ba_stats(int64_t h, int64_t* out4) {
-  BlockAllocator* ba = ba_get(h);
+  auto ba = ba_get(h);
   if (!ba) return GOFR_E_BADHANDLE;
   std::lock_guard<std::mutex> g(ba->mu);
   out4[0] = static_cast<int64_t>(ba->free_list.size());
@@ -305,10 +300,10 @@ struct Scheduler {
 };
 
 std::mutex g_sc_mu;
-std::unordered_map<int64_t, Scheduler*> g_scheds;
+std::unordered_map<int64_t, std::shared_ptr<Scheduler>> g_scheds;
 int64_t g_next_sc = 1;
 
-Scheduler* sc_get(int64_t h) {
+std::shared_ptr<Scheduler> sc_get(int64_t h) {
   std::lock_guard<std::mutex> g(g_sc_mu);
   auto it = g_scheds.find(h);
   return it == g_scheds.end() ? nullptr : it->second;
@@ -320,30 +315,22 @@ GOFR_API int64_t gofr_sched_create(int32_t max_slots, int32_t max_queue,
                                    int32_t prefill_token_budget) {
   if (max_slots <= 0 || max_queue <= 0 || prefill_token_budget <= 0)
     return GOFR_E_ARG;
-  auto* sc = new Scheduler(max_slots, max_queue, prefill_token_budget);
+  auto sc = std::make_shared<Scheduler>(max_slots, max_queue, prefill_token_budget);
   std::lock_guard<std::mutex> g(g_sc_mu);
   int64_t h = g_next_sc++;
-  g_scheds[h] = sc;
+  g_scheds[h] = std::move(sc);
   return h;
 }
 
 GOFR_API int32_t gofr_sched_destroy(int64_t h) {
-  Scheduler* sc = nullptr;
-  {
-    std::lock_guard<std::mutex> g(g_sc_mu);
-    auto it = g_scheds.find(h);
-    if (it == g_scheds.end()) return GOFR_E_BADHANDLE;
-    sc = it->second;
-    g_scheds.erase(it);
-  }
-  delete sc;
-  return GOFR_OK;
+  std::lock_guard<std::mutex> g(g_sc_mu);
+  return g_scheds.erase(h) ? GOFR_OK : GOFR_E_BADHANDLE;
 }
 
-GOFR_API int32_t gofr_sched_submit(int64_t h, int64_t req_id,
-                                   int32_t prompt_len, int32_t max_new_tokens,
-                                   int32_t priority) {
-  Scheduler* sc = sc_get(h);
+static int32_t sched_submit_impl(int64_t h, int64_t req_id, int32_t prompt_len,
+                                 int32_t max_new_tokens, int32_t priority,
+                                 bool front) {
+  auto sc = sc_get(h);
   if (!sc) return GOFR_E_BADHANDLE;
   if (prompt_len < 0 || max_new_tokens < 0) return GOFR_E_ARG;
   std::lock_guard<std::mutex> g(sc->mu);
@@ -351,17 +338,37 @@ GOFR_API int32_t gofr_sched_submit(int64_t h, int64_t req_id,
   if (sc->queue_depth_locked() >= sc->max_queue) return GOFR_E_QUEUEFULL;
   SchedRequest r{req_id, prompt_len, max_new_tokens, priority, sc->next_seqno++};
   auto& q = sc->queues[priority];
-  q.push_back(r);
-  sc->by_id[req_id] = &q.back();
-  // deque push_back can reallocate iterators? std::deque never invalidates
-  // pointers to *other* elements on push_back, but may on push_front /
-  // middle erase — we only push_back and pop_front, and rebuild by_id on
-  // pop, so stored pointers stay valid for queued elements.
+  // std::deque push_back/push_front never invalidate pointers to *other*
+  // elements; we only push at the ends and pop_front (erasing from by_id
+  // first), so stored pointers stay valid for queued elements.
+  if (front) {
+    q.push_front(r);
+    sc->by_id[req_id] = &q.front();
+  } else {
+    q.push_back(r);
+    sc->by_id[req_id] = &q.back();
+  }
   return GOFR_OK;
 }
 
+GOFR_API int32_t gofr_sched_submit(int64_t h, int64_t req_id,
+                                   int32_t prompt_len, int32_t max_new_tokens,
+                                   int32_t priority) {
+  return sched_submit_impl(h, req_id, prompt_len, max_new_tokens, priority, false);
+}
+
+// Head insertion within the priority class: used to put a request back at
+// the FRONT after a transient admission failure (KV pages), preserving its
+// FIFO position instead of sending it to the tail.
+GOFR_API int32_t gofr_sched_submit_front(int64_t h, int64_t req_id,
+                                         int32_t prompt_len,
+                                         int32_t max_new_tokens,
+                                         int32_t priority) {
+  return sched_submit_impl(h, req_id, prompt_len, max_new_tokens, priority, true);
+}
+
 GOFR_API int32_t gofr_sched_cancel(int64_t h, int64_t req_id) {
-  Scheduler* sc = sc_get(h);
+  auto sc = sc_get(h);
   if (!sc) return GOFR_E_BADHANDLE;
   std::lock_guard<std::mutex> g(sc->mu);
   auto it = sc->by_id.find(req_id);
@@ -383,7 +390,7 @@ GOFR_API int32_t gofr_sched_admit(int64_t h, int64_t* out_req_ids,
                                   int32_t canceled_cap,
                                   int32_t* out_n_canceled) {
   if (out_n_canceled) *out_n_canceled = 0;
-  Scheduler* sc = sc_get(h);
+  auto sc = sc_get(h);
   if (!sc) return GOFR_E_BADHANDLE;
   std::lock_guard<std::mutex> g(sc->mu);
   int32_t admitted = 0;
@@ -434,7 +441,7 @@ done:
 }
 
 GOFR_API int32_t gofr_sched_release(int64_t h, int32_t slot) {
-  Scheduler* sc = sc_get(h);
+  auto sc = sc_get(h);
   if (!sc) return GOFR_E_BADHANDLE;
   std::lock_guard<std::mutex> g(sc->mu);
   if (slot < 0 || slot >= sc->max_slots) return GOFR_E_ARG;
@@ -446,7 +453,7 @@ GOFR_API int32_t gofr_sched_release(int64_t h, int32_t slot) {
 // stats: out[0]=queue depth, out[1]=busy slots, out[2]=max slots,
 // out[3]=total admitted, out[4]=total canceled
 GOFR_API int32_t gofr_sched_stats(int64_t h, int64_t* out5) {
-  Scheduler* sc = sc_get(h);
+  auto sc = sc_get(h);
   if (!sc) return GOFR_E_BADHANDLE;
   std::lock_guard<std::mutex> g(sc->mu);
   out5[0] = sc->queue_depth_locked();
