@@ -320,7 +320,11 @@ class App:
             f"http=:{self.http_port} metrics=:{self.metrics_port}"
             + (f" grpc=:{self.grpc_port}" if self._grpc_server else "")
         )
+        from gofr_tpu.telemetry import send_ping
+
+        send_ping(self.config, "start", self.logger)
         await self._shutdown_event.wait()
+        send_ping(self.config, "stop", self.logger)
         await self.shutdown()
 
     def run(self) -> int | None:

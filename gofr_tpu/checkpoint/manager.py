@@ -48,6 +48,13 @@ class CheckpointManager:
         self._logger = logger
         self._metrics = metrics
         os.makedirs(self.directory, exist_ok=True)
+        if metrics is not None:
+            try:
+                metrics.new_histogram(
+                    "app_checkpoint_save_seconds", "Checkpoint save latency"
+                )
+            except Exception:
+                pass  # already registered
         if backend == "auto":
             try:
                 import orbax.checkpoint  # noqa: F401
@@ -90,7 +97,8 @@ class CheckpointManager:
         ≤ the newest committed step is an error (resume must never silently
         rewind — migration.go's skip-below-last-version rule)."""
         manifest = self._read_manifest()
-        last = self.latest_step()
+        steps = [e["step"] for e in manifest["steps"]]
+        last = max(steps) if steps else None
         if last is not None and step <= last:
             raise CheckpointError(
                 f"step {step} is not past the last committed step {last}"
@@ -113,8 +121,16 @@ class CheckpointManager:
                 "metadata": metadata or {},
             }
         )
+        # fold the prune into the single commit: one fsync+rename per save
+        all_steps = sorted(e["step"] for e in manifest["steps"])
+        excess = all_steps[: -self.keep] if self.keep > 0 else []
+        if excess:
+            manifest["steps"] = [
+                e for e in manifest["steps"] if e["step"] not in excess
+            ]
         self._commit_manifest(manifest)  # step becomes visible HERE
-        self._prune(manifest)
+        for old in excess:  # files only after the manifest stopped naming them
+            shutil.rmtree(self._step_dir(old), ignore_errors=True)
         elapsed = time.perf_counter() - start
         if self._logger:
             self._logger.info(f"checkpoint step {step} saved in {elapsed:.2f}s")
@@ -179,12 +195,7 @@ class CheckpointManager:
         else:
             tree = self._restore_npz(step_dir, abstract_tree)
             if sharding is not None:
-                shardings = (
-                    sharding
-                    if jax.tree.structure(sharding, is_leaf=_is_sharding)
-                    == jax.tree.structure(tree)
-                    else jax.tree.map(lambda _: sharding, tree)
-                )
+                shardings = _normalize_shardings(sharding, tree)
                 tree = jax.tree.map(
                     lambda x, s: jax.device_put(x, s), tree, shardings
                 )
@@ -205,12 +216,7 @@ class CheckpointManager:
                 abstract_tree,
             )
         else:
-            shardings = (
-                sharding
-                if jax.tree.structure(sharding, is_leaf=_is_sharding)
-                == jax.tree.structure(abstract_tree)
-                else jax.tree.map(lambda _: sharding, abstract_tree)
-            )
+            shardings = _normalize_shardings(sharding, abstract_tree)
             abstract = jax.tree.map(to_abstract, abstract_tree, shardings)
         with ocp.StandardCheckpointer() as ckptr:
             return ckptr.restore(step_dir, abstract)
@@ -226,6 +232,19 @@ class CheckpointManager:
                 f"leaf count mismatch: tree has {len(leaves)}, "
                 f"checkpoint has {len(data.files)}"
             )
+        # structure check: identical leaf count/shapes with a DIFFERENT tree
+        # shape would silently permute weights (tree.json is the save-side
+        # record of the structure)
+        tree_json = os.path.join(step_dir, "tree.json")
+        if os.path.exists(tree_json):
+            with open(tree_json) as f:
+                saved = json.load(f)
+            if saved.get("treedef") != str(treedef):
+                raise CheckpointError(
+                    "pytree structure mismatch between checkpoint and "
+                    f"restore target:\n  saved:  {saved.get('treedef')}\n"
+                    f"  target: {treedef}"
+                )
         restored = [data[f"leaf_{i}"] for i in range(len(leaves))]
         for i, (leaf, arr) in enumerate(zip(leaves, restored)):
             if tuple(getattr(leaf, "shape", arr.shape)) != arr.shape:
@@ -233,17 +252,6 @@ class CheckpointManager:
                     f"leaf {i} shape mismatch: expected {leaf.shape}, got {arr.shape}"
                 )
         return jax.tree.unflatten(treedef, restored)
-
-    # ------------------------------------------------------------- pruning
-    def _prune(self, manifest: dict) -> None:
-        steps = sorted(e["step"] for e in manifest["steps"])
-        excess = steps[: -self.keep] if self.keep > 0 else []
-        if not excess:
-            return
-        manifest["steps"] = [e for e in manifest["steps"] if e["step"] not in excess]
-        self._commit_manifest(manifest)  # drop from manifest BEFORE rm
-        for step in excess:
-            shutil.rmtree(self._step_dir(step), ignore_errors=True)
 
     def health_check(self) -> dict[str, Any]:
         try:
@@ -265,3 +273,14 @@ def _is_sharding(x: Any) -> bool:
     from jax.sharding import Sharding
 
     return isinstance(x, Sharding)
+
+
+def _normalize_shardings(sharding: Any, tree: Any) -> Any:
+    """Accept either a pytree of shardings matching ``tree`` or a single
+    sharding broadcast to every leaf."""
+    if (
+        jax.tree.structure(sharding, is_leaf=_is_sharding)
+        == jax.tree.structure(tree)
+    ):
+        return sharding
+    return jax.tree.map(lambda _: sharding, tree)

@@ -1,0 +1,3 @@
+from gofr_tpu.datasource.document.embedded import EmbeddedDocumentStore, new_document_store
+
+__all__ = ["EmbeddedDocumentStore", "new_document_store"]

@@ -85,3 +85,71 @@ class FileKVStore(InMemoryKVStore):
 
     def health_check(self) -> dict[str, Any]:
         return {"status": "UP", "details": {"backend": "file", "path": self.path, "keys": len(self._data)}}
+
+
+class TTLKVStore(InMemoryKVStore):
+    """DynamoDB-flavored KV: per-key time-to-live with lazy expiry
+    (reference: datasource/kv-store/dynamodb — the managed-TTL analogue;
+    badger's entry TTL). Keys expire on read/scan; ``purge()`` sweeps."""
+
+    def __init__(self, default_ttl: float | None = None) -> None:
+        super().__init__()
+        self.default_ttl = default_ttl
+        self._expires: dict[str, float] = {}
+
+    @classmethod
+    def from_config(cls, config: Any) -> "TTLKVStore":
+        ttl = config.get("KV_DEFAULT_TTL_SECONDS")
+        # 0 (and negatives) mean "no expiry" — the common config convention
+        return cls(float(ttl) if ttl and float(ttl) > 0 else None)
+
+    def _expired(self, key: str) -> bool:
+        import time
+
+        deadline = self._expires.get(key)
+        return deadline is not None and time.monotonic() >= deadline
+
+    def set(self, key: str, value: str, ttl: float | None = None) -> None:
+        import time
+
+        with self._lock:
+            self._data[key] = value
+            ttl = ttl if ttl is not None else self.default_ttl
+            if ttl is not None:
+                self._expires[key] = time.monotonic() + ttl
+            else:
+                self._expires.pop(key, None)
+
+    def get(self, key: str) -> str:
+        with self._lock:
+            if key in self._data and self._expired(key):
+                del self._data[key]
+                del self._expires[key]
+            if key not in self._data:
+                raise KVError(key)
+            return self._data[key]
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+            self._expires.pop(key, None)
+
+    def purge(self) -> int:
+        """Remove all expired keys; returns the count (cron-able sweep)."""
+        with self._lock:
+            dead = [k for k in self._data if self._expired(k)]
+            for k in dead:
+                del self._data[k]
+                del self._expires[k]
+            return len(dead)
+
+    def health_check(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "status": "UP",
+                "details": {
+                    "backend": "ttl-memory",
+                    "keys": len(self._data),
+                    "keys_with_ttl": len(self._expires),
+                },
+            }

@@ -1,9 +1,14 @@
-"""The metrics port: /metrics + diagnostics.
+"""The metrics port: /metrics + diagnostics + device profiler.
 
 Reference parity: pkg/gofr/metrics/handler.go:13-52 + metrics_server.go —
 Prometheus exposition on :2121/metrics, plus the pprof-style debug surface
 (/debug/pprof/* in the reference; here /debug/threads, /debug/gc,
 /debug/vars — Python's runtime diagnostics) and health/alive.
+
+TPU addition (SURVEY §5.1): the XLA/libtpu device profiler mounted beside
+pprof — POST /debug/profiler/start?dir=… begins a jax.profiler trace
+(XPlane/Perfetto-compatible, covers device compute + HBM transfers),
+POST /debug/profiler/stop ends it and reports the trace directory.
 """
 
 from __future__ import annotations
@@ -21,6 +26,8 @@ from gofr_tpu.http.responder import WireResponse
 class MetricsHandler:
     def __init__(self, container: Any) -> None:
         self.container = container
+        self._profile_dir: str | None = None
+        self._profile_lock = threading.Lock()
 
     async def __call__(self, req: Any) -> WireResponse:
         path = req.path
@@ -43,6 +50,37 @@ class MetricsHandler:
         if path == "/debug/gc" or path == "/debug/pprof/heap":
             stats = {"gc_stats": gc.get_stats(), "objects": len(gc.get_objects())}
             return _json(stats)
+        if path in ("/debug/profiler/start", "/debug/profiler/stop") and \
+                getattr(req, "method", "POST").upper() != "POST":
+            return _json({"error": "method not allowed; use POST"}, status=405)
+        if path == "/debug/profiler/start":
+            directory = req.param("dir") or "/tmp/gofr-tpu-profile"
+            with self._profile_lock:
+                if self._profile_dir is not None:
+                    return _json(
+                        {"error": "profiler already running",
+                         "dir": self._profile_dir},
+                        status=409,
+                    )
+                try:
+                    import jax
+
+                    jax.profiler.start_trace(directory)
+                except Exception as exc:
+                    return _json({"error": str(exc)}, status=500)
+                self._profile_dir = directory
+            return _json({"profiling": True, "dir": directory})
+        if path == "/debug/profiler/stop":
+            with self._profile_lock:
+                if self._profile_dir is None:
+                    return _json({"error": "profiler not running"}, status=409)
+                try:
+                    import jax
+
+                    jax.profiler.stop_trace()
+                finally:
+                    directory, self._profile_dir = self._profile_dir, None
+            return _json({"profiling": False, "dir": directory})
         if path == "/debug/vars":
             return _json(
                 {
@@ -54,8 +92,9 @@ class MetricsHandler:
         return WireResponse(status=404, body=b"404 not found")
 
 
-def _json(obj: Any) -> WireResponse:
+def _json(obj: Any, status: int = 200) -> WireResponse:
     return WireResponse(
+        status=status,
         headers={"Content-Type": "application/json"},
         body=json.dumps(obj, default=str).encode(),
     )

@@ -121,6 +121,17 @@ def test_restore_onto_mesh_sharding(tmp_path, backend):
     assert restored2["layers"]["wq"].sharding.spec == P()
 
 
+def test_npz_structure_mismatch_rejected(tmp_path):
+    """Same leaf count and shapes but a different pytree structure must be
+    rejected (silent permutation would serve garbage weights)."""
+    mgr = CheckpointManager(str(tmp_path), backend="npz")
+    a = {"x": jnp.zeros((4, 4)), "y": jnp.ones((4, 4))}
+    mgr.save(1, a)
+    b = {"p": {"x": jnp.zeros((4, 4))}, "q": jnp.ones((4, 4))}  # same leaves
+    with pytest.raises(CheckpointError, match="structure mismatch"):
+        mgr.restore(b)
+
+
 def test_npz_shape_mismatch_rejected(tmp_path):
     cfg, params = tiny_params()
     mgr = CheckpointManager(str(tmp_path), backend="npz")

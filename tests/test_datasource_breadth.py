@@ -1,0 +1,227 @@
+"""Breadth datasources: embedded document store (Mongo shape), wide-column
+store (Cassandra shape: CAS + batches), TTL KV (Dynamo shape), profiler
+endpoints, telemetry opt-out."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from gofr_tpu.config.config import MapConfig as Config
+from gofr_tpu.datasource.document import EmbeddedDocumentStore
+from gofr_tpu.datasource.kv.store import KVError, TTLKVStore
+from gofr_tpu.datasource.widecolumn import EmbeddedWideColumnStore
+
+
+class TestDocumentStore:
+    @pytest.fixture()
+    def store(self):
+        s = EmbeddedDocumentStore()
+        s.connect()
+        yield s
+        s.close()
+
+    def test_insert_find_roundtrip(self, store):
+        oid = store.insert_one("users", {"name": "ada", "age": 36})
+        assert oid
+        doc = store.find_one("users", {"name": "ada"})
+        assert doc["age"] == 36 and doc["_id"] == oid
+        assert store.find_one("users", {"name": "ghost"}) is None
+
+    def test_filter_operators(self, store):
+        store.insert_many("nums", [{"n": i} for i in range(10)])
+        assert store.count_documents("nums", {"n": {"$gt": 7}}) == 2
+        assert store.count_documents("nums", {"n": {"$gte": 7}}) == 3
+        assert store.count_documents("nums", {"n": {"$lt": 2}}) == 2
+        assert store.count_documents("nums", {"n": {"$ne": 5}}) == 9
+        assert store.count_documents("nums", {"n": {"$in": [1, 3, 99]}}) == 2
+        with pytest.raises(ValueError):
+            store.find("nums", {"n": {"$regex": "x"}})
+
+    def test_updates(self, store):
+        store.insert_one("items", {"sku": "a", "qty": 1})
+        store.insert_one("items", {"sku": "b", "qty": 1})
+        assert store.update_one("items", {"sku": "a"}, {"$inc": {"qty": 4}}) == 1
+        assert store.find_one("items", {"sku": "a"})["qty"] == 5
+        assert store.update_many("items", {}, {"$set": {"checked": True}}) == 2
+        doc = store.find_one("items", {"sku": "b"})
+        oid = doc["_id"]
+        assert store.update_by_id("items", oid, {"sku": "b2", "qty": 9}) == 1
+        replaced = store.find_one("items", {"_id": oid})
+        assert replaced["sku"] == "b2" and "checked" not in replaced
+
+    def test_delete_and_drop(self, store):
+        store.insert_many("d", [{"x": 1}, {"x": 1}, {"x": 2}])
+        assert store.delete_one("d", {"x": 1}) == 1
+        assert store.delete_many("d", {"x": 1}) == 1
+        assert store.count_documents("d", {}) == 1
+        store.drop("d")
+        assert store.count_documents("d", {}) == 0
+
+    def test_injection_guard_and_health(self, store):
+        with pytest.raises(ValueError):
+            store.insert_one("users; DROP TABLE x", {"a": 1})
+        store.insert_one("safe_coll", {"a": 1})
+        h = store.health_check()
+        assert h["status"] == "UP"
+        assert "safe_coll" in h["details"]["collections"]
+
+
+class TestWideColumnStore:
+    @pytest.fixture()
+    def store(self):
+        s = EmbeddedWideColumnStore()
+        s.connect()
+        s.exec("CREATE TABLE kv (k TEXT PRIMARY KEY, v TEXT, version INTEGER)")
+        yield s
+        s.close()
+
+    def test_query_into_target(self, store):
+        store.exec("INSERT INTO kv VALUES (?, ?, ?)", "a", "1", 1)
+        out: list = []
+        rows = store.query(out, "SELECT * FROM kv WHERE k = ?", "a")
+        assert out == rows == [{"k": "a", "v": "1", "version": 1}]
+
+    def test_cas_insert_if_not_exists(self, store):
+        assert store.exec_cas(None, "INSERT INTO kv VALUES (?, ?, ?) IF NOT EXISTS", "x", "1", 1)
+        assert not store.exec_cas(None, "INSERT INTO kv VALUES (?, ?, ?) IF NOT EXISTS", "x", "2", 2)
+        out: list = []
+        store.query(out, "SELECT v FROM kv WHERE k = ?", "x")
+        assert out[0]["v"] == "1"  # second insert did not apply
+
+    def test_cas_update_if(self, store):
+        store.exec("INSERT INTO kv VALUES (?, ?, ?)", "y", "old", 1)
+        assert store.exec_cas(None, "UPDATE kv SET v = ?, version = ? WHERE k = ? IF version = ?",
+                              "new", 2, "y", 1)
+        assert not store.exec_cas(None, "UPDATE kv SET v = ? WHERE k = ? IF version = ?",
+                                  "newer", "y", 1)  # version moved on
+        out: list = []
+        store.query(out, "SELECT v, version FROM kv WHERE k = ?", "y")
+        assert out[0] == {"v": "new", "version": 2}
+
+    def test_batch_atomicity(self, store):
+        store.new_batch("b1", 0)
+        store.batch_query("b1", "INSERT INTO kv VALUES (?, ?, ?)", "b-1", "1", 1)
+        store.batch_query("b1", "INSERT INTO kv VALUES (?, ?, ?)", "b-2", "2", 1)
+        store.execute_batch("b1")
+        assert len(store.query([], "SELECT * FROM kv")) == 2
+        # failing batch rolls back entirely
+        store.new_batch("b2", 0)
+        store.batch_query("b2", "INSERT INTO kv VALUES (?, ?, ?)", "b-3", "3", 1)
+        store.batch_query("b2", "INSERT INTO nonexistent VALUES (?)", "boom")
+        with pytest.raises(Exception):
+            store.execute_batch("b2")
+        assert store.query([], "SELECT * FROM kv WHERE k = ?", "b-3") == []
+        with pytest.raises(KeyError):
+            store.execute_batch("b2")  # consumed
+        with pytest.raises(KeyError):
+            store.batch_query("never-created", "SELECT 1")
+
+    def test_health(self, store):
+        assert store.health_check()["status"] == "UP"
+
+    def test_cas_lowercase_insert(self, store):
+        assert store.exec_cas(None, "insert into kv values (?, ?, ?) IF NOT EXISTS", "lc", "1", 1)
+        assert not store.exec_cas(None, "insert into kv values (?, ?, ?) IF NOT EXISTS", "lc", "2", 2)
+
+
+class TestTTLKV:
+    def test_ttl_expiry(self):
+        kv = TTLKVStore()
+        kv.set("ephemeral", "v", ttl=0.05)
+        kv.set("stable", "v")
+        assert kv.get("ephemeral") == "v"
+        time.sleep(0.08)
+        with pytest.raises(KVError):
+            kv.get("ephemeral")
+        assert kv.get("stable") == "v"
+
+    def test_default_ttl_and_purge(self):
+        kv = TTLKVStore(default_ttl=0.05)
+        kv.set("a", "1")
+        kv.set("b", "2")
+        kv.set("keep", "3", ttl=100)
+        time.sleep(0.08)
+        assert kv.purge() == 2
+        assert kv.get("keep") == "3"
+        assert kv.health_check()["details"]["keys"] == 1
+
+    def test_from_config(self):
+        cfg = Config({"KV_DEFAULT_TTL_SECONDS": "30"})
+        kv = TTLKVStore.from_config(cfg)
+        assert kv.default_ttl == 30.0
+        # 0 = no expiry, not instant expiry
+        kv0 = TTLKVStore.from_config(Config({"KV_DEFAULT_TTL_SECONDS": "0"}))
+        assert kv0.default_ttl is None
+        kv0.set("k", "v")
+        assert kv0.get("k") == "v"
+
+
+class TestProfilerEndpoints:
+    def test_start_stop_cycle(self, tmp_path):
+        import asyncio
+
+        from gofr_tpu.container.container import Container
+        from gofr_tpu.metrics.server import MetricsHandler
+
+        container = Container(Config({"APP_NAME": "prof-test"}))
+        handler = MetricsHandler(container)
+
+        class Req:
+            def __init__(self, path, params=None, method="POST"):
+                self.path = path
+                self.method = method
+                self._params = params or {}
+
+            def param(self, key):
+                return self._params.get(key, "")
+
+        async def drive():
+            # state-changing endpoint refuses GET
+            r405 = await handler(Req("/debug/profiler/start", method="GET"))
+            assert r405.status == 405
+            r = await handler(Req("/debug/profiler/start", {"dir": str(tmp_path)}))
+            assert r.status == 200, r.body
+            r2 = await handler(Req("/debug/profiler/start"))
+            assert r2.status == 409  # already running
+            r3 = await handler(Req("/debug/profiler/stop"))
+            assert r3.status == 200
+            r4 = await handler(Req("/debug/profiler/stop"))
+            assert r4.status == 409  # not running
+
+        asyncio.run(drive())
+        # the trace actually hit disk (jax writes plugins/profile/...)
+        produced = list(tmp_path.rglob("*"))
+        assert produced, "profiler produced no trace files"
+
+
+class TestTelemetry:
+    def test_opt_out(self):
+        from gofr_tpu.telemetry import build_ping, telemetry_enabled
+
+        assert telemetry_enabled(Config({}))
+        assert not telemetry_enabled(Config({"GOFR_TELEMETRY": "false"}))
+        ping = build_ping(Config({}), "start")
+        assert ping["event"] == "start"
+        assert set(ping) == {"event", "framework_version", "python", "os", "arch"}
+
+    def test_send_ping_logs_not_network(self):
+        from gofr_tpu.telemetry import send_ping
+
+        lines = []
+
+        class FakeLogger:
+            def debug(self, msg):
+                lines.append(msg)
+
+        send_ping(Config({}), "start", FakeLogger())
+        deadline = time.time() + 2
+        while not lines and time.time() < deadline:
+            time.sleep(0.01)
+        assert lines and "telemetry start" in lines[0]
+        # disabled: nothing fires
+        lines.clear()
+        send_ping(Config({"GOFR_TELEMETRY": "false"}), "start", FakeLogger())
+        time.sleep(0.1)
+        assert not lines

@@ -1,0 +1,207 @@
+"""MQTT driver against the in-process broker: wire codec, QoS-1 ack flow,
+wildcards, at-least-once redelivery across reconnect, subscriber-loop
+integration."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from gofr_tpu.datasource.pubsub.mqtt import (
+    MQTTClient,
+    encode_remaining_length,
+    topic_matches,
+)
+from gofr_tpu.testutil.mqtt_broker import MiniMQTTBroker
+
+
+@pytest.fixture()
+def broker():
+    b = MiniMQTTBroker().start()
+    yield b
+    b.stop()
+
+
+def make_client(broker, **kw):
+    c = MQTTClient("127.0.0.1", broker.port, **kw)
+    c.connect()
+    return c
+
+
+class TestCodec:
+    def test_remaining_length_boundaries(self):
+        assert encode_remaining_length(0) == b"\x00"
+        assert encode_remaining_length(127) == b"\x7f"
+        assert encode_remaining_length(128) == b"\x80\x01"
+        assert encode_remaining_length(16383) == b"\xff\x7f"
+        assert encode_remaining_length(16384) == b"\x80\x80\x01"
+
+    def test_topic_matching(self):
+        assert topic_matches("a/b", "a/b")
+        assert topic_matches("a/+", "a/b")
+        assert not topic_matches("a/+", "a/b/c")
+        assert topic_matches("a/#", "a/b/c")
+        assert topic_matches("#", "anything/at/all")
+        assert not topic_matches("a/b", "a")
+        assert not topic_matches("+", "a/b")
+
+
+class TestDriver:
+    def test_publish_subscribe_roundtrip(self, broker):
+        pub = make_client(broker, client_id="pub")
+        sub = make_client(broker, client_id="sub")
+        try:
+            assert sub.subscribe("orders") is None  # registers the filter
+            pub.publish("orders", b"order-1", None)
+            msg = sub.subscribe("orders")
+            assert msg is not None
+            assert msg.value == b"order-1"
+            assert msg.topic == "orders"
+            msg.commit()
+        finally:
+            pub.close()
+            sub.close()
+
+    def test_qos0(self, broker):
+        pub = make_client(broker, client_id="pub0", qos=0)
+        sub = make_client(broker, client_id="sub0", qos=0)
+        try:
+            sub.subscribe("t0")
+            pub.publish("t0", b"fire-and-forget", None)
+            msg = sub.subscribe("t0")
+            assert msg is not None and msg.value == b"fire-and-forget"
+        finally:
+            pub.close()
+            sub.close()
+
+    def test_wildcard_subscription(self, broker):
+        pub = make_client(broker, client_id="wp")
+        sub = make_client(broker, client_id="ws")
+        try:
+            sub.subscribe("sensors/+/temp")
+            pub.publish("sensors/kitchen/temp", b"21.5", None)
+            msg = sub.subscribe("sensors/+/temp")
+            assert msg is not None
+            assert msg.topic == "sensors/kitchen/temp"
+            assert msg.value == b"21.5"
+        finally:
+            pub.close()
+            sub.close()
+
+    def test_uncommitted_redelivered_after_reconnect(self, broker):
+        """QoS-1 at-least-once: no PUBACK -> DUP redelivery on reconnect."""
+        pub = make_client(broker, client_id="rp")
+        sub = make_client(broker, client_id="rsub")
+        try:
+            sub.subscribe("jobs")
+            pub.publish("jobs", b"job-77", None)
+            msg = sub.subscribe("jobs")
+            assert msg is not None and msg.value == b"job-77"
+            # do NOT commit; drop the connection
+            sub.close()
+
+            sub2 = make_client(broker, client_id="rsub")  # same session
+            deadline = time.time() + 5
+            msg2 = None
+            while time.time() < deadline and msg2 is None:
+                msg2 = sub2.subscribe("jobs")
+            assert msg2 is not None, "QoS-1 message not redelivered"
+            assert msg2.value == b"job-77"
+            msg2.commit()
+            # committed: a third connect sees nothing
+            sub2.close()
+            sub3 = make_client(broker, client_id="rsub")
+            assert sub3.subscribe("jobs") is None
+            sub3.close()
+        finally:
+            pub.close()
+
+    def test_many_messages_in_order(self, broker):
+        pub = make_client(broker, client_id="mp")
+        sub = make_client(broker, client_id="ms")
+        try:
+            sub.subscribe("stream")
+            for i in range(50):
+                pub.publish("stream", f"m{i}".encode(), None)
+            got = []
+            deadline = time.time() + 10
+            while len(got) < 50 and time.time() < deadline:
+                msg = sub.subscribe("stream")
+                if msg is not None:
+                    got.append(msg.value.decode())
+                    msg.commit()
+            assert got == [f"m{i}" for i in range(50)]
+        finally:
+            pub.close()
+            sub.close()
+
+    def test_health_check(self, broker):
+        c = make_client(broker, client_id="hc")
+        try:
+            h = c.health_check()
+            assert h["status"] == "UP"
+            assert h["details"]["backend"] == "MQTT"
+        finally:
+            c.close()
+        assert c.health_check()["status"] == "DOWN"
+
+    def test_connect_refused_surfaces(self):
+        c = MQTTClient("127.0.0.1", 1)  # nothing listens on port 1
+        with pytest.raises(OSError):
+            c.connect()
+
+
+class TestSubscriberIntegration:
+    def test_app_subscriber_loop_consumes(self, broker):
+        """The framework's subscriber loop (SURVEY §3.4) drives the MQTT
+        driver exactly like the in-memory broker."""
+        import asyncio
+        import threading
+
+        import gofr_tpu
+
+        app = gofr_tpu.App()
+        driver = MQTTClient("127.0.0.1", broker.port, client_id="app-sub")
+        driver.connect()
+        app.container.pubsub = driver
+
+        seen = []
+        done = threading.Event()
+
+        def handler(ctx):
+            seen.append(ctx.bind(str))
+            if len(seen) >= 3:
+                done.set()
+            return None
+
+        app.subscribe("events", handler)
+
+        async def run_manager(stop_ev: asyncio.Event):
+            await app.subscription_manager.start()
+            await stop_ev.wait()
+            await app.subscription_manager.stop()
+
+        loop = asyncio.new_event_loop()
+        stop_ev: asyncio.Event | None = None
+
+        def loop_main():
+            nonlocal stop_ev
+            asyncio.set_event_loop(loop)
+            stop_ev = asyncio.Event()
+            loop.run_until_complete(run_manager(stop_ev))
+
+        t = threading.Thread(target=loop_main, daemon=True)
+        t.start()
+        pub = make_client(broker, client_id="app-pub")
+        try:
+            for i in range(3):
+                pub.publish("events", f"evt-{i}".encode(), None)
+            assert done.wait(timeout=15), f"only saw {seen}"
+            assert sorted(seen) == ["evt-0", "evt-1", "evt-2"]
+        finally:
+            pub.close()
+            if stop_ev is not None:
+                loop.call_soon_threadsafe(stop_ev.set)
+            t.join(timeout=10)
+            driver.close()
