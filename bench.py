@@ -4,14 +4,19 @@
 Round-1 benchmark: single-chip Llama-family batched decode throughput —
 the core of the north-star metric. BASELINE.json's target is >1,000 req/s
 aggregate on v5e-8 for Llama-3-8B /generate; with ~128 output tokens per
-request that is ~128k generated tok/s over 8 chips ⇒ **16k tok/s per chip**.
-``vs_baseline`` is measured tokens/s divided by that per-chip target (the
-reference itself publishes no numbers — BASELINE.md).
+request that is ~128k generated tok/s over 8 chips ⇒ **16k tok/s per
+chip**. ``vs_baseline`` is measured tokens/s divided by that per-chip
+target (the reference itself publishes no numbers — BASELINE.md).
 
 Model under test: a 1.1B-param Llama-shape (d=2048, L=16, GQA 16/8,
 ff=8192) in bf16 — big enough to exercise MXU/HBM realistically, small
-enough to init on-chip in seconds. Batch 32, decode via the production
-``decode_step`` path (scan over layers, dense KV cache, donated buffers).
+enough to init on-chip in seconds. Batch 32, decode via the fused
+one-dispatch step (llama.decode_step_greedy): forward + argmax + length
+increment in a single executable launch, because per-launch host↔device
+round trips dominate at decode step granularity. Timing syncs through
+``jax.device_get`` of the final token — the only sync that provably
+drains the pipeline on proxied PJRT backends (block_until_ready can
+return early there).
 """
 
 from __future__ import annotations
@@ -57,21 +62,22 @@ def main() -> None:
     seq_lens = jnp.full((batch,), prompt_len, jnp.int32)
     cache = llama.KVCache.create(cfg, batch, max_len=cache_len_max)
 
-    # compile + warmup
+    # compile + warmup (prefill, then one fused decode step)
     last, cache = llama.prefill(cfg, params, tokens, cache, seq_lens)
     next_tokens = jnp.argmax(last, axis=-1)
     cache_len = seq_lens
-    cache_len = cache_len + 1
-    last, cache = llama.decode_step(cfg, params, next_tokens, cache, cache_len)
-    jax.block_until_ready(last)
+    next_tokens, cache, cache_len = llama.decode_step_greedy(
+        cfg, params, next_tokens, cache, cache_len
+    )
+    jax.device_get(next_tokens)
 
-    # timed decode loop (async dispatch, one sync at the end)
+    # timed decode loop: one dispatch per token, one full sync at the end
     start = time.perf_counter()
     for _ in range(decode_steps):
-        cache_len = cache_len + 1
-        last, cache = llama.decode_step(cfg, params, next_tokens, cache, cache_len)
-        next_tokens = jnp.argmax(last, axis=-1)
-    jax.block_until_ready(next_tokens)
+        next_tokens, cache, cache_len = llama.decode_step_greedy(
+            cfg, params, next_tokens, cache, cache_len
+        )
+    jax.device_get(next_tokens)
     elapsed = time.perf_counter() - start
 
     tokens_per_sec = batch * decode_steps / elapsed

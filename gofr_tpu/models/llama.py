@@ -374,7 +374,22 @@ def decode_step_paged(
     return logits, k_pool, v_pool
 
 
-def greedy_generate(
+@partial(jax.jit, static_argnums=0, donate_argnums=(3, 4))
+def decode_step_greedy(
+    cfg: LlamaConfig,
+    params: dict,
+    tokens: jnp.ndarray,  # [B] last sampled token per row
+    cache: KVCache,
+    cache_len: jnp.ndarray,  # [B] length BEFORE this token's position
+) -> tuple[jnp.ndarray, KVCache, jnp.ndarray]:
+    """Fused decode step: forward + greedy argmax + length increment in ONE
+    dispatch. On hardware where every executable launch pays a host→device
+    round trip (PJRT over a proxy; multi-host controllers), folding the
+    3-dispatch sequence (len+1, forward, argmax) into one call is worth
+    milliseconds per token — this is the serving/bench hot path."""
+    cache_len = cache_len + 1
+    logits, cache = decode_step.__wrapped__(cfg, params, tokens, cache, cache_len)
+    return jnp.argmax(logits, axis=-1), cache, cache_len
     cfg: LlamaConfig,
     params: dict,
     prompt: jnp.ndarray,  # [B, S] right-padded
