@@ -1,0 +1,23 @@
+# Pre-commit gate (round-1 post-mortem: HEAD shipped with a SyntaxError
+# because nothing ran before the final commit). `make check` MUST pass
+# before every commit.
+
+PY ?= python
+
+.PHONY: check import-check test bench-smoke native
+
+check: import-check test bench-smoke
+	@echo "CHECK OK"
+
+import-check:
+	$(PY) -c "import compileall,sys; sys.exit(0 if compileall.compile_dir('gofr_tpu', quiet=2) else 1)"
+	$(PY) -c "import gofr_tpu; import __graft_entry__; print('import ok')"
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -x
+
+bench-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py
+
+native:
+	$(MAKE) -C native

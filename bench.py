@@ -30,7 +30,9 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    sys.path.insert(0, "/root/repo")
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from gofr_tpu.models import llama
 
     platform = jax.devices()[0].platform

@@ -146,12 +146,23 @@ class CheckpointManager:
     def _save_npz(self, step_dir: str, tree: Any) -> None:
         os.makedirs(step_dir, exist_ok=True)
         leaves, treedef = _flatten(tree)
+        # np.savez stores non-numpy-native dtypes (bfloat16, fp8) as raw
+        # void bytes that restore as 'V2' and are rejected by device_put —
+        # bit-cast those to a same-width uint and record the true dtype
+        dtypes = [str(leaf.dtype) for leaf in leaves]
+        stored = [
+            leaf.view(f"u{leaf.dtype.itemsize}") if leaf.dtype.kind == "V" else leaf
+            for leaf in leaves
+        ]
         np.savez(
             os.path.join(step_dir, "weights.npz"),
-            **{f"leaf_{i}": leaf for i, leaf in enumerate(leaves)},
+            **{f"leaf_{i}": leaf for i, leaf in enumerate(stored)},
         )
         with open(os.path.join(step_dir, "tree.json"), "w") as f:
-            json.dump({"treedef": str(treedef), "n_leaves": len(leaves)}, f)
+            json.dump(
+                {"treedef": str(treedef), "n_leaves": len(leaves), "dtypes": dtypes},
+                f,
+            )
 
     # ------------------------------------------------------------- restore
     def latest_step(self) -> int | None:
@@ -236,6 +247,7 @@ class CheckpointManager:
         # shape would silently permute weights (tree.json is the save-side
         # record of the structure)
         tree_json = os.path.join(step_dir, "tree.json")
+        saved: dict = {}
         if os.path.exists(tree_json):
             with open(tree_json) as f:
                 saved = json.load(f)
@@ -246,10 +258,24 @@ class CheckpointManager:
                     f"  target: {treedef}"
                 )
         restored = [data[f"leaf_{i}"] for i in range(len(leaves))]
+        saved_dtypes = saved.get("dtypes")
+        if saved_dtypes is not None:
+            # undo the save-side uint bit-cast of non-native dtypes (bf16 …)
+            import ml_dtypes  # noqa: F401  (registers the dtype names)
+
+            restored = [
+                arr.view(dt) if str(arr.dtype) != dt else arr
+                for arr, dt in zip(restored, saved_dtypes)
+            ]
         for i, (leaf, arr) in enumerate(zip(leaves, restored)):
             if tuple(getattr(leaf, "shape", arr.shape)) != arr.shape:
                 raise CheckpointError(
                     f"leaf {i} shape mismatch: expected {leaf.shape}, got {arr.shape}"
+                )
+            want = getattr(leaf, "dtype", None)
+            if want is not None and np.dtype(want) != arr.dtype:
+                raise CheckpointError(
+                    f"leaf {i} dtype mismatch: expected {want}, got {arr.dtype}"
                 )
         return jax.tree.unflatten(treedef, restored)
 

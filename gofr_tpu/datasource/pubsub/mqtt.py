@@ -167,6 +167,14 @@ class MQTTClient:
         self._pinger: threading.Thread | None = None
         self._closed = False
         self._connected = False
+        # PINGREQ/PINGRESP bookkeeping for the close() flush barrier: the
+        # broker answers pings in order, so resp-count catching up to
+        # req-count proves everything sent before the last PINGREQ was
+        # applied broker-side (a bare Event could be released by a stale
+        # PINGRESP answering the keepalive pinger's earlier request)
+        self._ping_cv = threading.Condition()
+        self._pings_sent = 0
+        self._pings_received = 0
         self._last_error: str | None = None
         self._logger: Any = None
         self._metrics: Any = None
@@ -209,6 +217,12 @@ class MQTTClient:
             sock.close()
             raise MQTTError(f"CONNACK refused: {body!r}")
         self._sock = sock
+        # new socket generation: in-flight pings from the old connection
+        # will never be answered — reset so the close() barrier stays
+        # satisfiable after a reconnect
+        with self._ping_cv:
+            self._pings_sent = 0
+            self._pings_received = 0
         self._connected = True
         self._reader = threading.Thread(target=self._read_loop, daemon=True,
                                         name="mqtt-reader")
@@ -228,6 +242,14 @@ class MQTTClient:
             if self._sock is None:
                 raise MQTTError("not connected")
             self._sock.sendall(data)
+
+    def _send_ping(self) -> int:
+        """Send PINGREQ; returns the resp-count that acknowledges it."""
+        with self._ping_cv:
+            self._pings_sent += 1
+            target = self._pings_sent
+        self._send(packet(PINGREQ, 0, b""))
+        return target
 
     def _packet_id(self) -> int:
         with self._lock:
@@ -250,7 +272,9 @@ class MQTTClient:
                     if ev:
                         ev.set()
                 elif ptype == PINGRESP:
-                    pass
+                    with self._ping_cv:
+                        self._pings_received += 1
+                        self._ping_cv.notify_all()
         except (MQTTError, OSError) as exc:
             self._connected = False
             self._last_error = str(exc)
@@ -277,7 +301,7 @@ class MQTTClient:
             if self._closed or self._sock is not sock:
                 return  # superseded by a reconnect
             try:
-                self._send(packet(PINGREQ, 0, b""))
+                self._send_ping()
             except (MQTTError, OSError):
                 return  # reader notices the dead socket
 
@@ -358,6 +382,21 @@ class MQTTClient:
         return {"status": "UP", "details": details}
 
     def close(self) -> None:
+        # flush barrier: the broker processes a connection's packets in
+        # order, so the PINGRESP answering the ping sent HERE proves every
+        # prior packet (e.g. a commit's PUBACK) was applied broker-side
+        if self._connected and self._sock is not None:
+            try:
+                target = self._send_ping()
+                deadline = time.monotonic() + 2
+                with self._ping_cv:
+                    while self._pings_received < target:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._ping_cv.wait(remaining)
+            except (MQTTError, OSError):
+                pass
         self._closed = True
         self._connected = False
         sock, self._sock = self._sock, None

@@ -390,6 +390,9 @@ def decode_step_greedy(
     cache_len = cache_len + 1
     logits, cache = decode_step.__wrapped__(cfg, params, tokens, cache, cache_len)
     return jnp.argmax(logits, axis=-1), cache, cache_len
+
+
+def greedy_generate(
     cfg: LlamaConfig,
     params: dict,
     prompt: jnp.ndarray,  # [B, S] right-padded
