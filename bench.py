@@ -1,27 +1,34 @@
 """Benchmark entry point (driver contract): prints ONE JSON line
 ``{"metric", "value", "unit", "vs_baseline"}``.
 
-Round-1 benchmark: single-chip Llama-family batched decode throughput —
-the core of the north-star metric. BASELINE.json's target is >1,000 req/s
+Benchmark: single-chip Llama-family batched decode throughput — the core
+of the north-star metric. BASELINE.json's target is >1,000 req/s
 aggregate on v5e-8 for Llama-3-8B /generate; with ~128 output tokens per
 request that is ~128k generated tok/s over 8 chips ⇒ **16k tok/s per
 chip**. ``vs_baseline`` is measured tokens/s divided by that per-chip
 target (the reference itself publishes no numbers — BASELINE.md).
 
 Model under test: a 1.1B-param Llama-shape (d=2048, L=16, GQA 16/8,
-ff=8192) in bf16 — big enough to exercise MXU/HBM realistically, small
-enough to init on-chip in seconds. Batch 32, decode via the fused
-one-dispatch step (llama.decode_step_greedy): forward + argmax + length
-increment in a single executable launch, because per-launch host↔device
-round trips dominate at decode step granularity. Timing syncs through
-``jax.device_get`` of the final token — the only sync that provably
-drains the pipeline on proxied PJRT backends (block_until_ready can
-return early there).
+ff=8192) in bf16. Decode batch 256 — the measured throughput knee on
+v5e (bigger batches degrade: the [B≤256] step is HBM-bound at
+~360 GB/s effective; past 256 XLA's fusion tiling falls off a cliff).
+Each decode step is the fused one-dispatch ``llama.decode_step_greedy``
+(forward + argmax + length increment): launches pipeline asynchronously,
+so per-launch host↔device latency (milliseconds on proxied PJRT
+backends) overlaps compute; the timed loop syncs ONCE at the end via
+``jax.device_get`` — the only sync that provably drains the pipeline on
+proxied backends (block_until_ready can return early there).
+
+The KV cache rides the scan *carry* with per-layer in-place updates
+(llama._layer_cached): scanning it as xs/ys cost two full-cache copies
+plus a slice/restack per step — that one structural fix took the same
+hardware from 4.4k to 21.7k tok/s.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -29,8 +36,6 @@ import time
 def main() -> None:
     import jax
     import jax.numpy as jnp
-
-    import os
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from gofr_tpu.models import llama
@@ -51,39 +56,54 @@ def main() -> None:
         # CPU fallback so the bench never crashes off-TPU; tiny shapes
         cfg = llama.LlamaConfig.tiny(dtype=jnp.bfloat16)
 
-    batch = 32 if platform == "tpu" else 4
+    batch = 256 if platform == "tpu" else 4
     prompt_len = 128 if platform == "tpu" else 8
     decode_steps = 64 if platform == "tpu" else 4
     cache_len_max = prompt_len + decode_steps + 8
 
     key = jax.random.PRNGKey(0)
-    params = llama.init_params(cfg, key)
-    params = jax.device_put(params)
+    params = jax.device_put(llama.init_params(cfg, key))
 
     tokens = jax.random.randint(key, (batch, prompt_len), 0, cfg.vocab_size)
     seq_lens = jnp.full((batch,), prompt_len, jnp.int32)
     cache = llama.KVCache.create(cfg, batch, max_len=cache_len_max)
 
     # compile + warmup (prefill, then one fused decode step)
+    t0 = time.perf_counter()
     last, cache = llama.prefill(cfg, params, tokens, cache, seq_lens)
     next_tokens = jnp.argmax(last, axis=-1)
+    jax.device_get(next_tokens[0])
+    prefill_warm_s = time.perf_counter() - t0
     cache_len = seq_lens
     next_tokens, cache, cache_len = llama.decode_step_greedy(
         cfg, params, next_tokens, cache, cache_len
     )
-    jax.device_get(next_tokens)
+    jax.device_get(next_tokens[0])
 
-    # timed decode loop: one dispatch per token, one full sync at the end
+    # timed decode loop: one dispatch per token, launches pipelined, one
+    # full sync at the end
     start = time.perf_counter()
     for _ in range(decode_steps):
         next_tokens, cache, cache_len = llama.decode_step_greedy(
             cfg, params, next_tokens, cache, cache_len
         )
-    jax.device_get(next_tokens)
+    jax.device_get(next_tokens[0])
     elapsed = time.perf_counter() - start
 
     tokens_per_sec = batch * decode_steps / elapsed
-    per_chip_target = 16000.0  # derived from the 1k req/s north star, see module docstring
+    step_ms = elapsed / decode_steps * 1e3
+
+    # effective HBM bandwidth: per step the chip streams the non-embedding
+    # weights (the embedding table is only gathered B rows at a time) plus
+    # the mean valid KV prefix per row
+    n_params = llama.param_count(params)
+    n_embed = cfg.vocab_size * cfg.d_model
+    bytes_weights = (n_params - n_embed) * 2 + batch * cfg.d_model * 2
+    mean_len = prompt_len + decode_steps / 2
+    bytes_kv = 2 * cfg.n_layers * batch * mean_len * cfg.n_kv_heads * cfg.head_dim * 2
+    eff_gbps = (bytes_weights + bytes_kv) / (elapsed / decode_steps) / 1e9
+
+    per_chip_target = 16000.0  # from the 1k req/s north star, see docstring
     print(
         json.dumps(
             {
@@ -91,6 +111,12 @@ def main() -> None:
                 "value": round(tokens_per_sec, 2),
                 "unit": "tokens/s",
                 "vs_baseline": round(tokens_per_sec / per_chip_target, 4),
+                "details": {
+                    "decode_step_ms": round(step_ms, 3),
+                    "prefill_warm_s": round(prefill_warm_s, 2),
+                    "est_hbm_gbps": round(eff_gbps, 1),
+                    "params": n_params,
+                },
             }
         )
     )

@@ -137,6 +137,38 @@ class KVCache:
 
 
 # ---------------------------------------------------------------- layer body
+def _qkv(
+    cfg: LlamaConfig,
+    x: jnp.ndarray,  # [B, S, D]
+    lp: dict,
+    sin: jnp.ndarray,
+    cos: jnp.ndarray,
+    positions: jnp.ndarray,  # [B, S]
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Shared layer preamble: attn-norm + QKV projections + RoPE.
+    Returns (h_normed, q, k, v)."""
+    B, S, _ = x.shape
+    H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(B, S, H, Dh)
+    k = (h @ lp["wk"]).reshape(B, S, Hkv, Dh)
+    v = (h @ lp["wv"]).reshape(B, S, Hkv, Dh)
+    q = apply_rope(q, positions, sin, cos)
+    k = apply_rope(k, positions, sin, cos)
+    return h, q, k, v
+
+
+def _attn_mlp_epilogue(
+    cfg: LlamaConfig, x: jnp.ndarray, lp: dict, attn: jnp.ndarray
+) -> jnp.ndarray:
+    """Shared layer epilogue: attn output projection + SwiGLU MLP."""
+    B, S, _ = x.shape
+    x = x + attn.reshape(B, S, cfg.n_heads * cfg.head_dim) @ lp["wo"]
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    return x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+
+
 def _layer(
     cfg: LlamaConfig,
     x: jnp.ndarray,  # [B, S, D]
@@ -144,58 +176,70 @@ def _layer(
     sin: jnp.ndarray,
     cos: jnp.ndarray,
     positions: jnp.ndarray,  # [B, S] absolute positions
-    k_cache: jnp.ndarray | None,  # [B, S_max, Hkv, Dh]
-    v_cache: jnp.ndarray | None,
-    cache_len: jnp.ndarray | None,  # [B] length AFTER writing current tokens
+) -> jnp.ndarray:
+    """Cache-less layer (training/forward path). The cached prefill/decode
+    modes live in _layer_cached, which carries the stacked KV cache."""
+    _, q, k, v = _qkv(cfg, x, lp, sin, cos, positions)
+
+    if cfg.attn_impl == "cp":
+        # long-context path: seq axis sharded on the sp mesh axis, ring
+        # or Ulysses attention per the ambient cp_context (§5.7)
+        from gofr_tpu.parallel.context_parallel import cp_attention
+
+        attn = cp_attention(q, k, v)
+    else:
+        attn = attention(q, k, v, causal=True, kv_len=None)
+    return _attn_mlp_epilogue(cfg, x, lp, attn)
+
+
+def _layer_cached(
+    cfg: LlamaConfig,
+    x: jnp.ndarray,  # [B, S, D]
+    lp: dict,  # per-layer params (leading L axis stripped by scan)
+    layer: jnp.ndarray,  # scalar layer index (traced)
+    sin: jnp.ndarray,
+    cos: jnp.ndarray,
+    positions: jnp.ndarray,  # [B, S]
+    k_all: jnp.ndarray,  # [L, B, S_max, Hkv, Dh] — FULL stacked cache
+    v_all: jnp.ndarray,
+    cache_len: jnp.ndarray,  # [B] length AFTER writing current tokens
     mode: str,
-) -> tuple[jnp.ndarray, jnp.ndarray | None, jnp.ndarray | None]:
-    B, S, D = x.shape
-    H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Layer body for the cached modes, carrying the WHOLE stacked cache.
 
-    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (h @ lp["wq"]).reshape(B, S, H, Dh)
-    k = (h @ lp["wk"]).reshape(B, S, Hkv, Dh)
-    v = (h @ lp["wv"]).reshape(B, S, Hkv, Dh)
-    q = apply_rope(q, positions, sin, cos)
-    k = apply_rope(k, positions, sin, cos)
+    Scanning the cache as xs/ys (the obvious formulation) makes XLA slice
+    layer caches out, restack them, and take two full-cache copies per
+    step — profiled at ~15 ms of a 25 ms decode step at B=256. Keeping
+    the stacked cache in the scan *carry* and doing per-layer indexed
+    in-place updates leaves it resident in HBM: per step the only cache
+    traffic is the attention read plus a one-token scatter."""
+    B, S, _ = x.shape
+    _, q, k, v = _qkv(cfg, x, lp, sin, cos, positions)
 
-    if mode == "prefill_nocache":
-        if cfg.attn_impl == "cp":
-            # long-context path: seq axis sharded on the sp mesh axis, ring
-            # or Ulysses attention per the ambient cp_context (§5.7)
-            from gofr_tpu.parallel.context_parallel import cp_attention
-
-            attn = cp_attention(q, k, v)
-        else:
-            attn = attention(q, k, v, causal=True, kv_len=None)
-        new_k = new_v = None
-    elif mode == "prefill":
-        # right-padded rows all start at 0: write the whole slab at offset 0
-        new_k = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, 0, 0))
-        new_v = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, 0, 0))
+    if mode == "prefill":
+        # fill layer `layer`'s slab in place; attention runs on the fresh
+        # k/v directly (no cache read-back needed during prefill)
+        k_all = jax.lax.dynamic_update_slice(k_all, k[None], (layer, 0, 0, 0, 0))
+        v_all = jax.lax.dynamic_update_slice(v_all, v[None], (layer, 0, 0, 0, 0))
         use_flash_auto = (
             cfg.attn_impl == "auto"
             and S % 128 == 0
-            and jax.default_backend() == "tpu"  # interpret mode off-TPU is slow
+            and jax.default_backend() == "tpu"
         )
         if cfg.attn_impl == "flash" or use_flash_auto:
             attn = flash_attention(q, k, v, cache_len, causal=True)
         else:
             attn = attention(q, k, v, causal=True, kv_len=cache_len)
-    else:  # decode: S == 1, scatter at per-row positions
+    else:  # decode: S == 1, one-token scatter at (layer, row, position)
         idx = cache_len - 1  # position just written
         b_idx = jnp.arange(B)
-        new_k = k_cache.at[b_idx, idx].set(k[:, 0])
-        new_v = v_cache.at[b_idx, idx].set(v[:, 0])
-        attn = decode_attention(q, new_k, new_v, cache_len)
+        k_all = k_all.at[layer, b_idx, idx].set(k[:, 0])
+        v_all = v_all.at[layer, b_idx, idx].set(v[:, 0])
+        kc = jax.lax.dynamic_index_in_dim(k_all, layer, 0, keepdims=False)
+        vc = jax.lax.dynamic_index_in_dim(v_all, layer, 0, keepdims=False)
+        attn = decode_attention(q, kc, vc, cache_len)
 
-    attn = attn.reshape(B, S, H * Dh)
-    x = x + attn @ lp["wo"]
-
-    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-    x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
-    return x, new_k, new_v
+    return _attn_mlp_epilogue(cfg, x, lp, attn), k_all, v_all
 
 
 def _run_layers(
@@ -219,18 +263,27 @@ def _run_layers(
 
     if cache is None:
         def body(h, lp):
-            h, _, _ = _layer(cfg, h, lp, sin, cos, positions, None, None, cache_len, mode)
+            h = _layer(cfg, h, lp, sin, cos, positions)
             return h, None
 
         x, _ = jax.lax.scan(body, x, params["layers"])
         return x, None
 
-    def body(h, xs):
-        lp, kc, vc = xs
-        h, nk, nv = _layer(cfg, h, lp, sin, cos, positions, kc, vc, cache_len, mode)
-        return h, (nk, nv)
+    # cache modes: the stacked cache rides the CARRY (in-place per-layer
+    # updates), never the xs/ys path — see _layer_cached's docstring
+    def body(carry, xs):
+        h, k_all, v_all = carry
+        lp, layer = xs
+        h, k_all, v_all = _layer_cached(
+            cfg, h, lp, layer, sin, cos, positions, k_all, v_all, cache_len, mode
+        )
+        return (h, k_all, v_all), None
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    (x, new_k, new_v), _ = jax.lax.scan(
+        body,
+        (x, cache.k, cache.v),
+        (params["layers"], jnp.arange(cfg.n_layers)),
+    )
     return x, KVCache(new_k, new_v)
 
 
@@ -283,8 +336,12 @@ def prefill(
     x = params["embedding"][tokens].astype(cfg.dtype)
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     x, cache = _run_layers(cfg, params, x, positions, cache, seq_lens, "prefill")
-    logits = _logits(cfg, params, x)  # [B, S, V]
-    last = jnp.take_along_axis(logits, (seq_lens - 1)[:, None, None], axis=1)[:, 0]
+    # gather last hidden state BEFORE the lm_head: computing [B, S, V]
+    # logits just to slice one position wastes 2·B·S·D·V flops and a
+    # B·S·V f32 temp (6.3 GB at B=384, S=128, V=32k — an OOM at serving
+    # batch sizes)
+    last_h = jnp.take_along_axis(x, (seq_lens - 1)[:, None, None], axis=1)  # [B,1,D]
+    last = _logits(cfg, params, last_h)[:, 0]  # [B, V]
     return last, cache
 
 
@@ -390,6 +447,36 @@ def decode_step_greedy(
     cache_len = cache_len + 1
     logits, cache = decode_step.__wrapped__(cfg, params, tokens, cache, cache_len)
     return jnp.argmax(logits, axis=-1), cache, cache_len
+
+
+@partial(jax.jit, static_argnums=(0, 5), donate_argnums=(3,))
+def decode_loop_greedy(
+    cfg: LlamaConfig,
+    params: dict,
+    tokens: jnp.ndarray,  # [B] last sampled token per row
+    cache: KVCache,
+    cache_len: jnp.ndarray,  # [B] length BEFORE the first new position
+    n_steps: int,
+) -> tuple[jnp.ndarray, KVCache, jnp.ndarray, jnp.ndarray]:
+    """``n_steps`` greedy decode steps fused into ONE dispatch via
+    ``lax.scan``. Useful when launches CANNOT be pipelined (e.g. the host
+    must observe each token, or a strict one-outstanding-dispatch PJRT
+    proxy); when the caller can keep the dispatch queue full, the
+    per-step ``decode_step_greedy`` loop measures slightly faster (the
+    bench uses that). Returns (last_token, cache, cache_len,
+    tokens [B, n_steps])."""
+
+    def body(carry, _):
+        tokens, cache, cache_len = carry
+        tokens, cache, cache_len = decode_step_greedy.__wrapped__(
+            cfg, params, tokens, cache, cache_len
+        )
+        return (tokens, cache, cache_len), tokens
+
+    (tokens, cache, cache_len), toks = jax.lax.scan(
+        body, (tokens, cache, cache_len), None, length=n_steps
+    )
+    return tokens, cache, cache_len, jnp.transpose(toks)  # [B, n_steps]
 
 
 def greedy_generate(

@@ -38,15 +38,23 @@ def attention(
     kv_len: jnp.ndarray | None = None,  # [B] valid KV length per row
     scale: float | None = None,
 ) -> jnp.ndarray:
-    """Dense attention. ``q_offset`` is the absolute position of q[0] (for
-    chunked prefill); ``kv_len`` masks right-padded KV."""
+    """Dense attention, GQA-native. Queries are grouped as
+    ``[B, Sq, Hkv, G, D]`` and contracted against the *unexpanded* KV —
+    never ``jnp.repeat`` the cache: at decode batch sizes the materialized
+    [B, S, H, D] copies would double-to-quadruple HBM traffic in the hot
+    path (the step is bandwidth-bound). ``q_offset`` is the absolute
+    position of q[0] (for chunked prefill); ``kv_len`` masks right-padded
+    KV."""
     B, Sq, H, D = q.shape
-    Sk = k.shape[1]
+    Sk, Hkv = k.shape[1], k.shape[2]
+    G = H // Hkv
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
-    k = gqa_repeat(k, H)
-    v = gqa_repeat(v, H)
+    qg = q.reshape(B, Sq, Hkv, G, D)
 
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    # [B, Hkv, G, Sq, Sk] f32
+    logits = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
+    )
     logits = logits * scale
 
     mask = None
@@ -54,18 +62,18 @@ def attention(
         q_pos = jnp.arange(Sq)[:, None] + q_offset  # [Sq, 1]
         k_pos = jnp.arange(Sk)[None, :]
         mask = k_pos <= q_pos  # [Sq, Sk]
-        mask = mask[None, None, :, :]
+        mask = mask[None, None, None, :, :]
     if kv_len is not None:
         valid = jnp.arange(Sk)[None, :] < kv_len[:, None]  # [B, Sk]
-        valid = valid[:, None, None, :]
+        valid = valid[:, None, None, None, :]
         mask = valid if mask is None else (mask & valid)
     if mask is not None:
         logits = jnp.where(mask, logits, NEG_INF)
 
     probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
     probs = probs / (jnp.sum(probs, axis=-1, keepdims=True) + 1e-30)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
-    return out
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v)
+    return out.reshape(B, Sq, H, D)
 
 
 def decode_attention(

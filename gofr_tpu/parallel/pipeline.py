@@ -120,10 +120,7 @@ def pp_forward(
 
     def stage_fn(stage_layers: dict, h: jnp.ndarray) -> jnp.ndarray:
         def layer_body(h, lp):
-            h, _, _ = _layer(
-                cfg, h, lp, sin, cos, positions, None, None, None,
-                "prefill_nocache",
-            )
+            h = _layer(cfg, h, lp, sin, cos, positions)
             return h, None
 
         h, _ = jax.lax.scan(layer_body, h, stage_layers)

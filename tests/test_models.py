@@ -117,3 +117,22 @@ def test_bert_padding_invariance():
     e1 = bert.embed(cfg, params, short, jnp.array([3]))
     e2 = bert.embed(cfg, params, padded, jnp.array([3]))
     np.testing.assert_allclose(e1, e2, rtol=1e-4, atol=1e-5)
+
+
+def test_decode_loop_matches_stepwise(tiny_llama):
+    """The scan-fused decode loop must emit exactly the tokens the
+    stepwise decode_step_greedy path does."""
+    cfg, params = tiny_llama
+    prompt = jnp.array([[5, 9, 2]])
+    seq_lens = jnp.array([3])
+    n = 5
+    oracle = llama.greedy_generate(cfg, params, prompt, seq_lens, n + 1)
+
+    cache = llama.KVCache.create(cfg, 1, max_len=16)
+    logits, cache = llama.prefill(cfg, params, prompt, cache, seq_lens)
+    first = jnp.argmax(logits, axis=-1)
+    _, _, _, toks = llama.decode_loop_greedy(
+        cfg, params, first, cache, seq_lens, n
+    )
+    got = jnp.concatenate([first[:, None], toks], axis=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(oracle))
