@@ -152,9 +152,9 @@ def ulysses_attention_sharded(
     if H % n != 0:
         raise ValueError(f"heads {H} not divisible by {axis_name}={n}")
     if k.shape[2] % n != 0:
-        # repeat KV only to lcm(Hkv, n) — enough for an even head split; the
-        # inner attention's gqa_repeat finishes the broadcast locally, so the
-        # all_to_all moves the minimum KV volume
+        # repeat KV only to lcm(Hkv, n) — enough for an even head split;
+        # the inner attention contracts grouped queries against the
+        # unexpanded KV, so the all_to_all moves the minimum KV volume
         target = _math.lcm(k.shape[2], n)
         k = gqa_repeat(k, target)
         v = gqa_repeat(v, target)

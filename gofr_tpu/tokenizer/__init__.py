@@ -1,0 +1,81 @@
+"""Real-model tokenizers, dependency-free.
+
+The serving engine's tokenizer boundary (serving/tokenizer.py Protocol)
+accepts any encode/decode implementation; this package provides the two
+families real Llama checkpoints ship with:
+
+- ``BPETokenizer`` (bpe.py) — byte-level BPE parsing the HF
+  ``tokenizer.json`` format (Llama-3 / GPT-2 lineage).
+- ``SentencePieceTokenizer`` (spm.py) — unigram Viterbi over a
+  SentencePiece ``.model`` protobuf (Llama-2 lineage), parsed with a
+  built-in wire-format reader.
+
+Reference parity: the reference loads external assets through its file
+datasource abstraction (/root/reference/pkg/gofr/datasource/file/
+interface.go:48-61); tokenizer assets load through plain paths here and
+through the object-store datasource once mounted.
+"""
+
+from __future__ import annotations
+
+import os
+
+from gofr_tpu.tokenizer.bpe import BPETokenizer
+from gofr_tpu.tokenizer.spm import SentencePieceTokenizer
+
+__all__ = ["BPETokenizer", "SentencePieceTokenizer", "load_tokenizer"]
+
+
+def load_tokenizer(path: str, fs=None):
+    """Auto-detect a tokenizer asset: a ``tokenizer.json`` (HF byte-level
+    BPE) file or directory containing one, or a SentencePiece ``.model``
+    file (or directory containing ``tokenizer.model``). ``fs``: optional
+    file datasource (``open``/``exists``) so assets load from object
+    stores the same way weights do."""
+    import json
+
+    if fs is not None:
+        exists = getattr(fs, "exists", None)
+
+        def _read(p: str) -> bytes | None:
+            if exists is not None and not exists(p):
+                return None
+            try:
+                with fs.open(p, "rb") as f:
+                    return f.read()
+            except (FileNotFoundError, OSError):
+                return None
+
+        candidates = (
+            [path]
+            if path.endswith((".json", ".model"))
+            else [os.path.join(path, n) for n in ("tokenizer.json", "tokenizer.model")]
+        )
+        for candidate in candidates:
+            data = _read(candidate)
+            if data is None:
+                continue
+            if candidate.endswith(".json"):
+                cfg_raw = _read(
+                    os.path.join(os.path.dirname(candidate), "tokenizer_config.json")
+                )
+                tok_cfg = json.loads(cfg_raw) if cfg_raw else None
+                return BPETokenizer.from_spec(json.loads(data), tok_cfg)
+            return SentencePieceTokenizer.from_bytes(data)
+        raise FileNotFoundError(
+            f"no tokenizer.json or tokenizer.model under {path}"
+        )
+
+    if os.path.isdir(path):
+        for name in ("tokenizer.json", "tokenizer.model"):
+            candidate = os.path.join(path, name)
+            if os.path.exists(candidate):
+                path = candidate
+                break
+        else:
+            raise FileNotFoundError(
+                f"no tokenizer.json or tokenizer.model under {path}"
+            )
+    if path.endswith(".json"):
+        return BPETokenizer.from_file(path)
+    return SentencePieceTokenizer.from_file(path)

@@ -1,0 +1,256 @@
+"""Byte-level BPE tokenizer reading the HF ``tokenizer.json`` format.
+
+Own implementation (no ``tokenizers`` dependency in the product path):
+parses vocab + merges, applies the file's pre-tokenization regex, and
+round-trips text through the GPT-2 byte↔unicode table. Llama-3, GPT-2,
+and Qwen-family assets all load through this. The installed ``tokenizers``
+wheel is used in tests as the conformance oracle only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any
+
+import regex as _regex
+
+# GPT-2 pre-tokenization pattern — the default when the asset doesn't
+# carry its own Split pattern.
+GPT2_PATTERN = (
+    r"""'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"""
+)
+
+
+def bytes_to_unicode() -> dict[int, str]:
+    """The GPT-2 printable-byte table: maps every byte 0..255 to a unicode
+    char such that 'visible' bytes map to themselves."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("¡"), ord("¬") + 1))
+        + list(range(ord("®"), ord("ÿ") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, (chr(c) for c in cs)))
+
+
+_BYTE_ENCODER = bytes_to_unicode()
+_BYTE_DECODER = {c: b for b, c in _BYTE_ENCODER.items()}
+
+
+def _find_pattern(pre_tokenizer: dict | None) -> str:
+    """Extract the Split regex from a (possibly nested) pre_tokenizer."""
+    if not pre_tokenizer:
+        return GPT2_PATTERN
+    kind = pre_tokenizer.get("type")
+    if kind == "Split":
+        pat = pre_tokenizer.get("pattern", {})
+        return pat.get("Regex") or pat.get("String") or GPT2_PATTERN
+    if kind == "Sequence":
+        for sub in pre_tokenizer.get("pretokenizers", []):
+            if sub.get("type") == "Split":
+                return _find_pattern(sub)
+    return GPT2_PATTERN
+
+
+class BPETokenizer:
+    """Serving-engine Tokenizer (serving/tokenizer.py Protocol)."""
+
+    def __init__(
+        self,
+        vocab: dict[str, int],
+        merges: list[tuple[str, str]],
+        *,
+        pattern: str = GPT2_PATTERN,
+        special_tokens: dict[str, int] | None = None,
+        bos_token: str | None = None,
+        eos_token: str | None = None,
+        pad_token: str | None = None,
+        add_bos: bool = False,
+    ) -> None:
+        self.vocab = vocab
+        self.ranks = {tuple(m): i for i, m in enumerate(merges)}
+        self.pattern = _regex.compile(pattern)
+        self.special_tokens = dict(special_tokens or {})
+        self.id_to_token = {i: t for t, i in vocab.items()}
+        for tok, i in self.special_tokens.items():
+            self.id_to_token.setdefault(i, tok)
+        self.vocab_size = max(self.id_to_token, default=-1) + 1
+        self.add_bos = add_bos
+
+        def _sid(token: str | None, *fallbacks: str) -> int | None:
+            for cand in (token, *fallbacks):
+                if cand is not None:
+                    i = self.special_tokens.get(cand)
+                    if i is None:
+                        i = self.vocab.get(cand)
+                    if i is not None:
+                        return i
+            return None
+
+        def _by_pattern(pat: str) -> int | None:
+            rx = _regex.compile(pat)
+            for tok, i in sorted(self.special_tokens.items(), key=lambda kv: kv[1]):
+                if rx.search(tok):
+                    return i
+            return None
+
+        bos = _sid(bos_token, "<|begin_of_text|>", "<s>", "<|endoftext|>")
+        eos = _sid(eos_token, "<|end_of_text|>", "</s>", "<|endoftext|>")
+        pad = _sid(pad_token, "<pad>")
+        if bos is None:
+            bos = _by_pattern(r"(?i)bos|begin")
+        if eos is None:
+            eos = _by_pattern(r"(?i)eos|end|im_end")
+        # eos = -1 is the never-stop sentinel: no real vocab id may double
+        # as a stop token (defaulting to 0 would make the engine stop on a
+        # legitimate token). pad at 0 is only used to fill masked positions.
+        self.bos_id = bos if bos is not None else 0
+        self.eos_id = eos if eos is not None else -1
+        self.pad_id = pad if pad is not None else (self.eos_id if self.eos_id >= 0 else 0)
+        self._special_ids = frozenset(self.special_tokens.values())
+        self._cache: dict[str, list[int]] = {}
+        self._cache_lock = threading.Lock()
+        if self.special_tokens:
+            # one alternation that splits text on special-token literals
+            alts = "|".join(
+                _regex.escape(t)
+                for t in sorted(self.special_tokens, key=len, reverse=True)
+            )
+            self._special_re = _regex.compile(f"({alts})")
+        else:
+            self._special_re = None
+
+    # ------------------------------------------------------------ loading
+    @classmethod
+    def from_file(cls, path: str) -> "BPETokenizer":
+        with open(path) as f:
+            spec = json.load(f)
+        tok_cfg = None
+        cfg_path = os.path.join(os.path.dirname(path), "tokenizer_config.json")
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                tok_cfg = json.load(f)
+        return cls.from_spec(spec, tok_cfg)
+
+    @classmethod
+    def from_spec(
+        cls, spec: dict, tokenizer_config: dict | None = None
+    ) -> "BPETokenizer":
+        """Build from a parsed tokenizer.json dict (and optionally the
+        sibling tokenizer_config.json dict naming bos/eos/pad)."""
+        model = spec.get("model", {})
+        if model.get("type") not in (None, "BPE"):
+            raise ValueError(f"unsupported tokenizer model type {model.get('type')}")
+        vocab = model.get("vocab", {})
+        merges_raw = model.get("merges", [])
+        merges: list[tuple[str, str]] = []
+        for m in merges_raw:
+            if isinstance(m, str):
+                a, _, b = m.partition(" ")
+                merges.append((a, b))
+            else:
+                merges.append((m[0], m[1]))
+        special = {
+            t["content"]: t["id"]
+            for t in spec.get("added_tokens", [])
+            if t.get("special")
+        }
+        pattern = _find_pattern(spec.get("pre_tokenizer"))
+        # tokenizer_config.json names the bos/eos tokens
+        bos = eos = pad = None
+        if tokenizer_config:
+
+            def _name(v: Any) -> str | None:
+                return v.get("content") if isinstance(v, dict) else v
+
+            bos = _name(tokenizer_config.get("bos_token"))
+            eos = _name(tokenizer_config.get("eos_token"))
+            pad = _name(tokenizer_config.get("pad_token"))
+        return cls(
+            vocab,
+            merges,
+            pattern=pattern,
+            special_tokens=special,
+            bos_token=bos,
+            eos_token=eos,
+            pad_token=pad,
+        )
+
+    # ------------------------------------------------------------ encoding
+    def _bpe_word(self, word: str) -> list[int]:
+        """Merge loop for one pre-tokenized word (already byte-mapped)."""
+        with self._cache_lock:
+            cached = self._cache.get(word)
+        if cached is not None:
+            return cached
+        parts = list(word)
+        while len(parts) > 1:
+            best_rank = None
+            best_i = -1
+            for i in range(len(parts) - 1):
+                rank = self.ranks.get((parts[i], parts[i + 1]))
+                if rank is not None and (best_rank is None or rank < best_rank):
+                    best_rank, best_i = rank, i
+            if best_rank is None:
+                break
+            parts[best_i : best_i + 2] = [parts[best_i] + parts[best_i + 1]]
+        ids = []
+        for p in parts:
+            i = self.vocab.get(p)
+            if i is None:  # unmergeable byte with no vocab entry
+                ids.extend(self.vocab.get(ch, 0) for ch in p)
+            else:
+                ids.append(i)
+        with self._cache_lock:
+            if len(self._cache) > 65536:
+                self._cache.clear()
+            self._cache[word] = ids
+        return ids
+
+    def _encode_plain(self, text: str) -> list[int]:
+        out: list[int] = []
+        for word in self.pattern.findall(text):
+            mapped = "".join(_BYTE_ENCODER[b] for b in word.encode("utf-8"))
+            out.extend(self._bpe_word(mapped))
+        return out
+
+    def encode(self, text: str, *, add_bos: bool | None = None) -> list[int]:
+        out: list[int] = []
+        if add_bos if add_bos is not None else self.add_bos:
+            out.append(self.bos_id)
+        if self._special_re is None:
+            out.extend(self._encode_plain(text))
+            return out
+        for chunk in self._special_re.split(text):
+            if not chunk:
+                continue
+            sid = self.special_tokens.get(chunk)
+            if sid is not None:
+                out.append(sid)
+            else:
+                out.extend(self._encode_plain(chunk))
+        return out
+
+    # ------------------------------------------------------------ decoding
+    def decode(self, ids: list[int]) -> str:
+        data = bytearray()
+        for i in ids:
+            i = int(i)
+            tok = self.id_to_token.get(i)
+            if tok is None or i in self._special_ids:
+                continue
+            for ch in tok:
+                b = _BYTE_DECODER.get(ch)
+                if b is not None:
+                    data.append(b)
+                else:  # non-byte-level token (added non-special)
+                    data.extend(ch.encode("utf-8"))
+        return data.decode("utf-8", "replace")

@@ -1,0 +1,111 @@
+"""End-to-end real-model serving: HF safetensors weights + a real BPE
+tokenizer through the continuous-batching engine, with transformers'
+greedy generate as the oracle (VERDICT round-1 item 3 done-condition)."""
+
+from __future__ import annotations
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+pytest.importorskip("transformers")
+pytest.importorskip("tokenizers")
+
+from gofr_tpu.serving.engine import EngineConfig, ServingEngine  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def real_model_dir(tmp_path_factory):
+    """An HF-layout model dir: safetensors weights + tokenizer.json."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, decoders, trainers
+    from transformers import LlamaConfig as HFConfig, LlamaForCausalLM
+
+    path = tmp_path_factory.mktemp("real_model")
+
+    tok = Tokenizer(models.BPE())
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=384,
+        special_tokens=["<|bos|>", "<|eos|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    )
+    tok.train_from_iterator(
+        ["the quick brown fox", "hello world hello engine", "pad pad pad"] * 5,
+        trainer,
+    )
+    tok.save(str(path / "tokenizer.json"))
+
+    torch.manual_seed(7)
+    hf_cfg = HFConfig(
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    model = LlamaForCausalLM(hf_cfg).eval()
+    model.save_pretrained(str(path), safe_serialization=True)
+    return str(path), model, tok
+
+
+def test_engine_serves_real_checkpoint_deterministically(real_model_dir):
+    path, model, oracle_tok = real_model_dir
+    engine = ServingEngine.from_hf(
+        path,
+        dtype=jnp.float32,
+        engine_config=EngineConfig(max_slots=2, max_seq_len=64),
+    )
+    engine.start()
+    try:
+        prompt = "hello world"
+        n_new = 6
+        prompt_ids = oracle_tok.encode(prompt).ids
+        with torch.no_grad():
+            ref_ids = model.generate(
+                torch.tensor([prompt_ids], dtype=torch.long),
+                max_new_tokens=n_new,
+                do_sample=False,
+                pad_token_id=0,
+            ).numpy()[0, len(prompt_ids):]
+
+        async def go():
+            return await engine.generate(
+                prompt, max_new_tokens=n_new, temperature=0.0
+            )
+
+        result = asyncio.run(go())
+        # token-exact vs transformers (engine may stop early at eos)
+        got = result.token_ids
+        expect = list(ref_ids)
+        if engine.tokenizer.eos_id in expect:
+            expect = expect[: expect.index(engine.tokenizer.eos_id) + 1]
+        assert got == expect[: len(got)] and len(got) >= 1
+        # and the text is our tokenizer's decode of those ids
+        assert result.text == engine.tokenizer.decode(got)
+
+        # deterministic across calls
+        result2 = asyncio.run(go())
+        assert result2.token_ids == got
+    finally:
+        engine.stop()
+
+
+def test_from_hf_without_tokenizer_asset_falls_back(tmp_path, real_model_dir):
+    import shutil
+
+    path, _, _ = real_model_dir
+    bare = tmp_path / "bare"
+    shutil.copytree(path, bare)
+    (bare / "tokenizer.json").unlink()
+    engine = ServingEngine.from_hf(str(bare), dtype=jnp.float32)
+    from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+    assert isinstance(engine.tokenizer, ByteTokenizer)

@@ -1,0 +1,196 @@
+"""Tokenizers: own byte-level BPE vs the installed `tokenizers` oracle,
+own SentencePiece parser/encoder on a handcrafted model proto."""
+
+from __future__ import annotations
+
+import json
+import struct
+
+import pytest
+
+from gofr_tpu.tokenizer import BPETokenizer, SentencePieceTokenizer, load_tokenizer
+
+SAMPLES = [
+    "Hello, world!",
+    "The quick brown fox jumps over 1337 lazy dogs.",
+    "  leading spaces and\nnewlines\t\ttabs",
+    "unicode: caffè, naïve, 東京, emoji 🚀🔥",
+    "don't stop'n believin'",
+    "x = (a + b) * c / d - e % f",
+    "",
+    "a",
+]
+
+
+# --------------------------------------------------------------- BPE
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    """Train a small byte-level BPE with the `tokenizers` wheel (oracle),
+    dump tokenizer.json, load it with our implementation."""
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers import Tokenizer, models, pre_tokenizers, decoders, trainers
+
+    tok = Tokenizer(models.BPE())
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=400,
+        special_tokens=["<|bos|>", "<|eos|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    )
+    corpus = [
+        "the quick brown fox jumps over the lazy dog",
+        "hello world, hello tokenizers, hello bpe",
+        "numbers 0123456789 and symbols !@#$%^&*()",
+        "don't won't can't shouldn't",
+        "unicode caffè naïve 東京 🚀",
+    ] * 4
+    tok.train_from_iterator(corpus, trainer)
+    path = tmp_path_factory.mktemp("bpe") / "tokenizer.json"
+    tok.save(str(path))
+    ours = BPETokenizer.from_file(str(path))
+    return tok, ours
+
+
+def test_bpe_matches_oracle_encode(trained):
+    oracle, ours = trained
+    for text in SAMPLES:
+        expect = oracle.encode(text).ids
+        got = ours.encode(text)
+        assert got == expect, f"mismatch on {text!r}: {got} != {expect}"
+
+
+def test_bpe_decode_roundtrip(trained):
+    _, ours = trained
+    for text in SAMPLES:
+        assert ours.decode(ours.encode(text)) == text
+
+
+def test_bpe_special_tokens(trained):
+    oracle, ours = trained
+    bos = ours.special_tokens["<|bos|>"]
+    ids = ours.encode("<|bos|>hello world<|eos|>")
+    assert ids[0] == bos
+    assert ids[-1] == ours.special_tokens["<|eos|>"]
+    # specials never leak into decoded text
+    assert "<|bos|>" not in ours.decode(ids)
+
+
+def test_bpe_gpt2_style_pattern_groups_numbers(trained):
+    _, ours = trained
+    # pre-tokenizer must split letters from digits the same way the
+    # oracle does; covered by encode equality, here just sanity that
+    # multibyte utf-8 survives
+    text = "東京123"
+    assert ours.decode(ours.encode(text)) == text
+
+
+def test_load_tokenizer_detects_json(trained, tmp_path):
+    _, ours = trained
+    # write a directory containing tokenizer.json
+    import shutil
+
+    src = None
+    # recover the file path from the fixture's tokenizer by re-saving
+    d = tmp_path / "asset"
+    d.mkdir()
+    with open(d / "tokenizer.json", "w") as f:
+        json.dump(
+            {
+                "model": {
+                    "type": "BPE",
+                    "vocab": ours.vocab,
+                    "merges": [f"{a} {b}" for (a, b) in sorted(ours.ranks, key=ours.ranks.get)],
+                },
+                "added_tokens": [
+                    {"id": i, "content": t, "special": True}
+                    for t, i in ours.special_tokens.items()
+                ],
+            },
+            f,
+        )
+    loaded = load_tokenizer(str(d))
+    assert loaded.encode("hello world") == ours.encode("hello world")
+
+
+# --------------------------------------------------------------- SPM
+def _sp_piece(piece: str, score: float, ptype: int) -> bytes:
+    body = b""
+    data = piece.encode("utf-8")
+    body += bytes([0x0A, len(data)]) + data  # field 1 (piece), len-delim
+    body += bytes([0x15]) + struct.pack("<f", score)  # field 2 (score), 32-bit
+    body += bytes([0x18, ptype])  # field 3 (type), varint
+    return bytes([0x0A, len(body)]) + body  # ModelProto field 1
+
+
+def _sp_trainer(model_type: int) -> bytes:
+    body = bytes([0x18, model_type])  # field 3 model_type
+    body += bytes([0xC0, 0x02, 0])  # field 40 unk_id = 0
+    body += bytes([0xC8, 0x02, 1])  # field 41 bos_id = 1
+    body += bytes([0xD0, 0x02, 2])  # field 42 eos_id = 2
+    return bytes([0x12, len(body)]) + body  # ModelProto field 2
+
+
+def build_spm_model(model_type: int = 1) -> bytes:
+    NORMAL, UNKNOWN, CONTROL, BYTE = 1, 2, 3, 6
+    pieces = [
+        ("<unk>", 0.0, UNKNOWN),
+        ("<s>", 0.0, CONTROL),
+        ("</s>", 0.0, CONTROL),
+        ("▁", -2.0, NORMAL),
+        ("▁hello", -1.0, NORMAL),
+        ("▁world", -1.2, NORMAL),
+        ("▁he", -3.0, NORMAL),
+        ("llo", -3.1, NORMAL),
+        ("h", -5.0, NORMAL),
+        ("e", -5.0, NORMAL),
+        ("l", -5.0, NORMAL),
+        ("o", -5.0, NORMAL),
+        ("w", -5.0, NORMAL),
+        ("r", -5.0, NORMAL),
+        ("d", -5.0, NORMAL),
+        ("▁h", -4.0, NORMAL),
+        ("ll", -4.5, NORMAL),  # BPE-mode merge chain: l+l → ll+o → llo
+    ] + [(f"<0x{b:02X}>", -20.0, BYTE) for b in range(256)]
+    blob = b"".join(_sp_piece(p, s, t) for p, s, t in pieces)
+    blob += _sp_trainer(model_type)
+    return blob
+
+
+def test_spm_parses_handcrafted_model():
+    tok = SentencePieceTokenizer.from_bytes(build_spm_model())
+    assert tok.unk_id == 0 and tok.bos_id == 1 and tok.eos_id == 2
+    assert tok.piece_to_id["▁hello"] == 4
+
+
+def test_spm_unigram_viterbi_picks_best_segmentation():
+    tok = SentencePieceTokenizer.from_bytes(build_spm_model(model_type=1))
+    ids = tok.encode("hello world")
+    # best path: ▁hello (-1.0) + ▁world (-1.2), NOT ▁he+llo (-6.1)
+    assert ids == [tok.piece_to_id["▁hello"], tok.piece_to_id["▁world"]]
+
+
+def test_spm_decode_roundtrip():
+    tok = SentencePieceTokenizer.from_bytes(build_spm_model())
+    for text in ("hello world", "hello", "world hello hello"):
+        assert tok.decode(tok.encode(text)) == text
+
+
+def test_spm_byte_fallback_for_oov():
+    tok = SentencePieceTokenizer.from_bytes(build_spm_model())
+    ids = tok.encode("hello 東")
+    # 東 is not in the vocab: encoded as its 3 utf-8 byte pieces
+    assert tok.decode(ids) == "hello 東"
+
+
+def test_spm_bpe_mode():
+    tok = SentencePieceTokenizer.from_bytes(build_spm_model(model_type=2))
+    ids = tok.encode("hello")
+    assert tok.decode(ids) == "hello"
+    # highest-score merges win: ▁hello should assemble fully
+    assert ids == [tok.piece_to_id["▁hello"]]
+
+
+def test_spm_control_pieces_never_emitted():
+    tok = SentencePieceTokenizer.from_bytes(build_spm_model())
+    assert tok.decode([1, 4, 2]) == "hello"
