@@ -9,7 +9,8 @@ the full contract (consumer groups, commits, backlog) and external drivers
 plug in behind the same interface.
 """
 
+from gofr_tpu.datasource.pubsub.kafka import KafkaClient
 from gofr_tpu.datasource.pubsub.message import Message
 from gofr_tpu.datasource.pubsub.memory import InMemoryBroker
 
-__all__ = ["Message", "InMemoryBroker"]
+__all__ = ["Message", "InMemoryBroker", "KafkaClient"]

@@ -1,0 +1,200 @@
+"""Kafka wire-protocol codec (the subset the driver + test broker speak).
+
+Reference parity: pkg/gofr/datasource/pubsub/kafka/kafka.go drives
+segmentio/kafka-go; this image has no Kafka client library, so — like the
+MQTT driver (mqtt.py) — the protocol is implemented directly from the
+public Kafka protocol spec. Everything here is the v0 wire format:
+
+- request framing: int32 size | int16 api_key | int16 api_version |
+  int32 correlation_id | nullable_string client_id | body
+- response framing: int32 size | int32 correlation_id | body
+- message set v0 (magic 0): int64 offset | int32 size | uint32 crc |
+  int8 magic | int8 attributes | bytes key | bytes value
+
+Shared by the production driver (kafka.py) and the in-process test broker
+(testutil/kafka_broker.py) — the CI-service-container pattern (SURVEY §4
+tier 4) without docker.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+# api keys
+PRODUCE = 0
+FETCH = 1
+LIST_OFFSETS = 2
+METADATA = 3
+OFFSET_COMMIT = 8
+OFFSET_FETCH = 9
+CREATE_TOPICS = 19
+DELETE_TOPICS = 20
+
+# error codes (subset)
+NONE = 0
+OFFSET_OUT_OF_RANGE = 1
+UNKNOWN_TOPIC_OR_PARTITION = 3
+TOPIC_ALREADY_EXISTS = 36
+
+EARLIEST_TIMESTAMP = -2
+LATEST_TIMESTAMP = -1
+
+
+class KafkaError(ConnectionError):
+    def __init__(self, code: int, context: str = "") -> None:
+        super().__init__(f"kafka error {code}{f' ({context})' if context else ''}")
+        self.code = code
+
+
+# ---------------------------------------------------------------- primitives
+def int8(v: int) -> bytes:
+    return struct.pack(">b", v)
+
+
+def int16(v: int) -> bytes:
+    return struct.pack(">h", v)
+
+
+def int32(v: int) -> bytes:
+    return struct.pack(">i", v)
+
+
+def int64(v: int) -> bytes:
+    return struct.pack(">q", v)
+
+
+def string(s: str | None) -> bytes:
+    if s is None:
+        return struct.pack(">h", -1)
+    data = s.encode()
+    return struct.pack(">h", len(data)) + data
+
+
+def bytes_(b: bytes | None) -> bytes:
+    if b is None:
+        return struct.pack(">i", -1)
+    return struct.pack(">i", len(b)) + b
+
+
+def array(items: list[bytes]) -> bytes:
+    return struct.pack(">i", len(items)) + b"".join(items)
+
+
+class Reader:
+    """Cursor over a response/request body."""
+
+    def __init__(self, data: bytes) -> None:
+        self.data = data
+        self.pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if self.pos + n > len(self.data):
+            raise KafkaError(-1, "short read")
+        out = self.data[self.pos : self.pos + n]
+        self.pos += n
+        return out
+
+    def int8(self) -> int:
+        return struct.unpack(">b", self._take(1))[0]
+
+    def int16(self) -> int:
+        return struct.unpack(">h", self._take(2))[0]
+
+    def int32(self) -> int:
+        return struct.unpack(">i", self._take(4))[0]
+
+    def int64(self) -> int:
+        return struct.unpack(">q", self._take(8))[0]
+
+    def uint32(self) -> int:
+        return struct.unpack(">I", self._take(4))[0]
+
+    def string(self) -> str | None:
+        n = self.int16()
+        if n < 0:
+            return None
+        return self._take(n).decode()
+
+    def bytes_(self) -> bytes | None:
+        n = self.int32()
+        if n < 0:
+            return None
+        return self._take(n)
+
+    def remaining(self) -> int:
+        return len(self.data) - self.pos
+
+
+# ---------------------------------------------------------------- messages
+def encode_message(key: bytes | None, value: bytes) -> bytes:
+    """One magic-0 message: crc | magic | attributes | key | value."""
+    body = int8(0) + int8(0) + bytes_(key) + bytes_(value)
+    return struct.pack(">I", zlib.crc32(body) & 0xFFFFFFFF) + body
+
+
+def encode_message_set(
+    entries: list[tuple[int, bytes | None, bytes]]
+) -> bytes:
+    """[(offset, key, value)] -> wire message set (no count prefix)."""
+    out = bytearray()
+    for offset, key, value in entries:
+        msg = encode_message(key, value)
+        out += int64(offset) + int32(len(msg)) + msg
+    return bytes(out)
+
+
+def decode_message_set(data: bytes) -> list[tuple[int, bytes | None, bytes]]:
+    """Wire message set -> [(offset, key, value)]; tolerates a trailing
+    partial message (the broker may truncate at max_bytes)."""
+    out: list[tuple[int, bytes | None, bytes]] = []
+    r = Reader(data)
+    while r.remaining() >= 12:
+        offset = r.int64()
+        size = r.int32()
+        if r.remaining() < size:
+            break  # partial trailing message
+        msg = Reader(r._take(size))
+        crc = msg.uint32()
+        payload = msg.data[msg.pos :]
+        if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            raise KafkaError(-1, f"crc mismatch at offset {offset}")
+        msg.int8()  # magic
+        msg.int8()  # attributes
+        key = msg.bytes_()
+        value = msg.bytes_()
+        out.append((offset, key, value or b""))
+    return out
+
+
+# ---------------------------------------------------------------- framing
+def encode_request(
+    api_key: int, api_version: int, correlation_id: int, client_id: str, body: bytes
+) -> bytes:
+    payload = (
+        int16(api_key)
+        + int16(api_version)
+        + int32(correlation_id)
+        + string(client_id)
+        + body
+    )
+    return int32(len(payload)) + payload
+
+
+def read_frame(recv_exact) -> bytes:
+    """Read one length-prefixed frame via a ``recv_exact(n) -> bytes``."""
+    (size,) = struct.unpack(">i", recv_exact(4))
+    if size < 0 or size > 64 * 1024 * 1024:
+        raise KafkaError(-1, f"bad frame size {size}")
+    return recv_exact(size)
+
+
+def recv_exact(sock, n: int) -> bytes:
+    """Read exactly ``n`` bytes from a socket (both Kafka peers use this)."""
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise KafkaError(-1, "connection closed by peer")
+        buf += chunk
+    return buf
