@@ -8,8 +8,20 @@ the same contract; GCS is the weight-loading path in the TPU build
 (SURVEY §5.4: checkpoint load = model weights through this abstraction).
 """
 
+from gofr_tpu.datasource.file.gcs import GCSProvider
 from gofr_tpu.datasource.file.local import LocalFileSystem
+from gofr_tpu.datasource.file.object_store import ObjectFileSystem, ObjectInfo
 from gofr_tpu.datasource.file.observability import ObservedFileSystem
 from gofr_tpu.datasource.file.row_reader import JSONRowReader, TextRowReader
+from gofr_tpu.datasource.file.s3 import S3Provider
 
-__all__ = ["LocalFileSystem", "ObservedFileSystem", "JSONRowReader", "TextRowReader"]
+__all__ = [
+    "LocalFileSystem",
+    "ObservedFileSystem",
+    "JSONRowReader",
+    "TextRowReader",
+    "ObjectFileSystem",
+    "ObjectInfo",
+    "GCSProvider",
+    "S3Provider",
+]
