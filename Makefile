@@ -21,3 +21,9 @@ bench-smoke:
 
 native:
 	$(MAKE) -C native
+
+# regenerate the committed descriptor sets for the built-in services
+protos:
+	cd gofr_tpu/grpcx/protos && \
+	protoc -I. --descriptor_set_out=reflection.binpb reflection.proto && \
+	protoc -I. --descriptor_set_out=health.binpb health.proto

@@ -1,82 +1,154 @@
 """Benchmark entry point (driver contract): prints ONE JSON line
-``{"metric", "value", "unit", "vs_baseline"}``.
+``{"metric", "value", "unit", "vs_baseline"}`` — ALWAYS, even when the
+TPU backend is unreachable (then with an ``"error"`` field; never a bare
+traceback). Round-2 post-mortem: one unguarded ``jax.devices()`` erased
+the round's perf record when the axon tunnel flaked.
 
-Benchmark: single-chip Llama-family batched decode throughput — the core
-of the north-star metric. BASELINE.json's target is >1,000 req/s
-aggregate on v5e-8 for Llama-3-8B /generate; with ~128 output tokens per
-request that is ~128k generated tok/s over 8 chips ⇒ **16k tok/s per
-chip**. ``vs_baseline`` is measured tokens/s divided by that per-chip
-target (the reference itself publishes no numbers — BASELINE.md).
+Headline benchmark: **memory-honest 8B-class decode** — Llama-3-8B shape
+(32L/32H/8KV/4096d/14336ff/128256V) with weight-only int8 matmul weights
+(per-channel scales, dequant fused into the dot; models/llama.py
+``quantize_weight``), bf16 activations/KV. That is the largest Llama
+config that fits one 16 GB v5e chip (~8.6 GB weights + ~3.4 GB KV at
+B=128), so ``vs_baseline`` against the 8B-derived target is apples to
+apples: BASELINE.json's north star is >1,000 req/s aggregate on v5e-8
+for Llama-3-8B /generate; at ~128 output tokens per request that is
+~128k tok/s over 8 chips ⇒ **16k tok/s per chip**. Beside tok/s the
+bench reports ``est_hbm_gbps`` and ``hbm_util`` (fraction of the v5e's
+819 GB/s peak) — decode at this scale is HBM-bound, so utilization is
+the honest "how close to the hardware ceiling" number.
 
-Model under test: a 1.1B-param Llama-shape (d=2048, L=16, GQA 16/8,
-ff=8192) in bf16. Decode batch 256 — the measured throughput knee on
-v5e (bigger batches degrade: the [B≤256] step is HBM-bound at
-~360 GB/s effective; past 256 XLA's fusion tiling falls off a cliff).
-Each decode step is the fused one-dispatch ``llama.decode_step_greedy``
-(forward + argmax + length increment): launches pipeline asynchronously,
-so per-launch host↔device latency (milliseconds on proxied PJRT
-backends) overlaps compute; the timed loop syncs ONCE at the end via
-``jax.device_get`` — the only sync that provably drains the pipeline on
-proxied backends (block_until_ready can return early there).
+Backend acquisition: the axon sitecustomize forces jax_platforms=axon
+(beating the JAX_PLATFORMS env var), and a downed tunnel makes backend
+init HANG rather than fail fast. So init is probed in a SUBPROCESS with
+a per-attempt timeout, retried with backoff up to BENCH_INIT_DEADLINE_S
+(default 600 s); only a successful probe lets the parent process touch
+jax. On exhaustion the bench falls back to CPU tiny shapes and carries
+the error in the contract line. Every successful on-TPU run is appended
+to the committed ``BENCH_LOCAL.jsonl`` so a snapshot-time outage can
+never erase the round's evidence again.
 
-The KV cache rides the scan *carry* with per-layer in-place updates
-(llama._layer_cached): scanning it as xs/ys cost two full-cache copies
-plus a slice/restack per step — that one structural fix took the same
-hardware from 4.4k to 21.7k tok/s.
+Decode loop: one fused dispatch per token (llama.decode_step_greedy:
+forward + argmax + length increment), launches pipelined, ONE
+``jax.device_get`` sync at the end — the only sync that provably drains
+the pipeline on proxied PJRT backends. The KV cache rides the scan
+carry with per-layer in-place updates (llama._layer_cached).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
+import traceback
 from typing import Any
 
+V5E_PEAK_HBM_GBPS = 819.0  # v5e HBM bandwidth; decode's honest ceiling
+PER_CHIP_TARGET_TOKS = 16000.0  # 1k req/s north star / 8 chips, 128 tok/req
 
-def main() -> None:
+_REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def _probe_backend_subprocess(timeout_s: float) -> tuple[str | None, str | None]:
+    """Try backend init in a child process (safe to kill on hang).
+    Returns (platform, None) on success, (None, error) on failure."""
+    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s, cwd=_REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"backend init exceeded {timeout_s:.0f}s (tunnel hang)"
+    if r.returncode != 0:
+        tail = (r.stderr or r.stdout).strip().splitlines()
+        return None, "; ".join(tail[-2:]) if tail else f"rc={r.returncode}"
+    for line in r.stdout.splitlines():
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1].strip(), None
+    return None, "probe printed no platform"
+
+
+def _init_in_process_guarded(timeout_s: float) -> str:
+    """Run the parent's own backend init under a watchdog: a hang here
+    (tunnel drops between the probe subprocess and this call) cannot be
+    interrupted, so the watchdog emits the contract error line and
+    hard-exits — the ALWAYS-one-JSON-line guarantee survives even this
+    window."""
+    import threading
+
     import jax
 
-    # The axon sitecustomize forces jax_platforms=axon via jax.config, which
-    # beats the JAX_PLATFORMS env var — honor an explicit CPU request (the
-    # `make check` smoke) here so the gate never blocks on TPU-tunnel health.
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-        jax.config.update("jax_platforms", "cpu")
+    result: list[str] = []
+    done = threading.Event()
 
+    def init() -> None:
+        result.append(jax.devices()[0].platform)
+        done.set()
+
+    t = threading.Thread(target=init, daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        _emit_error_line(
+            f"in-process backend init hung >{timeout_s:.0f}s after a successful probe",
+            time.time(),
+        )
+        sys.stdout.flush()
+        os._exit(1)
+    return result[0]
+
+
+def _acquire_backend() -> tuple[str, str | None]:
+    """Bounded-retry backend acquisition. Returns (platform, init_error).
+    platform is the jax platform actually initialized in THIS process;
+    init_error is non-None when the TPU path was wanted but unreachable
+    (the bench then runs the CPU fallback so the contract line still
+    carries a real measurement)."""
+    import jax  # deferred: importing jax does not init backends
+
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # explicit CPU request (make check smoke) — never probe the tunnel
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()[0].platform, None
+
+    deadline_s = float(os.environ.get("BENCH_INIT_DEADLINE_S", "600"))
+    start = time.monotonic()
+    attempt, backoff, last_err = 0, 5.0, "no attempts"
+    while time.monotonic() - start < deadline_s:
+        remaining = deadline_s - (time.monotonic() - start)
+        per_try = min(60.0 + 30.0 * attempt, 240.0, max(remaining, 30.0))
+        platform, err = _probe_backend_subprocess(per_try)
+        if platform is not None:
+            # probe succeeded → in-process init should be fast now, but the
+            # tunnel can still flake in this window: keep the watchdog on
+            return _init_in_process_guarded(max(per_try, 120.0)), None
+        last_err = err or "unknown"
+        print(f"bench: backend probe {attempt + 1} failed: {last_err}", file=sys.stderr)
+        attempt += 1
+        if time.monotonic() - start + backoff >= deadline_s:
+            break
+        time.sleep(backoff)
+        backoff = min(backoff * 2, 60.0)
+    jax.config.update("jax_platforms", "cpu")
+    return jax.devices()[0].platform, f"TPU backend unavailable after {attempt} probes: {last_err}"
+
+
+def _bench_decode(cfg: Any, params: Any, batch: int, prompt_len: int,
+                  decode_steps: int) -> dict:
+    """Timed batched decode: prefill once, then one fused dispatch per
+    token, a single device_get sync at the end."""
+    import jax
     import jax.numpy as jnp
 
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from gofr_tpu.models import llama
 
-    platform = jax.devices()[0].platform
-
-    cfg = llama.LlamaConfig(
-        vocab_size=32128,
-        d_model=2048,
-        n_layers=16,
-        n_heads=16,
-        n_kv_heads=8,
-        d_ff=8192,
-        max_seq_len=2048,
-        dtype=jnp.bfloat16,
-    )
-    if platform not in ("tpu",):
-        # CPU fallback so the bench never crashes off-TPU; tiny shapes
-        cfg = llama.LlamaConfig.tiny(dtype=jnp.bfloat16)
-
-    batch = 256 if platform == "tpu" else 4
-    prompt_len = 128 if platform == "tpu" else 8
-    decode_steps = 64 if platform == "tpu" else 4
+    key = jax.random.PRNGKey(1)
     cache_len_max = prompt_len + decode_steps + 8
-
-    key = jax.random.PRNGKey(0)
-    params = jax.device_put(llama.init_params(cfg, key))
-
     tokens = jax.random.randint(key, (batch, prompt_len), 0, cfg.vocab_size)
     seq_lens = jnp.full((batch,), prompt_len, jnp.int32)
     cache = llama.KVCache.create(cfg, batch, max_len=cache_len_max)
 
-    # compile + warmup (prefill, then one fused decode step)
     t0 = time.perf_counter()
     last, cache = llama.prefill(cfg, params, tokens, cache, seq_lens)
     next_tokens = jnp.argmax(last, axis=-1)
@@ -88,8 +160,6 @@ def main() -> None:
     )
     jax.device_get(next_tokens[0])
 
-    # timed decode loop: one dispatch per token, launches pipelined, one
-    # full sync at the end
     start = time.perf_counter()
     for _ in range(decode_steps):
         next_tokens, cache, cache_len = llama.decode_step_greedy(
@@ -99,52 +169,156 @@ def main() -> None:
     elapsed = time.perf_counter() - start
 
     tokens_per_sec = batch * decode_steps / elapsed
-    step_ms = elapsed / decode_steps * 1e3
+    step_s = elapsed / decode_steps
 
-    # effective HBM bandwidth: per step the chip streams the non-embedding
-    # weights (the embedding table is only gathered B rows at a time) plus
-    # the mean valid KV prefix per row
-    n_params = llama.param_count(params)
-    n_embed = cfg.vocab_size * cfg.d_model
-    bytes_weights = (n_params - n_embed) * 2 + batch * cfg.d_model * 2
+    # bytes the chip must stream per decode step: every matmul weight at
+    # its RESIDENT width (int8 for quantized leaves — the point of W8),
+    # embedding gathered B rows only, plus the mean valid KV prefix
+    n_embed_bytes = 0
+    weight_bytes = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        keys = [getattr(p, "key", None) for p in path]
+        if keys and keys[0] == "embedding":
+            n_embed_bytes = batch * cfg.d_model * leaf.dtype.itemsize
+            continue
+        weight_bytes += int(leaf.size) * leaf.dtype.itemsize
     mean_len = prompt_len + decode_steps / 2
-    bytes_kv = 2 * cfg.n_layers * batch * mean_len * cfg.n_kv_heads * cfg.head_dim * 2
-    eff_gbps = (bytes_weights + bytes_kv) / (elapsed / decode_steps) / 1e9
+    kv_bytes = 2 * cfg.n_layers * batch * mean_len * cfg.n_kv_heads * cfg.head_dim * 2
+    eff_gbps = (weight_bytes + n_embed_bytes + kv_bytes) / step_s / 1e9
 
-    # fail-safe: the engine phase must never cost the headline number
+    del cache
+    return {
+        "tokens_per_sec": round(tokens_per_sec, 2),
+        "decode_step_ms": round(step_s * 1e3, 3),
+        "prefill_warm_s": round(prefill_warm_s, 2),
+        "est_hbm_gbps": round(eff_gbps, 1),
+        "hbm_util": round(eff_gbps / V5E_PEAK_HBM_GBPS, 4),
+        "batch": batch,
+        "decode_steps": decode_steps,
+    }
+
+
+def main() -> None:
+    wall_start = time.time()
     try:
-        engine_stats = _engine_load(cfg, params, platform)
+        platform, init_error = _acquire_backend()
+    except Exception as exc:  # even acquisition must not kill the contract
+        _emit_error_line(f"{type(exc).__name__}: {exc}", wall_start)
+        return
+
+    try:
+        _run_benchmarks(platform, init_error, wall_start)
+    except Exception as exc:
+        tb = traceback.format_exc(limit=3).strip().replace("\n", " | ")
+        _emit_error_line(f"{type(exc).__name__}: {exc} [{tb}]", wall_start,
+                         init_error=init_error)
+
+
+def _emit_error_line(error: str, wall_start: float, init_error: str | None = None) -> None:
+    # metric name matches the success line's prefix for the same model kind
+    # so error records aggregate with the benchmark they belong to
+    model_kind = os.environ.get("BENCH_MODEL", "8b-int8")
+    line = {
+        "metric": f"llama_decode_tokens_per_sec_{model_kind}",
+        "value": None,
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "error": error,
+        "details": {"wall_s": round(time.time() - wall_start, 1)},
+    }
+    if init_error:
+        line["details"]["init_error"] = init_error
+    print(json.dumps(line))
+
+
+def _run_benchmarks(platform: str, init_error: str | None, wall_start: float) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, _REPO)
+    from gofr_tpu.models import llama
+
+    on_tpu = platform in ("tpu", "axon")
+    model_kind = os.environ.get("BENCH_MODEL", "8b-int8" if on_tpu else "tiny")
+
+    if model_kind == "8b-int8":
+        cfg = llama.LlamaConfig(max_seq_len=2048, dtype=jnp.bfloat16)
+        quantize = True
+        batch, prompt_len, decode_steps = 128, 128, 64
+    elif model_kind == "1b-bf16":
+        cfg = llama.LlamaConfig(
+            vocab_size=32128, d_model=2048, n_layers=16, n_heads=16,
+            n_kv_heads=8, d_ff=8192, max_seq_len=2048, dtype=jnp.bfloat16,
+        )
+        quantize = False
+        batch, prompt_len, decode_steps = 256, 128, 64
+    else:  # tiny CPU fallback — never crash off-TPU
+        cfg = llama.LlamaConfig.tiny(dtype=jnp.bfloat16)
+        quantize = True  # exercise the same W8 code path as the headline
+        batch, prompt_len, decode_steps = 4, 8, 4
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), quantize=quantize)
+    params = jax.device_put(params)
+    n_params = llama.param_count(params)
+    weight_gb = llama.param_bytes(params) / 1e9
+
+    decode = _bench_decode(cfg, params, batch, prompt_len, decode_steps)
+
+    # engine-under-load phase: the continuous-batching ServingEngine
+    # end-to-end (tokenize → schedule → prefill → batched decode →
+    # detokenize), TTFT from the engine's own measurements. Fail-safe:
+    # must never cost the headline number.
+    try:
+        engine_stats = _engine_load(cfg, params, on_tpu)
     except Exception as exc:  # pragma: no cover - defensive
         engine_stats = {"error": f"{type(exc).__name__}: {exc}"}
 
-    per_chip_target = 16000.0  # from the 1k req/s north star, see docstring
-    print(
-        json.dumps(
-            {
-                "metric": f"llama1b_decode_tokens_per_sec_bs{batch}_{platform}",
-                "value": round(tokens_per_sec, 2),
-                "unit": "tokens/s",
-                "vs_baseline": round(tokens_per_sec / per_chip_target, 4),
-                "details": {
-                    "decode_step_ms": round(step_ms, 3),
-                    "prefill_warm_s": round(prefill_warm_s, 2),
-                    "est_hbm_gbps": round(eff_gbps, 1),
-                    "params": n_params,
-                    "engine": engine_stats,
-                },
-            }
-        )
+    # vs_baseline only scores the config the 16k tok/s target was derived
+    # from (8B-class); a tiny/1B ratio against an 8B target flatters
+    # (VERDICT r2 weak #2)
+    vs = (
+        round(decode["tokens_per_sec"] / PER_CHIP_TARGET_TOKS, 4)
+        if model_kind == "8b-int8" else None
     )
+    line = {
+        "metric": f"llama_decode_tokens_per_sec_{model_kind}_bs{batch}_{platform}",
+        "value": decode["tokens_per_sec"],
+        "unit": "tokens/s",
+        "vs_baseline": vs,
+        "details": {
+            "model": model_kind,
+            "params": n_params,
+            "weight_gb": round(weight_gb, 2),
+            **decode,
+            "engine": engine_stats,
+            "wall_s": round(time.time() - wall_start, 1),
+        },
+    }
+    if init_error:
+        line["error"] = init_error
+        line["vs_baseline"] = None  # a CPU number must not score vs the TPU target
+    print(json.dumps(line))
+
+    if on_tpu and not init_error:
+        _append_local_record(line)
 
 
-def _engine_load(cfg: Any, params: Any, platform: str) -> dict:
-    """Engine-under-load phase (VERDICT r1 item 4): the continuous-batching
-    ServingEngine end-to-end — tokenize, schedule, prefill, batched decode,
-    detokenize — with p50/p95 TTFT and request rate read from the engine's
-    own histograms rather than wall-clock guesses."""
+def _append_local_record(line: dict) -> None:
+    """Persist every successful on-TPU measurement to the committed
+    BENCH_LOCAL.jsonl — the round's evidence must survive a snapshot-time
+    tunnel outage (VERDICT r2 weak #1)."""
+    rec = dict(line)
+    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    try:
+        with open(os.path.join(_REPO, "BENCH_LOCAL.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError as exc:  # read-only checkout must not kill the contract
+        print(f"bench: could not append BENCH_LOCAL.jsonl: {exc}", file=sys.stderr)
+
+
+def _engine_load(cfg: Any, params: Any, on_tpu: bool) -> dict:
     from gofr_tpu.serving import ByteTokenizer, EngineConfig, ServingEngine
 
-    on_tpu = platform == "tpu"
     n_requests = 32 if on_tpu else 6
     max_new = 16 if on_tpu else 4
     engine = ServingEngine(

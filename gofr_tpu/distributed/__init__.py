@@ -1,0 +1,10 @@
+"""DCN cross-host coordination (SURVEY §5.8): leader/worker membership,
+heartbeat + health fan-in, dp-shard assignment — over the framework's
+own typed gRPC (coordination.proto → coordination_gofr.py via
+grpcx/codegen.py). ICI collectives stay inside the XLA executable
+(parallel/); this plane coordinates BETWEEN hosts."""
+
+from gofr_tpu.distributed.coordinator import ClusterState, CoordinationService, MemberInfo
+from gofr_tpu.distributed.worker import WorkerAgent
+
+__all__ = ["ClusterState", "CoordinationService", "MemberInfo", "WorkerAgent"]

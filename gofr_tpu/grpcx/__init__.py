@@ -11,5 +11,13 @@ slots in").
 
 from gofr_tpu.grpcx.server import GRPCServer
 from gofr_tpu.grpcx.inference import InferenceService, InferenceClient
+from gofr_tpu.grpcx.runtime import GofrGrpcService, GofrStream, ProtoRequest
 
-__all__ = ["GRPCServer", "InferenceService", "InferenceClient"]
+__all__ = [
+    "GRPCServer",
+    "InferenceService",
+    "InferenceClient",
+    "GofrGrpcService",
+    "GofrStream",
+    "ProtoRequest",
+]

@@ -1,0 +1,159 @@
+"""gRPC server reflection (v1alpha) — reference parity grpc.go:131-134.
+
+The image has no ``grpc_reflection`` package, so the service is built
+from the committed descriptor set (protos/reflection.binpb, compiled
+from protos/reflection.proto by ``make protos``) through the same
+descriptor-pool machinery the typed codegen uses. Registered services
+contribute their ``FileDescriptorSet`` via
+``gofr_file_descriptor_set()``; grpcurl-style clients can then
+``list``/``describe`` every typed service plus grpc.health.v1.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import grpc
+
+from google.protobuf import descriptor_pb2
+
+from gofr_tpu.grpcx.runtime import load_messages
+
+_PROTO_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "protos")
+
+SERVICE_NAME = "grpc.reflection.v1alpha.ServerReflection"
+
+
+def _read_binpb(name: str) -> bytes:
+    with open(os.path.join(_PROTO_DIR, name), "rb") as f:
+        return f.read()
+
+
+class ReflectionRegistry:
+    """Symbol/file index over every registered service's descriptors."""
+
+    def __init__(self) -> None:
+        self._files: dict[str, descriptor_pb2.FileDescriptorProto] = {}
+        self._symbol_to_file: dict[str, str] = {}
+        self.services: list[str] = []
+        # the server's built-ins are always describable
+        self.add_service("grpc.health.v1.Health", _read_binpb("health.binpb"))
+        self.add_service(SERVICE_NAME, _read_binpb("reflection.binpb"))
+
+    def add_service(self, service_name: str, fds_bytes: bytes | None) -> None:
+        if service_name and service_name not in self.services:
+            self.services.append(service_name)
+        if not fds_bytes:
+            return
+        fds = descriptor_pb2.FileDescriptorSet.FromString(fds_bytes)
+        for fd in fds.file:
+            if fd.name in self._files:
+                continue
+            self._files[fd.name] = fd
+            self._index(fd)
+
+    def _index(self, fd: descriptor_pb2.FileDescriptorProto) -> None:
+        pkg = fd.package
+
+        def full(name: str) -> str:
+            return f"{pkg}.{name}" if pkg else name
+
+        def walk_msgs(prefix: str, msgs: Any) -> None:
+            for m in msgs:
+                fq = f"{prefix}.{m.name}" if prefix else m.name
+                self._symbol_to_file[fq] = fd.name
+                walk_msgs(fq, m.nested_type)
+
+        walk_msgs(pkg, fd.message_type)
+        for e in fd.enum_type:
+            self._symbol_to_file[full(e.name)] = fd.name
+        for s in fd.service:
+            self._symbol_to_file[full(s.name)] = fd.name
+            for m in s.method:
+                self._symbol_to_file[f"{full(s.name)}.{m.name}"] = fd.name
+
+    def file_by_filename(self, name: str) -> list[bytes] | None:
+        if name not in self._files:
+            return None
+        return self._closure(name)
+
+    def file_containing_symbol(self, symbol: str) -> list[bytes] | None:
+        fname = self._symbol_to_file.get(symbol)
+        if fname is None:
+            return None
+        return self._closure(fname)
+
+    def _closure(self, root: str) -> list[bytes]:
+        """The root file plus its transitive deps we know about — grpcurl
+        needs the full closure to build a pool client-side."""
+        out: list[bytes] = []
+        seen: set[str] = set()
+        stack = [root]
+        while stack:
+            name = stack.pop()
+            if name in seen or name not in self._files:
+                continue
+            seen.add(name)
+            fd = self._files[name]
+            out.append(fd.SerializeToString())
+            stack.extend(fd.dependency)
+        return out
+
+
+class ReflectionService:
+    """The ServerReflectionInfo bidi stream, as a gofr generic service."""
+
+    def __init__(self, registry: ReflectionRegistry) -> None:
+        self.container: Any = None  # injected at registration; unused
+        self.registry = registry
+        msgs = load_messages(_read_binpb("reflection.binpb"))
+        self._req_cls = msgs["grpc.reflection.v1alpha.ServerReflectionRequest"]
+        self._resp_cls = msgs["grpc.reflection.v1alpha.ServerReflectionResponse"]
+
+    def gofr_service_name(self) -> str:
+        return SERVICE_NAME
+
+    def gofr_file_descriptor_set(self) -> bytes:
+        return _read_binpb("reflection.binpb")
+
+    def gofr_method_handlers(self) -> dict[str, Any]:
+        return {
+            "ServerReflectionInfo": grpc.stream_stream_rpc_method_handler(
+                self._info,
+                request_deserializer=self._req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            )
+        }
+
+    async def _info(self, request_iterator: Any, context: Any):
+        async for req in request_iterator:
+            yield self._respond(req)
+
+    def _respond(self, req: Any) -> Any:
+        resp = self._resp_cls()
+        resp.valid_host = req.host
+        resp.original_request.CopyFrom(req)
+        which = req.WhichOneof("message_request")
+        if which == "list_services":
+            for name in self.registry.services:
+                resp.list_services_response.service.add().name = name
+        elif which == "file_by_filename":
+            self._file_response(resp, self.registry.file_by_filename(req.file_by_filename),
+                                req.file_by_filename)
+        elif which == "file_containing_symbol":
+            self._file_response(
+                resp, self.registry.file_containing_symbol(req.file_containing_symbol),
+                req.file_containing_symbol)
+        else:
+            resp.error_response.error_code = grpc.StatusCode.UNIMPLEMENTED.value[0]
+            resp.error_response.error_message = f"unsupported reflection request: {which}"
+        return resp
+
+    def _file_response(self, resp: Any, files: list[bytes] | None, what: str) -> None:
+        if files is None:
+            resp.error_response.error_code = grpc.StatusCode.NOT_FOUND.value[0]
+            resp.error_response.error_message = f"not found: {what}"
+            return
+        for f in files:
+            resp.file_descriptor_response.file_descriptor_proto.append(f)

@@ -2,8 +2,8 @@
 
 Reference parity: pkg/gofr/grpc.go — server construction with chained
 interceptors (recovery first, then observability, grpc.go:96-104), optional
-reflection via GRPC_ENABLE_REFLECTION (grpc.go:131-134; logged-and-skipped
-here, the image has no reflection package), graceful stop (grpc.go:185-197),
+reflection via GRPC_ENABLE_REFLECTION (grpc.go:131-134; served from the
+committed descriptor sets, grpcx/reflection.py), graceful stop (grpc.go:185-197),
 server status/error metrics (grpc.go:114-119), and reflection-based
 container injection into registered servicers (grpc.go:222-269 → here: the
 ``container`` attribute is set on the servicer when present).
@@ -137,6 +137,18 @@ class _ObservabilityInterceptor(grpc.aio.ServerInterceptor):
                 request_deserializer=handler.request_deserializer,
                 response_serializer=handler.response_serializer,
             )
+        if handler.stream_unary is not None:
+            return grpc.stream_unary_rpc_method_handler(
+                wrap_unary(handler.stream_unary),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer,
+            )
+        if handler.stream_stream is not None:
+            return grpc.stream_stream_rpc_method_handler(
+                wrap_stream(handler.stream_stream),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer,
+            )
         return handler
 
 
@@ -162,6 +174,10 @@ class GRPCServer:
             m.new_histogram("app_grpc_server_stats", "gRPC unary handler latency")
             m.new_histogram("app_grpc_stream_stats", "gRPC stream handler latency")
             m.new_counter("grpc_server_errors_total", "gRPC handler errors")
+            m.new_counter(
+                "app_grpc_message_total",
+                "per-message Send/Recv count on generated streaming services",
+            )
             m.new_gauge("grpc_server_status", "1 while the gRPC server is serving")
 
     def register(self, servicer: Any, adder: Callable | None = None) -> None:
@@ -200,10 +216,26 @@ class GRPCServer:
         if self.config is not None and self.config.get_or_default(
             "GRPC_ENABLE_REFLECTION", "false"
         ).lower() == "true":
-            self.container.logger.warn(
-                "GRPC_ENABLE_REFLECTION requested but grpc_reflection is not "
-                "available in this image; skipping"
-            )
+            # grpc.go:131-134 — reflection gated by env; built from the
+            # committed descriptor sets (grpcx/reflection.py), no
+            # grpc_reflection package needed
+            from gofr_tpu.grpcx.reflection import ReflectionRegistry, ReflectionService
+
+            registry = ReflectionRegistry()
+            for servicer, _adder in self._pending:
+                name_fn = getattr(servicer, "gofr_service_name", None)
+                fds_fn = getattr(servicer, "gofr_file_descriptor_set", None)
+                if callable(name_fn):
+                    registry.add_service(
+                        name_fn(), fds_fn() if callable(fds_fn) else None
+                    )
+            reflection = ReflectionService(registry)
+            self._server.add_generic_rpc_handlers((
+                grpc.method_handlers_generic_handler(
+                    reflection.gofr_service_name(), reflection.gofr_method_handlers()
+                ),
+            ))
+            self.container.logger.info("gRPC server reflection enabled")
         self._server.add_insecure_port(f"[::]:{self.port}")
         await self._server.start()
         self.container.metrics_manager.set_gauge("grpc_server_status", 1)

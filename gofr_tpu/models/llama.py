@@ -73,38 +73,118 @@ class LlamaConfig:
         return cls(**defaults)
 
 
-def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
-    """Random-init params pytree with stacked layers [L, ...]."""
+def init_params(cfg: LlamaConfig, key: jax.Array, quantize: bool = False) -> dict:
+    """Random-init params pytree with stacked layers [L, ...].
+
+    ``quantize=True`` emits each matmul weight already in the weight-only
+    int8 form (``{"q": int8, "s": f32}``, see :func:`quantize_weight`) so
+    peak HBM during init is the int8 total plus ONE dtype-sized leaf
+    transient — an 8B-class model inits on a single 16 GB v5e chip where
+    a full-bf16 init (16 GB resident before quantizing) cannot.
+    """
     k_embed, k_layers, k_head = jax.random.split(key, 3)
     L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
     H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     def winit(key: jax.Array, shape: tuple, fan_in: int) -> jnp.ndarray:
-        return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(cfg.dtype)
+        # generate directly in target dtype: a f32 intermediate for a
+        # [L, D, F] leaf is a 7.5 GB transient at 8B scale
+        return jax.random.normal(key, shape, cfg.dtype) / math.sqrt(fan_in)
+
+    def mm_weight(key: jax.Array, shape: tuple, fan_in: int):
+        w = winit(key, shape, fan_in)
+        return quantize_weight(w, axis=-2, donate=True) if quantize else w
 
     ks = jax.random.split(k_layers, 7)
     params: dict = {
         "embedding": winit(k_embed, (cfg.vocab_size, D), D),
         "layers": {
-            "wq": winit(ks[0], (L, D, H * Dh), D),
-            "wk": winit(ks[1], (L, D, Hkv * Dh), D),
-            "wv": winit(ks[2], (L, D, Hkv * Dh), D),
-            "wo": winit(ks[3], (L, H * Dh, D), H * Dh),
-            "w_gate": winit(ks[4], (L, D, F), D),
-            "w_up": winit(ks[5], (L, D, F), D),
-            "w_down": winit(ks[6], (L, F, D), F),
+            "wq": mm_weight(ks[0], (L, D, H * Dh), D),
+            "wk": mm_weight(ks[1], (L, D, Hkv * Dh), D),
+            "wv": mm_weight(ks[2], (L, D, Hkv * Dh), D),
+            "wo": mm_weight(ks[3], (L, H * Dh, D), H * Dh),
+            "w_gate": mm_weight(ks[4], (L, D, F), D),
+            "w_up": mm_weight(ks[5], (L, D, F), D),
+            "w_down": mm_weight(ks[6], (L, F, D), F),
             "attn_norm": jnp.ones((L, D), jnp.float32),
             "mlp_norm": jnp.ones((L, D), jnp.float32),
         },
         "final_norm": jnp.ones((D,), jnp.float32),
     }
     if not cfg.tie_embeddings:
-        params["lm_head"] = winit(k_head, (D, cfg.vocab_size), D)
+        params["lm_head"] = mm_weight(k_head, (D, cfg.vocab_size), D)
     return params
 
 
 def param_count(params: dict) -> int:
-    return sum(int(p.size) for p in jax.tree.leaves(params))
+    # scales are metadata, not model parameters
+    return sum(
+        int(p.size)
+        for path, p in jax.tree_util.tree_leaves_with_path(params)
+        if not (path and getattr(path[-1], "key", None) == "s")
+    )
+
+
+def param_bytes(params: dict) -> int:
+    """Resident bytes of the weight pytree (int8 q + f32 s counted as-is)."""
+    return sum(int(p.size) * p.dtype.itemsize for p in jax.tree.leaves(params))
+
+
+# ------------------------------------------------------- weight-only int8
+def _quantize_body(w: jnp.ndarray, axis: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    # jitted (below) so XLA fuses abs/div/round/clip/convert into one pass
+    # that streams w once and writes int8 — the eager version materializes
+    # TWO full-leaf f32 transients (15 GB for a [32,4096,14336] leaf),
+    # OOMing the 8B init on a 16 GB chip
+    amax = jnp.max(jnp.abs(w).astype(jnp.float32), axis=axis, keepdims=True)
+    s = jnp.maximum(amax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / s), -127, 127).astype(jnp.int8)
+    return q, jnp.squeeze(s, axis)
+
+
+_quantize_jit = jax.jit(_quantize_body, static_argnums=1)
+# init-path variant: the freshly-generated source leaf is a temp, so it is
+# donated and XLA reuses its buffer
+_quantize_jit_donate = jax.jit(_quantize_body, static_argnums=1, donate_argnums=0)
+
+
+def quantize_weight(w: jnp.ndarray, axis: int = -2, *, donate: bool = False) -> dict:
+    """Symmetric per-output-channel weight-only int8: ``axis`` is the
+    contraction (input) axis; returns ``{"q": int8 same-shape, "s": f32
+    per-output-channel}``. The matmul dequantizes on the fly (``_mm``) —
+    XLA fuses the int8→bf16 convert into the dot read, so HBM streams
+    int8 bytes. Accuracy is the standard W8 recipe (per-channel absmax);
+    the scale multiply rides the matmul epilogue. ``donate=True``
+    invalidates ``w`` (init path: the source leaf is a temp)."""
+    fn = _quantize_jit_donate if donate else _quantize_jit
+    q, s = fn(w, axis % w.ndim)
+    return {"q": q, "s": s}
+
+
+def quantize_params(params: dict) -> dict:
+    """Quantize every matmul weight of an existing (small enough to be
+    resident) params tree; embedding and norms stay in model dtype."""
+    layers = {
+        k: (quantize_weight(v, axis=-2) if k in _QUANT_KEYS and not isinstance(v, dict) else v)
+        for k, v in params["layers"].items()
+    }
+    out = dict(params, layers=layers)
+    if "lm_head" in params and not isinstance(params["lm_head"], dict):
+        out["lm_head"] = quantize_weight(params["lm_head"], axis=-2)
+    return out
+
+
+_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def _mm(x: jnp.ndarray, w) -> jnp.ndarray:
+    """Matmul against a maybe-quantized weight (plain array or the
+    ``{"q", "s"}`` int8 dict). Dequant is fused into the dot by XLA; the
+    per-output-channel scale is applied to the f32-accumulated result."""
+    if isinstance(w, dict):
+        y = jnp.matmul(x, w["q"].astype(x.dtype), preferred_element_type=jnp.float32)
+        return (y * w["s"]).astype(x.dtype)
+    return x @ w
 
 
 # ---------------------------------------------------------------- KV cache
@@ -150,9 +230,9 @@ def _qkv(
     B, S, _ = x.shape
     H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (h @ lp["wq"]).reshape(B, S, H, Dh)
-    k = (h @ lp["wk"]).reshape(B, S, Hkv, Dh)
-    v = (h @ lp["wv"]).reshape(B, S, Hkv, Dh)
+    q = _mm(h, lp["wq"]).reshape(B, S, H, Dh)
+    k = _mm(h, lp["wk"]).reshape(B, S, Hkv, Dh)
+    v = _mm(h, lp["wv"]).reshape(B, S, Hkv, Dh)
     q = apply_rope(q, positions, sin, cos)
     k = apply_rope(k, positions, sin, cos)
     return h, q, k, v
@@ -163,10 +243,10 @@ def _attn_mlp_epilogue(
 ) -> jnp.ndarray:
     """Shared layer epilogue: attn output projection + SwiGLU MLP."""
     B, S, _ = x.shape
-    x = x + attn.reshape(B, S, cfg.n_heads * cfg.head_dim) @ lp["wo"]
+    x = x + _mm(attn.reshape(B, S, cfg.n_heads * cfg.head_dim), lp["wo"])
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-    return x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    gate = jax.nn.silu(_mm(h, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    return x + _mm(gate * _mm(h, lp["w_up"]), lp["w_down"])
 
 
 def _layer(
@@ -289,7 +369,16 @@ def _run_layers(
 
 def _logits(cfg: LlamaConfig, params: dict, x: jnp.ndarray) -> jnp.ndarray:
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = params["embedding"].T if cfg.tie_embeddings else params["lm_head"]
+    if cfg.tie_embeddings:
+        head = params["embedding"].T
+    else:
+        head = params["lm_head"]
+        if isinstance(head, dict):
+            y = jnp.einsum(
+                "bsd,dv->bsv", x, head["q"].astype(x.dtype),
+                preferred_element_type=jnp.float32,
+            )
+            return y * head["s"]
     return jnp.einsum("bsd,dv->bsv", x, head, preferred_element_type=jnp.float32)
 
 
@@ -397,9 +486,9 @@ def decode_step_paged(
     def body(h, xs):
         lp, kc, vc = xs  # kc/vc: [N_pages, Hkv, page, Dh]
         hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps)
-        q = (hn @ lp["wq"]).reshape(B, 1, H, Dh)
-        k = (hn @ lp["wk"]).reshape(B, 1, Hkv, Dh)
-        v = (hn @ lp["wv"]).reshape(B, 1, Hkv, Dh)
+        q = _mm(hn, lp["wq"]).reshape(B, 1, H, Dh)
+        k = _mm(hn, lp["wk"]).reshape(B, 1, Hkv, Dh)
+        v = _mm(hn, lp["wv"]).reshape(B, 1, Hkv, Dh)
         q = apply_rope(q, positions, sin, cos)[:, 0]  # [B, H, Dh]
         k = apply_rope(k, positions, sin, cos)[:, 0]  # [B, Hkv, Dh]
         v = v[:, 0]
@@ -420,10 +509,10 @@ def decode_step_paged(
 
             attn = paged_decode_attention_ref(q, kc, vc, block_tables, seq_lens)
 
-        h = h + attn.reshape(B, 1, H * Dh) @ lp["wo"]
+        h = h + _mm(attn.reshape(B, 1, H * Dh), lp["wo"])
         hn = rms_norm(h, lp["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu((hn @ lp["w_gate"]).astype(jnp.float32)).astype(hn.dtype)
-        h = h + (gate * (hn @ lp["w_up"])) @ lp["w_down"]
+        gate = jax.nn.silu(_mm(hn, lp["w_gate"]).astype(jnp.float32)).astype(hn.dtype)
+        h = h + _mm(gate * _mm(hn, lp["w_up"]), lp["w_down"])
         return h, (kc, vc)
 
     x, (k_pool, v_pool) = jax.lax.scan(body, x, (params["layers"], k_pool, v_pool))

@@ -1,0 +1,227 @@
+"""Typed gRPC codegen end-to-end (VERDICT r2 item 3).
+
+Mirrors the reference's gofr-cli generated-service tests: a chat.proto
+with all four RPC kinds is compiled by grpcx/codegen.py at test time
+(system protoc), the generated module is imported, a servicer subclass
+is registered on the real grpc.aio server, and a typed client exercises
+every method — plus server reflection (grpc.go:131-134) listing and
+describing the service.
+"""
+
+import asyncio
+import importlib.util
+import sys
+
+import grpc
+import pytest
+
+from gofr_tpu.config import MapConfig
+from gofr_tpu.grpcx import GRPCServer
+from gofr_tpu.grpcx.codegen import generate, load_input
+from gofr_tpu.testutil import get_free_port, new_mock_container
+
+CHAT_PROTO = """
+syntax = "proto3";
+package chat.v1;
+
+service ChatService {
+  rpc Say(ChatRequest) returns (ChatResponse);
+  rpc Watch(ChatRequest) returns (stream ChatResponse);
+  rpc Upload(stream ChatRequest) returns (ChatResponse);
+  rpc Converse(stream ChatRequest) returns (stream ChatResponse);
+}
+
+message ChatRequest {
+  string text = 1;
+  int32 count = 2;
+}
+
+message ChatResponse {
+  string reply = 1;
+  int32 index = 2;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def generated(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("codegen")
+    proto = tmp / "chat.proto"
+    proto.write_text(CHAT_PROTO)
+    fds = load_input(str(proto))
+    modules = generate(fds)
+    assert "chat_gofr.py" in modules
+    dest = tmp / "chat_gofr.py"
+    dest.write_text(modules["chat_gofr.py"])
+    spec = importlib.util.spec_from_file_location("chat_gofr", dest)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["chat_gofr"] = mod
+    spec.loader.exec_module(mod)
+    yield mod
+    sys.modules.pop("chat_gofr", None)
+
+
+@pytest.fixture(scope="module")
+def servicer_cls(generated):
+    g = generated
+
+    class Chat(g.ChatServiceGofrServicer):
+        async def Say(self, ctx, request):
+            # Context-first signature: the proto request binds like any
+            # other transport's body (request_gofr.go:15-53)
+            bound = ctx.bind(dict)
+            assert bound["text"] == request.text
+            return g.ChatResponse(reply=f"hi {request.text}", index=request.count)
+
+        async def Watch(self, ctx, request, stream):
+            for i in range(request.count):
+                stream.send(g.ChatResponse(reply=request.text, index=i))
+
+        async def Upload(self, ctx, stream):
+            texts = [m.text async for m in stream]
+            return g.ChatResponse(reply=",".join(texts), index=len(texts))
+
+        async def Converse(self, ctx, stream):
+            while True:
+                msg = await stream.recv()
+                if msg is None:
+                    return
+                stream.send(g.ChatResponse(reply=msg.text.upper(), index=stream.received))
+
+    return Chat
+
+
+def test_generated_module_shape(generated):
+    g = generated
+    assert g.ChatServiceGofrServicer.SERVICE_NAME == "chat.v1.ChatService"
+    assert set(g.ChatServiceGofrServicer.METHODS) == {"Say", "Watch", "Upload", "Converse"}
+    kinds = {k: v[0] for k, v in g.ChatServiceGofrServicer.METHODS.items()}
+    assert kinds == {
+        "Say": "unary_unary", "Watch": "unary_stream",
+        "Upload": "stream_unary", "Converse": "stream_stream",
+    }
+    msg = g.ChatRequest(text="x", count=3)
+    assert g.ChatRequest.FromString(msg.SerializeToString()).count == 3
+
+
+def test_typed_service_end_to_end(generated, servicer_cls, run_async):
+    g = generated
+    container, _ = new_mock_container()
+    port = get_free_port()
+    server = GRPCServer(
+        container, port, MapConfig({"GRPC_ENABLE_REFLECTION": "true"}, use_env=False)
+    )
+    server.register(servicer_cls())
+
+    async def scenario():
+        await server.start()
+        client = g.ChatServiceGofrClient(f"127.0.0.1:{port}")
+        try:
+            # unary
+            resp = await client.Say(g.ChatRequest(text="ada", count=7))
+            assert (resp.reply, resp.index) == ("hi ada", 7)
+
+            # server streaming (typed frames, in order)
+            frames = [f async for f in client.Watch(g.ChatRequest(text="t", count=3))]
+            assert [f.index for f in frames] == [0, 1, 2]
+            assert all(isinstance(f, g.ChatResponse) for f in frames)
+
+            # client streaming
+            async def uploads():
+                for t in ("a", "b", "c"):
+                    yield g.ChatRequest(text=t)
+
+            resp = await client.Upload(uploads())
+            assert (resp.reply, resp.index) == ("a,b,c", 3)
+
+            # bidi
+            call = client.Converse(uploads())
+            replies = [r.reply async for r in call]
+            assert replies == ["A", "B", "C"]
+        finally:
+            await client.close()
+            await server.shutdown(grace=0.2)
+
+    run_async(scenario())
+
+
+def test_reflection_lists_and_describes(generated, servicer_cls, run_async):
+    g = generated
+    container, _ = new_mock_container()
+    port = get_free_port()
+    server = GRPCServer(
+        container, port, MapConfig({"GRPC_ENABLE_REFLECTION": "true"}, use_env=False)
+    )
+    server.register(servicer_cls())
+
+    from gofr_tpu.grpcx.reflection import _read_binpb
+    from gofr_tpu.grpcx.runtime import load_messages
+
+    msgs = load_messages(_read_binpb("reflection.binpb"))
+    Req = msgs["grpc.reflection.v1alpha.ServerReflectionRequest"]
+    Resp = msgs["grpc.reflection.v1alpha.ServerReflectionResponse"]
+
+    async def scenario():
+        await server.start()
+        channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+        call = channel.stream_stream(
+            "/grpc.reflection.v1alpha.ServerReflection/ServerReflectionInfo",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=Resp.FromString,
+        )
+
+        async def requests():
+            yield Req(list_services="*")
+            yield Req(file_containing_symbol="chat.v1.ChatService")
+            yield Req(file_by_filename="chat.proto")
+            yield Req(file_containing_symbol="no.such.Symbol")
+
+        try:
+            responses = [r async for r in call(requests())]
+            assert len(responses) == 4
+            names = {s.name for s in responses[0].list_services_response.service}
+            assert "chat.v1.ChatService" in names
+            assert "grpc.health.v1.Health" in names
+            assert "grpc.reflection.v1alpha.ServerReflection" in names
+
+            from google.protobuf import descriptor_pb2
+
+            fd_bytes = responses[1].file_descriptor_response.file_descriptor_proto
+            assert fd_bytes, "expected a file descriptor for the chat service"
+            fd = descriptor_pb2.FileDescriptorProto.FromString(fd_bytes[0])
+            assert fd.name == "chat.proto"
+            assert responses[2].file_descriptor_response.file_descriptor_proto
+            assert responses[3].error_response.error_code == grpc.StatusCode.NOT_FOUND.value[0]
+        finally:
+            await channel.close()
+            await server.shutdown(grace=0.2)
+
+    run_async(scenario())
+
+
+def test_response_type_enforced(generated, servicer_cls, run_async):
+    """Returning the wrong message type is a server-side INTERNAL, not a
+    silent mis-serialization."""
+    g = generated
+
+    class Bad(g.ChatServiceGofrServicer):
+        async def Say(self, ctx, request):
+            return g.ChatRequest(text="wrong type")
+
+    container, _ = new_mock_container()
+    port = get_free_port()
+    server = GRPCServer(container, port, MapConfig({}, use_env=False))
+    server.register(Bad())
+
+    async def scenario():
+        await server.start()
+        client = g.ChatServiceGofrClient(f"127.0.0.1:{port}")
+        try:
+            with pytest.raises(grpc.aio.AioRpcError) as err:
+                await client.Say(g.ChatRequest(text="x"))
+            assert err.value.code() == grpc.StatusCode.INTERNAL
+        finally:
+            await client.close()
+            await server.shutdown(grace=0.2)
+
+    run_async(scenario())

@@ -1,0 +1,181 @@
+"""DCN multi-host coordination (VERDICT r2 item 4, SURVEY §5.8 item 3).
+
+Two real OS worker processes (gofr_tpu.distributed.worker_main), each
+serving a tiny-llama engine over gRPC on CPU, register with an
+in-process leader. The test drives generate requests through the
+leader's shard routing, SIGKILLs one worker, and asserts the leader
+detects the death (DEGRADED, epoch bump, shard renumbering) while
+requests keep succeeding on the survivor — recovery without process
+death, no TPUs required.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from gofr_tpu.config import MapConfig
+from gofr_tpu.distributed import ClusterState, CoordinationService
+from gofr_tpu.distributed import coordination_gofr as pb
+from gofr_tpu.grpcx import GRPCServer, InferenceClient
+from gofr_tpu.testutil import get_free_port, new_mock_container
+
+
+def _spawn_worker(leader_port: int, worker_port: int, host_id: str) -> subprocess.Popen:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # workers need no virtual mesh; faster boot
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "gofr_tpu.distributed.worker_main",
+            "--leader", f"127.0.0.1:{leader_port}",
+            "--port", str(worker_port),
+            "--host-id", host_id,
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+    )
+
+
+async def _wait_members(client: pb.CoordinationGofrClient, pred, timeout_s: float):
+    deadline = time.monotonic() + timeout_s
+    last = None
+    while time.monotonic() < deadline:
+        last = await client.Members(pb.MembersRequest())
+        if pred(last):
+            return last
+        await asyncio.sleep(0.1)
+    raise AssertionError(f"membership condition not reached; last: {last}")
+
+
+def test_two_process_cluster_survives_host_drop(run_async):
+    leader_port = get_free_port()
+    w_ports = [get_free_port(), get_free_port()]
+
+    container, _ = new_mock_container()
+    state = ClusterState(heartbeat_interval_s=0.3, heartbeat_deadline_s=1.2)
+    leader = GRPCServer(container, leader_port, MapConfig({}, use_env=False))
+    leader.register(CoordinationService(state))
+
+    procs = []
+
+    async def scenario():
+        await leader.start()
+        procs.extend(
+            _spawn_worker(leader_port, p, f"w{i}") for i, p in enumerate(w_ports)
+        )
+        client = pb.CoordinationGofrClient(f"127.0.0.1:{leader_port}")
+        try:
+            # both workers register (jax import + engine boot can be slow)
+            members = await _wait_members(
+                client,
+                lambda r: len(r.members) == 2
+                and all(m.state == "UP" for m in r.members),
+                timeout_s=180,
+            )
+            assert members.status == "UP"
+            shard_idx = sorted(m.shard_index for m in members.members)
+            assert shard_idx == [0, 1]
+            epoch_before = members.epoch
+
+            # health fan-in: worker heartbeats carry container.health()
+            await _wait_members(
+                client,
+                lambda r: all(m.health_json for m in r.members),
+                timeout_s=30,
+            )
+
+            # requests via leader routing reach every UP shard
+            served = set()
+            for _ in range(4):
+                m = state.pick()
+                assert m is not None
+                icl = InferenceClient(m.address)
+                result = await icl.generate("hello", max_tokens=3)
+                assert result["usage"]["completion_tokens"] >= 1
+                await icl.close()
+                served.add(m.host_id)
+            assert served == {"w0", "w1"}
+
+            # kill one host (simulated machine loss, not graceful exit)
+            procs[0].send_signal(signal.SIGKILL)
+
+            members = await _wait_members(
+                client,
+                lambda r: any(m.state == "DEAD" for m in r.members)
+                and any(m.state == "UP" for m in r.members),
+                timeout_s=30,
+            )
+            assert members.status == "DEGRADED"
+            assert members.epoch > epoch_before
+            dead = next(m for m in members.members if m.state == "DEAD")
+            live = next(m for m in members.members if m.state == "UP")
+            assert dead.host_id == "w0"
+            # shards renumbered over the survivor
+            assert dead.shard_index == -1 and live.shard_index == 0
+
+            # serving continues on the survivor through leader routing
+            for _ in range(3):
+                m = state.pick()
+                assert m is not None and m.host_id == "w1"
+                icl = InferenceClient(m.address)
+                result = await icl.generate("again", max_tokens=3)
+                assert result["usage"]["completion_tokens"] >= 1
+                await icl.close()
+        finally:
+            await client.close()
+            for p in procs:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            await leader.shutdown(grace=0.2)
+
+    run_async(scenario())
+
+
+def test_cluster_state_unit():
+    """Pure membership logic: sweep transitions + reassignment + zombie
+    re-register, without processes."""
+    st = ClusterState(heartbeat_interval_s=0.01, heartbeat_deadline_s=0.05)
+    st.register("a", "h:1", 1)
+    st.register("b", "h:2", 1)
+    assert st.status() == "UP"
+    assert [m.host_id for m in st.assignment()] == ["a", "b"]
+    e0 = st.epoch
+
+    # b goes silent → SUSPECT → DEAD
+    time.sleep(0.12)
+    st.heartbeat("a")
+    st.sweep()
+    assert st.status() == "DEGRADED"
+    assert [m.host_id for m in st.assignment()] == ["a"]
+    assert st.epoch > e0
+
+    # a DEAD host must re-register, not resume by heartbeat
+    assert st.heartbeat("b") is False
+    st.register("b", "h:2", 1)
+    st.heartbeat("b")
+    assert st.status() == "UP"
+    assert len(st.assignment()) == 2
+
+    # SUSPECT recovers on heartbeat
+    time.sleep(0.06)
+    st.sweep()
+    assert st.status() == "DOWN"  # both aged past one deadline → SUSPECT
+    st.heartbeat("a")
+    st.heartbeat("b")
+    assert st.status() == "UP"
+
+    # round-robin routing covers all UP members
+    picked = {st.pick().host_id for _ in range(4)}
+    assert picked == {"a", "b"}
