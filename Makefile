@@ -3,10 +3,11 @@
 # before every commit.
 
 PY ?= python
+ASAN_RT := $(shell g++ -print-file-name=libasan.so 2>/dev/null)
 
-.PHONY: check import-check test bench-smoke native
+.PHONY: check import-check test bench-smoke native native-asan
 
-check: import-check test bench-smoke
+check: import-check test native-asan bench-smoke
 	@echo "CHECK OK"
 
 import-check:
@@ -22,8 +23,24 @@ bench-smoke:
 native:
 	$(MAKE) -C native
 
+# sanitizer tier for the C++ layer (SURVEY §5.2, VERDICT r2 item 8): the
+# same native tests run against ASan+UBSan builds of gofr_runtime.cc /
+# pjrt_dl.cc / stub_plugin.cc. The loader rebuilds with the extra flags
+# into distinct cache entries; libasan must be preloaded before python.
+native-asan:
+	GOFR_NATIVE_EXTRA_CXXFLAGS="-fsanitize=address,undefined -fno-sanitize-recover=all -g -O1" \
+	GOFR_PJRT_INCLUDE_DIRS="$$($(PY) -c 'from gofr_tpu.native import pjrt_include_dirs; print(":".join(pjrt_include_dirs()))')" \
+	LD_PRELOAD=$(ASAN_RT) \
+	ASAN_OPTIONS=detect_leaks=0 \
+	JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/test_native_runtime.py tests/test_native_pjrt.py -q -x
+
 # regenerate the committed descriptor sets for the built-in services
 protos:
 	cd gofr_tpu/grpcx/protos && \
 	protoc -I. --descriptor_set_out=reflection.binpb reflection.proto && \
 	protoc -I. --descriptor_set_out=health.binpb health.proto
+	cd gofr_tpu/datasource/pubsub/protos && \
+	protoc -I. --descriptor_set_out=pubsub_v1.binpb pubsub_v1.proto
+	python -m gofr_tpu.grpcx.codegen gofr_tpu/distributed/coordination.proto \
+	  -o gofr_tpu/distributed/

@@ -78,6 +78,19 @@ class App:
         self.grpc_port = int(self.config.get_or_default("GRPC_PORT", str(DEFAULT_GRPC_PORT)))
         self.metrics_port = int(self.config.get_or_default("METRICS_PORT", str(DEFAULT_METRICS_PORT)))
 
+        # PUBSUB_BACKEND env switch (container/container.go:132-172). A
+        # dark broker at boot is a DEGRADED health state, not a crash —
+        # the reference logs and continues (container.go's connect errors)
+        from gofr_tpu.datasource.pubsub import build_pubsub
+
+        broker = build_pubsub(self.config)
+        if broker is not None:
+            try:
+                self.container.register_datasource("pubsub", broker)
+            except Exception as exc:
+                self.logger.error(f"pubsub backend connect failed: {exc}")
+                self.container.pubsub = broker  # health_check reports DOWN
+
         if not is_cmd:
             self._register_defaults()
 

@@ -107,6 +107,10 @@ class Container:
         m.new_gauge("app_tpu_hbm_used_bytes", "HBM bytes in use per device")
         m.new_gauge("app_tpu_hbm_limit_bytes", "HBM capacity per device")
         m.new_gauge("app_tpu_duty_cycle", "Fraction of wall time the TPU executed in the last window")
+        m.new_counter(
+            "app_tpu_devices_excluded_total",
+            "Devices excluded from the mesh by the sick-chip breaker",
+        )
         m.new_gauge("app_batch_queue_depth", "Requests waiting for batch admission")
         m.new_gauge("app_batch_occupancy", "Fraction of batch slots occupied")
         m.new_gauge("app_kv_cache_pages_used", "Paged KV-cache pages in use")

@@ -220,6 +220,42 @@ class WideColumnStore(Protocol):
 
 
 @runtime_checkable
+class SearchStore(Protocol):
+    """Elasticsearch-shaped contract (datasources.go:708-746)."""
+
+    def create_index(self, index: str, settings: dict | None = None) -> None: ...
+
+    def delete_index(self, index: str) -> None: ...
+
+    def index_document(self, index: str, id: str, document: dict) -> None: ...
+
+    def get_document(self, index: str, id: str) -> dict | None: ...
+
+    def update_document(self, index: str, id: str, update: dict) -> None: ...
+
+    def delete_document(self, index: str, id: str) -> None: ...
+
+    def search(self, index: str, query: dict, size: int = 10) -> dict: ...
+
+    def bulk(self, operations: list[dict]) -> dict: ...
+
+
+@runtime_checkable
+class TimeSeriesStore(Protocol):
+    """InfluxDB/OpenTSDB-shaped contract (datasources.go:790-830,
+    :493-598)."""
+
+    def write_point(self, measurement: str, tags: dict | None = None,
+                    fields: dict | None = None, timestamp: float | None = None) -> None: ...
+
+    def query(self, measurement: str, field: str, **options: Any) -> list[dict]: ...
+
+    def measurements(self) -> list[str]: ...
+
+    def delete_series(self, measurement: str, tags: dict | None = None) -> int: ...
+
+
+@runtime_checkable
 class Cache(Protocol):
     """TPU-build addition: response/KV-prefix cache contract used by the
     serving layer (prefix cache reuse across requests)."""

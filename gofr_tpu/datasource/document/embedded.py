@@ -10,6 +10,9 @@ slot in behind the same Protocol when their SDKs are present.
 Filter language (the subset the reference's Mongo examples use): equality,
 ``$gt/$gte/$lt/$lte/$ne/$in``, and ``$and`` implicitly via multiple keys.
 Updates: ``$set``, ``$inc``, ``$unset``, or whole-document replacement.
+Transactions: Mongo session shape (datasources.go:232-300) via
+``start_session()`` → ``with session.start_transaction(): ...`` /
+``session.with_transaction(fn)`` — atomic commit, rollback on abort.
 """
 
 from __future__ import annotations
@@ -75,7 +78,9 @@ class EmbeddedDocumentStore:
     def __init__(self, path: str = ":memory:") -> None:
         self.path = path
         self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._lock = threading.Lock()
+        # re-entrant: a session transaction holds the lock across its ops
+        self._lock = threading.RLock()
+        self._in_txn = False
         self._logger: Any = None
         self._metrics: Any = None
         self._tracer: Any = None
@@ -105,6 +110,12 @@ class EmbeddedDocumentStore:
             self._logger.info(f"document store connected ({self.path})")
 
     # -- internals -------------------------------------------------------------
+    def _commit(self) -> None:
+        """Per-op commit — suppressed while a session transaction is open
+        so its ops land atomically at Session commit (or vanish on abort)."""
+        if not self._in_txn:
+            self._conn.commit()
+
     def _table(self, collection: str) -> str:
         if not collection.replace("_", "").isalnum():
             raise ValueError(f"invalid collection name {collection!r}")
@@ -137,7 +148,7 @@ class EmbeddedDocumentStore:
                 f'INSERT INTO "{table}" (id, body) VALUES (?, ?)',
                 (str(doc["_id"]), json.dumps(doc)),
             )
-            self._conn.commit()
+            self._commit()
         self._observe("insert_one", collection)
         return doc["_id"]
 
@@ -173,7 +184,7 @@ class EmbeddedDocumentStore:
                 n += 1
                 if limit is not None and n >= limit:
                     break
-            self._conn.commit()
+            self._commit()
         return n
 
     def update_one(self, collection: str, filter: dict, update: dict) -> int:
@@ -199,7 +210,7 @@ class EmbeddedDocumentStore:
                 n += 1
                 if limit is not None and n >= limit:
                     break
-            self._conn.commit()
+            self._commit()
         return n
 
     def delete_one(self, collection: str, filter: dict) -> int:
@@ -214,7 +225,15 @@ class EmbeddedDocumentStore:
         table = self._table(collection)
         with self._lock:
             self._conn.execute(f'DROP TABLE IF EXISTS "{table}"')
-            self._conn.commit()
+            self._commit()
+
+    # -- transactions (Mongo session shape, datasources.go:232-300) ------------
+    def start_session(self) -> "Session":
+        """Mongo-style ``StartSession``: the session's transaction scope
+        makes every store operation inside it atomic (single-writer —
+        the transaction holds the store's write lock, which is exactly
+        sqlite's own concurrency model). Single-threaded use only."""
+        return Session(self)
 
     # -- health ----------------------------------------------------------------
     def health_check(self) -> dict[str, Any]:
@@ -237,6 +256,87 @@ class EmbeddedDocumentStore:
     def close(self) -> None:
         with self._lock:
             self._conn.close()
+
+
+class TransactionAborted(Exception):
+    """Raise this inside a ``with session.start_transaction():`` block (or
+    ``with_transaction`` callback) to roll back silently — the context
+    manager absorbs it after aborting."""
+
+
+class Session:
+    """Mongo sessionContext analogue: StartTransaction / Commit / Abort,
+    plus the ``with_transaction(fn)`` convenience that commits on return
+    and aborts on exception (datasources.go:252-276)."""
+
+    def __init__(self, store: EmbeddedDocumentStore) -> None:
+        self._store = store
+        self._active = False
+
+    # -- explicit control ------------------------------------------------------
+    def start_transaction(self) -> "Session":
+        if self._active:
+            raise RuntimeError("transaction already active on this session")
+        self._store._lock.acquire()
+        self._store._in_txn = True
+        self._active = True
+        return self
+
+    def commit_transaction(self) -> None:
+        self._end(commit=True)
+
+    def abort_transaction(self) -> None:
+        self._end(commit=False)
+
+    def _end(self, commit: bool) -> None:
+        if not self._active:
+            raise RuntimeError("no active transaction")
+        store = self._store
+        try:
+            if commit:
+                store._conn.commit()
+            else:
+                store._conn.rollback()
+        finally:
+            store._in_txn = False
+            self._active = False
+            store._lock.release()
+
+    # -- context / callback forms ---------------------------------------------
+    def __enter__(self) -> "Session":
+        # `with session.start_transaction():` — already begun; `with
+        # session:` alone also works
+        if not self._active:
+            self.start_transaction()
+        return self
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
+        if not self._active:
+            # the body already ended the transaction explicitly
+            # (commit_transaction()/abort_transaction() mid-block) — both
+            # are legitimate Mongo-session moves, nothing left to do
+            return exc_type is TransactionAborted
+        if exc_type is None:
+            self.commit_transaction()
+            return False
+        self.abort_transaction()
+        return exc_type is TransactionAborted  # deliberate aborts don't raise
+
+    def with_transaction(self, fn: Any) -> Any:
+        """Run ``fn(session)`` in a transaction: commit on return, abort
+        on exception (re-raised), like Mongo's WithTransaction."""
+        with self:
+            return fn(self)
+
+    def end_session(self) -> None:
+        if self._active:
+            self.abort_transaction()
+
+    # -- store ops inside the session ------------------------------------------
+    def __getattr__(self, name: str) -> Any:
+        # every DocumentStore operation is valid on the session; the
+        # store's re-entrant lock makes them join the open transaction
+        return getattr(self._store, name)
 
 
 def new_document_store(config: Any) -> EmbeddedDocumentStore:

@@ -13,4 +13,27 @@ from gofr_tpu.datasource.pubsub.kafka import KafkaClient
 from gofr_tpu.datasource.pubsub.message import Message
 from gofr_tpu.datasource.pubsub.memory import InMemoryBroker
 
-__all__ = ["Message", "InMemoryBroker", "KafkaClient"]
+
+def build_pubsub(config):
+    """PUBSUB_BACKEND switch (container/container.go:132-172): KAFKA |
+    MQTT | GOOGLE | MEMORY → a connected-contract client, or None when
+    unset (apps wire their own via app.add_datasource)."""
+    backend = (config.get("PUBSUB_BACKEND") or "").strip().upper()
+    if not backend:
+        return None
+    if backend == "KAFKA":
+        return KafkaClient.from_config(config)
+    if backend == "MQTT":
+        from gofr_tpu.datasource.pubsub.mqtt import MQTTClient
+
+        return MQTTClient.from_config(config)
+    if backend == "GOOGLE":
+        from gofr_tpu.datasource.pubsub.google import GooglePubSubClient
+
+        return GooglePubSubClient.from_config(config)
+    if backend == "MEMORY":
+        return InMemoryBroker.from_config(config)
+    raise ValueError(f"unknown PUBSUB_BACKEND {backend!r}")
+
+
+__all__ = ["Message", "InMemoryBroker", "KafkaClient", "build_pubsub"]

@@ -4,9 +4,11 @@ Reference parity: pkg/gofr/datasource/pubsub/kafka/kafka.go:1-259 —
 publisher + consumer-group subscriber with offset commit, health check,
 topic create/delete, and the pubsub metrics counters. The reference wraps
 segmentio/kafka-go; this image has no Kafka client, so the driver speaks
-the protocol itself (kafka_wire.py): Produce/Fetch/ListOffsets/Metadata
-v0 with magic-0 message sets, OffsetCommit/OffsetFetch v0 for group
-offsets, CreateTopics/DeleteTopics v0 for admin.
+the protocol itself (kafka_wire.py): Produce v3 / Fetch v4 with
+**record-batch v2** framing (magic 2, CRC-32C, per-record headers — what
+Kafka ≥0.11 requires; VERDICT r2 item 5), ListOffsets/Metadata v0,
+OffsetCommit/OffsetFetch v0 for group offsets, CreateTopics/DeleteTopics
+v0 for admin. Message metadata rides as record headers.
 
 Semantics:
 - ``publish`` → Produce acks=-1 (full commit on the broker).
@@ -129,30 +131,30 @@ class KafkaClient:
 
     # -- Publisher -------------------------------------------------------------
     def publish(self, topic: str, message: bytes, metadata: dict | None = None) -> None:
-        """Produce v0, acks=-1. ``metadata`` rides as the message key (the
-        magic-0 format has no headers); absent metadata → null key."""
+        """Produce v3 (record-batch v2), acks=-1. ``metadata`` rides as
+        per-record headers — the native v2 mechanism (the old key-as-JSON
+        hack died with the magic-0 format)."""
         if self._metrics:
             self._metrics.increment_counter("app_pubsub_publish_total_count", topic=topic)
         value = message if isinstance(message, bytes) else str(message).encode()
-        key = None
-        if metadata:
-            import json
-
-            key = json.dumps(metadata, separators=(",", ":")).encode()
-        msg_set = wire.encode_message_set([(0, key, value)])
+        headers = [
+            (str(k), str(v).encode()) for k, v in (metadata or {}).items()
+        ]
+        batch = wire.encode_record_batch(0, [(None, value, headers)])
         body = (
-            wire.int16(-1)  # acks: full ISR
+            wire.string(None)  # transactional_id
+            + wire.int16(-1)  # acks: full ISR
             + wire.int32(5000)  # timeout ms
             + wire.array([
                 wire.string(topic)
                 + wire.array([
                     wire.int32(self.partition)
-                    + wire.int32(len(msg_set))
-                    + msg_set
+                    + wire.int32(len(batch))
+                    + batch
                 ])
             ])
         )
-        r = self._request(wire.PRODUCE, body)
+        r = self._request(wire.PRODUCE, body, api_version=wire.PRODUCE_API_VERSION)
         n_topics = r.int32()
         for _ in range(n_topics):
             r.string()
@@ -160,6 +162,7 @@ class KafkaClient:
                 r.int32()  # partition
                 err = r.int16()
                 r.int64()  # base offset
+                r.int64()  # log append time (v2+)
                 if err != wire.NONE:
                     raise wire.KafkaError(err, f"produce {topic}")
         if self._metrics:
@@ -176,18 +179,13 @@ class KafkaClient:
             self._fetch_into(topic, buf)
         if not buf:
             return None
-        offset, key, value = buf.popleft()
+        offset, key, value, headers = buf.popleft()
         self._positions[topic] = offset + 1
-        metadata: dict[str, str] = {}
-        if key:
-            import json
-
-            try:
-                decoded = json.loads(key)
-                if isinstance(decoded, dict):
-                    metadata = {str(k): str(v) for k, v in decoded.items()}
-            except ValueError:
-                metadata = {"key": key.decode("utf-8", "replace")}
+        metadata: dict[str, str] = {
+            hk: hv.decode("utf-8", "replace") for hk, hv in headers
+        }
+        if key and "key" not in metadata:
+            metadata["key"] = key.decode("utf-8", "replace")
         # NOTE: the subscribe/commit counters are recorded by the framework
         # subscriber loop (subscriber.py:79,93) — counting here too would
         # double every consumed message
@@ -207,23 +205,29 @@ class KafkaClient:
             wire.int32(-1)  # replica_id: client
             + wire.int32(int(self.poll_timeout * 1000))  # max_wait
             + wire.int32(1)  # min_bytes
+            + wire.int32(1 << 22)  # max_bytes (whole response, v3+)
+            + wire.int8(0)  # isolation_level: read_uncommitted (v4+)
             + wire.array([
                 wire.string(topic)
                 + wire.array([
                     wire.int32(self.partition)
                     + wire.int64(position)
-                    + wire.int32(1 << 20)  # max_bytes
+                    + wire.int32(1 << 20)  # partition max_bytes
                 ])
             ])
         )
-        r = self._request(wire.FETCH, body)
+        r = self._request(wire.FETCH, body, api_version=wire.FETCH_API_VERSION)
+        r.int32()  # throttle_time_ms (v1+)
         for _ in range(r.int32()):
             r.string()
             for _ in range(r.int32()):
                 r.int32()  # partition
                 err = r.int16()
                 r.int64()  # high watermark
-                msg_set = r.bytes_() or b""
+                r.int64()  # last stable offset (v4+)
+                for _a in range(r.int32()):  # aborted transactions (v4+)
+                    r.int64(), r.int64()
+                record_set = r.bytes_() or b""
                 if err == wire.OFFSET_OUT_OF_RANGE:
                     # retention (or topic recreation) moved the log relative
                     # to our position: reset straight to the auto_offset_reset
@@ -238,8 +242,8 @@ class KafkaClient:
                     return
                 if err != wire.NONE:
                     raise wire.KafkaError(err, f"fetch {topic}")
-                for entry in wire.decode_message_set(msg_set):
-                    if entry[0] >= position:  # broker may resend from segment start
+                for entry in wire.decode_record_batches(record_set):
+                    if entry[0] >= position:  # batch may start before position
                         buf.append(entry)
 
     def _initial_offset(self, topic: str) -> int:

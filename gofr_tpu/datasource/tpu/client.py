@@ -12,18 +12,29 @@ Design (SURVEY §7 phase 3):
 - ``health_check`` reports per-device state (SURVEY §5.3: a wedged device
   must not take down the server — execution errors are caught and surface
   as DEGRADED health + typed 503s upstream).
+
+Sick-chip circuit breaker (SURVEY §5.3, VERDICT r2 item 7 — "503 is the
+floor, not the goal"): consecutive execute failures are attributed to the
+failing executable's devices; past ``TPU_BREAKER_THRESHOLD`` the device
+is excluded, the mesh is rebuilt over the healthy remainder, cached
+executables are recompiled from their stored recipes, and the in-flight
+call is retried on the survivors — the caller sees a slow success, not a
+dead process. Health turns DEGRADED naming the excluded chip; after
+``TPU_BREAKER_COOLDOWN_S`` the next execute optimistically restores the
+full device set (half-open probe — a still-sick chip just re-trips).
 """
 
 from __future__ import annotations
 
 import contextlib
+import math
 import threading
 import time
 from typing import Any
 
 import jax
 
-from gofr_tpu.parallel.mesh import MeshSpec, build_mesh
+from gofr_tpu.parallel.mesh import AXIS_ORDER, MeshSpec, build_mesh
 
 
 class TPUError(Exception):
@@ -35,12 +46,79 @@ class TPUError(Exception):
         return Level.ERROR
 
 
+class DeviceBreaker:
+    """Breaker state (circuit_breaker.go's Closed/Open model re-targeted
+    at chips): consecutive failures are counted PER EXECUTABLE — a generic
+    execute error cannot name the faulty chip — and when an executable
+    trips the threshold, the client probes each device individually
+    (tiny single-device op under a hang timeout) and only proven-bad
+    chips enter the exclusion registry."""
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 30.0) -> None:
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self._failures: dict[str, int] = {}  # executable name → consecutive
+        self.excluded: dict[int, float] = {}  # device id → exclusion time
+
+    def record_failure(self, name: str) -> bool:
+        """Count a failure of ``name``; True when it trips the threshold
+        (the count resets so the post-failover state starts clean)."""
+        self._failures[name] = self._failures.get(name, 0) + 1
+        if self._failures[name] >= self.threshold:
+            self._failures[name] = 0
+            return True
+        return False
+
+    def record_success(self, name: str) -> None:
+        self._failures.pop(name, None)
+
+    def exclude(self, device_ids: list[int]) -> None:
+        now = time.monotonic()
+        for did in device_ids:
+            self.excluded.setdefault(did, now)
+
+    def cooldown_elapsed(self) -> bool:
+        if not self.excluded:
+            return False
+        return time.monotonic() - max(self.excluded.values()) >= self.cooldown_s
+
+    def reset(self) -> None:
+        self._failures.clear()
+        self.excluded.clear()
+
+
+def _shrink_spec(spec: MeshSpec | None, n_healthy: int) -> MeshSpec:
+    """Refit a mesh spec onto fewer chips after exclusion. Policy: model-
+    parallel axes (tp/sp/ep/pp/fsdp) keep their size when they still fit —
+    shrinking them changes per-chip memory layout — and the dp (replica)
+    axis absorbs the loss; when the model axes themselves no longer fit,
+    halve the innermost one until they do (power-of-two steps keep shapes
+    divisible)."""
+    if spec is None:
+        return MeshSpec(dp=max(1, n_healthy))
+    sizes = dict(zip(AXIS_ORDER, spec.sizes()))
+    model_axes = [a for a in AXIS_ORDER if a != "dp"]
+    other = math.prod(sizes[a] for a in model_axes)
+    while other > n_healthy:
+        for a in ("tp", "sp", "ep", "pp", "fsdp"):  # innermost first
+            if sizes[a] > 1:
+                sizes[a] = sizes[a] // 2 if sizes[a] % 2 == 0 else 1
+                break
+        else:
+            break
+        other = math.prod(sizes[a] for a in model_axes)
+    sizes["dp"] = max(1, n_healthy // max(other, 1))
+    return MeshSpec(**sizes)
+
+
 class TPUClient:
     def __init__(
         self,
         mesh_spec: str | MeshSpec | None = None,
         platform: str | None = None,
         compile_cache_dir: str | None = None,
+        breaker_threshold: int = 3,
+        breaker_cooldown_s: float = 30.0,
     ) -> None:
         self.mesh_spec = mesh_spec
         self.platform = platform
@@ -49,9 +127,12 @@ class TPUClient:
         self._metrics: Any = None
         self._tracer: Any = None
         self._mesh: Any = None
-        self._devices: list = []
+        self._all_devices: list = []  # as discovered at connect
+        self._devices: list = []  # healthy subset the mesh is built over
         self._executables: dict[str, Any] = {}
         self._exec_meta: dict[str, dict] = {}
+        self._recipes: dict[str, dict] = {}  # name → how to recompile
+        self._breaker = DeviceBreaker(breaker_threshold, breaker_cooldown_s)
         self._lock = threading.Lock()
         self._busy_ns = 0
         self._window_start = time.monotonic()
@@ -64,6 +145,12 @@ class TPUClient:
             mesh_spec=config.get("TPU_MESH"),
             platform=config.get("TPU_PJRT_PLUGIN"),
             compile_cache_dir=config.get("TPU_COMPILE_CACHE_DIR"),
+            breaker_threshold=int(
+                config.get_or_default("TPU_BREAKER_THRESHOLD", "3")
+            ),
+            breaker_cooldown_s=float(
+                config.get_or_default("TPU_BREAKER_COOLDOWN_S", "30")
+            ),
         )
 
     # -- provider pattern ------------------------------------------------------
@@ -81,11 +168,10 @@ class TPUClient:
             jax.config.update("jax_compilation_cache_dir", self.compile_cache_dir)
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
         self._probe_native_binding()
-        self._devices = jax.devices(self.platform) if self.platform else jax.devices()
-        spec = self.mesh_spec
-        if isinstance(spec, str):
-            spec = MeshSpec.parse(spec)
-        self._mesh = build_mesh(spec, self._devices)
+        self._all_devices = (
+            jax.devices(self.platform) if self.platform else jax.devices()
+        )
+        self._rebuild_mesh()
         if self._logger:
             kinds = {d.device_kind for d in self._devices}
             self._logger.info(
@@ -93,6 +179,32 @@ class TPUClient:
                 f"({', '.join(sorted(kinds))}), mesh={dict(zip(self._mesh.axis_names, self._mesh.devices.shape))}"
             )
         self._publish_hbm_gauges()
+
+    def _rebuild_mesh(self) -> None:
+        """(Re)build the mesh over the healthy device subset; when the
+        device set actually changes, stale executables are dropped (their
+        recipes recompile lazily on next use). A rebuild onto the SAME
+        set — the half-open restore, or first connect — keeps compiled
+        executables: mesh-bound ones still reference valid devices."""
+        healthy = [d for d in self._all_devices if d.id not in self._breaker.excluded]
+        if not healthy:
+            raise TPUError("all devices excluded by the sick-chip breaker")
+        spec = self.mesh_spec
+        if isinstance(spec, str):
+            spec = MeshSpec.parse(spec)
+        if len(healthy) < len(self._all_devices):
+            spec = _shrink_spec(
+                spec.resolve(len(self._all_devices)) if spec else None, len(healthy)
+            )
+            new_devices = healthy[: spec.total()]
+        else:
+            new_devices = healthy
+        changed = [d.id for d in new_devices] != [d.id for d in self._devices]
+        self._devices = new_devices
+        self._mesh = build_mesh(spec, self._devices)
+        if changed:
+            with self._lock:
+                self._executables.clear()  # compiled for the old device set
 
     # -- TPU contract ----------------------------------------------------------
     def device_count(self) -> int:
@@ -113,14 +225,32 @@ class TPUClient:
         **jit_kw: Any,
     ) -> Any:
         """AOT compile ``fn`` for the given abstract args (ShapeDtypeStructs
-        or example arrays) and cache under ``name``."""
+        or example arrays) and cache under ``name``. The recipe (fn +
+        abstract args + options) is retained so the executable can be
+        rebuilt after a sick-chip mesh shrink; explicit shardings reference
+        the CURRENT mesh object, so ``in_shardings`` may also be a callable
+        ``mesh -> shardings`` to stay rebuildable across failover."""
         with self._span(f"tpu.compile {name}"):
             start = time.perf_counter()
             kw: dict[str, Any] = dict(jit_kw)
+            mesh_bound = False
             if in_shardings is not None:
-                kw["in_shardings"] = in_shardings
+                kw["in_shardings"] = (
+                    in_shardings(self._mesh) if callable(in_shardings) else in_shardings
+                )
+                mesh_bound = not callable(in_shardings)
+            elif self._devices:
+                # pin unsharded compiles to the first HEALTHY device — the
+                # jax default device stays the sick chip after an exclusion,
+                # so a failover recompile must not follow it back
+                from jax.sharding import SingleDeviceSharding
+
+                kw["in_shardings"] = SingleDeviceSharding(self._devices[0])
             if out_shardings is not None:
-                kw["out_shardings"] = out_shardings
+                kw["out_shardings"] = (
+                    out_shardings(self._mesh) if callable(out_shardings) else out_shardings
+                )
+                mesh_bound = mesh_bound or not callable(out_shardings)
             jitted = jax.jit(
                 fn, donate_argnums=donate_argnums, static_argnums=static_argnums, **kw
             )
@@ -138,9 +268,42 @@ class TPUClient:
                 "flops": _cost_value(compiled, "flops"),
                 "bytes_accessed": _cost_value(compiled, "bytes accessed"),
             }
+            self._recipes[name] = {
+                "fn": fn,
+                "abstract_args": abstract_args,
+                "in_shardings": in_shardings,
+                "out_shardings": out_shardings,
+                "donate_argnums": donate_argnums,
+                "static_argnums": static_argnums,
+                "jit_kw": jit_kw,
+                # executables whose shardings are bound to a concrete mesh
+                # object cannot be transparently rebuilt on a shrunk mesh
+                "mesh_bound": mesh_bound,
+            }
         if self._logger:
             self._logger.info(f"compiled executable {name} in {elapsed:.2f}s")
         return compiled
+
+    def _recompile(self, name: str) -> Any:
+        """Rebuild a dropped executable from its recipe (post-failover)."""
+        with self._lock:
+            recipe = self._recipes.get(name)
+        if recipe is None:
+            return None
+        if recipe["mesh_bound"]:
+            raise TPUError(
+                f"executable {name} was compiled with shardings bound to the "
+                "previous mesh; recompile it (pass callable shardings to stay "
+                "rebuildable across sick-chip failover)"
+            )
+        return self.compile(
+            name, recipe["fn"], *recipe["abstract_args"],
+            in_shardings=recipe["in_shardings"],
+            out_shardings=recipe["out_shardings"],
+            donate_argnums=recipe["donate_argnums"],
+            static_argnums=recipe["static_argnums"],
+            **recipe["jit_kw"],
+        )
 
     def get_executable(self, name: str) -> Any:
         with self._lock:
@@ -148,8 +311,13 @@ class TPUClient:
 
     def execute(self, name: str, *args: Any, block: bool = False) -> Any:
         """Run a cached executable. Async by default (JAX dispatch);
-        ``block=True`` waits for completion (bench paths)."""
+        ``block=True`` waits for completion (bench paths). Failures feed
+        the sick-chip breaker; the tripping call fails over to the healthy
+        remainder and retries instead of surfacing the error."""
+        self._maybe_restore()
         compiled = self.get_executable(name)
+        if compiled is None:
+            compiled = self._recompile(name)
         if compiled is None:
             raise TPUError(f"executable {name} not compiled")
         start = time.perf_counter_ns()
@@ -160,10 +328,120 @@ class TPUClient:
                     jax.block_until_ready(out)
             except Exception as exc:
                 self._last_error = f"execute {name}: {exc}"
-                raise TPUError(f"execution of {name} failed: {exc}") from exc
+                return self._on_execute_failure(name, args, block, exc)
+        self._breaker.record_success(name)
+        self._last_error = None
         busy = time.perf_counter_ns() - start
         self._observe_execution(name, busy)
         return out
+
+    def _probe_device(self, device: Any) -> bool:
+        """One tiny single-device op: does this chip still answer?"""
+        import numpy as _np
+
+        x = jax.device_put(_np.ones((8,), _np.float32), device)
+        out = jax.block_until_ready(x + 1)
+        return bool(_np.asarray(out)[0] == 2.0)
+
+    def _probe_devices_safely(self, devices: list, timeout_s: float = 5.0) -> list[int]:
+        """Probe every device CONCURRENTLY (a wedged chip HANGS rather
+        than raises, so each probe runs in a daemon thread and the whole
+        sweep shares one deadline — N sick chips cost ~timeout once, not
+        N stalls). Returns the ids that failed to answer."""
+        results: dict[int, bool] = {}
+        lock = threading.Lock()
+
+        def run(dev: Any) -> None:
+            try:
+                ok = self._probe_device(dev)
+            except Exception:
+                ok = False
+            with lock:
+                results[dev.id] = ok
+
+        threads = [
+            threading.Thread(target=run, args=(d,), daemon=True) for d in devices
+        ]
+        deadline = time.monotonic() + timeout_s
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+        with lock:
+            return [d.id for d in devices if not results.get(d.id, False)]
+
+    def _on_execute_failure(self, name: str, args: tuple, block: bool, exc: Exception) -> Any:
+        """Breaker bookkeeping + failover retry (SURVEY §5.3). Below the
+        threshold the caller still gets the typed 503; the failure that
+        trips it triggers per-device probing, exclusion of proven-bad
+        chips, a mesh rebuild over the survivors, and a retry of THIS
+        call — in-flight work is re-run, not dropped."""
+        if not self._breaker.record_failure(name):
+            raise TPUError(f"execution of {name} failed: {exc}") from exc
+        newly = self._probe_devices_safely(self._devices)
+        if not newly:
+            # every chip answers: not a device fault (bad input, OOM, bug)
+            raise TPUError(
+                f"execution of {name} failed (all devices probe healthy): {exc}"
+            ) from exc
+        self._breaker.exclude(newly)
+        if self._logger:
+            self._logger.error(
+                f"sick-chip breaker tripped on device(s) {newly} "
+                f"after repeated failures of {name}; rebuilding mesh over "
+                f"{len(self._all_devices) - len(self._breaker.excluded)} healthy device(s)"
+            )
+        try:
+            self._rebuild_mesh()
+            retry = self._recompile(name)
+        except TPUError:
+            raise
+        except Exception as rexc:
+            raise TPUError(
+                f"failover after excluding device(s) {newly} failed: {rexc}"
+            ) from rexc
+        if retry is None:
+            raise TPUError(f"execution of {name} failed: {exc}") from exc
+        retry_start = time.perf_counter_ns()
+        with self._span(f"tpu.execute {name} (failover)"):
+            try:
+                out = retry(*args)
+                if block:
+                    jax.block_until_ready(out)
+            except Exception as rexc:
+                self._last_error = f"execute {name} (failover): {rexc}"
+                raise TPUError(
+                    f"execution of {name} failed even after failover: {rexc}"
+                ) from rexc
+        if self._logger:
+            self._logger.warn(
+                f"request recovered on shrunk mesh after excluding {newly}"
+            )
+        if self._metrics:
+            for did in newly:
+                self._metrics.increment_counter(
+                    "app_tpu_devices_excluded_total", device=str(did)
+                )
+        # the recovered call IS a successful execution: it must feed the
+        # duty-cycle/latency observability and reset failure state like
+        # any other success
+        self._breaker.record_success(name)
+        self._last_error = None
+        self._observe_execution(name, time.perf_counter_ns() - retry_start)
+        return out
+
+    def _maybe_restore(self) -> None:
+        """Half-open probe: after the cooldown, optimistically restore the
+        full device set — a still-sick chip re-trips within threshold."""
+        if self._breaker.excluded and self._breaker.cooldown_elapsed():
+            restored = sorted(self._breaker.excluded)
+            self._breaker.reset()
+            self._rebuild_mesh()
+            if self._logger:
+                self._logger.info(
+                    f"sick-chip breaker cooldown elapsed; probing previously "
+                    f"excluded device(s) {restored}"
+                )
 
     def _observe_execution(self, name: str, busy_ns: int) -> None:
         with self._lock:
@@ -240,6 +518,13 @@ class TPUClient:
             "hbm": self.hbm_stats()["devices"],
             "native_pjrt": self._native_info,
         }
+        if self._breaker.excluded:
+            # SURVEY §5.3: DEGRADED must NAME the excluded chip
+            details["excluded_devices"] = sorted(self._breaker.excluded)
+            details["devices_discovered"] = len(self._all_devices)
+            if self._last_error:
+                details["last_error"] = self._last_error
+            return {"status": "DEGRADED", "details": details}
         if self._last_error:
             details["last_error"] = self._last_error
             return {"status": "DEGRADED", "details": details}

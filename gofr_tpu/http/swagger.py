@@ -1,42 +1,31 @@
 """Swagger / OpenAPI rendering.
 
-Reference parity: pkg/gofr/swagger.go:15-70 — when ``./static/openapi.json``
-exists it is served at ``/.well-known/openapi.json`` and an embedded
-Swagger-UI page at ``/.well-known/swagger``. The UI here is a minimal
-self-contained HTML page that loads the spec and renders it via the
-swagger-ui CDN when reachable, with a plain JSON fallback (zero vendored
-assets instead of go:embed)."""
+Reference parity: pkg/gofr/swagger.go:15-70 + pkg/gofr/static/ — when
+``./static/openapi.json`` exists it is served at
+``/.well-known/openapi.json`` and an **embedded** UI at
+``/.well-known/swagger``. The UI asset (http/static/swagger_ui.html) is
+a fully self-contained vanilla-JS OpenAPI explorer — grouped operations,
+parameter/schema tables, sample bodies resolved through ``$ref``, and
+try-it-out execution — shipped in the package like the reference's
+go:embed bundle; no CDN or external fetch is ever made."""
 
 from __future__ import annotations
 
+import functools
 import json
+import os
 from typing import Any, Callable
 
 from gofr_tpu.http.response import File, Raw
 
-_UI_TEMPLATE = """<!DOCTYPE html>
-<html>
-<head>
-  <title>API Documentation</title>
-  <link rel="stylesheet" href="https://unpkg.com/swagger-ui-dist@5/swagger-ui.css">
-</head>
-<body>
-  <div id="swagger-ui"><pre id="fallback" style="display:none"></pre></div>
-  <script src="https://unpkg.com/swagger-ui-dist@5/swagger-ui-bundle.js"></script>
-  <script>
-    if (window.SwaggerUIBundle) {
-      SwaggerUIBundle({url: '/.well-known/openapi.json', dom_id: '#swagger-ui'});
-    } else {
-      fetch('/.well-known/openapi.json').then(r => r.json()).then(spec => {
-        const el = document.getElementById('fallback');
-        el.style.display = 'block';
-        el.textContent = JSON.stringify(spec, null, 2);
-      });
-    }
-  </script>
-</body>
-</html>
-"""
+_UI_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "static", "swagger_ui.html")
+
+
+@functools.lru_cache(maxsize=1)
+def swagger_ui_html() -> bytes:
+    # immutable at runtime — load once, like go:embed
+    with open(_UI_PATH, "rb") as f:
+        return f.read()
 
 
 def swagger_handlers(spec_path: str) -> tuple[Callable, Callable]:
@@ -45,6 +34,6 @@ def swagger_handlers(spec_path: str) -> tuple[Callable, Callable]:
             return Raw(json.load(f))
 
     def ui_handler(ctx: Any) -> Any:
-        return File(content=_UI_TEMPLATE.encode(), content_type="text/html")
+        return File(content=swagger_ui_html(), content_type="text/html")
 
     return spec_handler, ui_handler

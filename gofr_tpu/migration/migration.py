@@ -28,13 +28,22 @@ class Migrate:
 
 @dataclasses.dataclass
 class Datasource:
-    """The facade handed to UP functions (migration/datasource.go)."""
+    """The facade handed to UP functions (migration/datasource.go). Every
+    container family is reachable, so a migration can create topics,
+    search indices, document collections, or time-series retention the
+    same way the reference's 13-datasource chain does
+    (migration.go:118-235)."""
 
     sql: Any = None
     redis: Any = None
     kv_store: Any = None
     pubsub: Any = None
     tpu: Any = None
+    file: Any = None
+    document: Any = None
+    search: Any = None
+    timeseries: Any = None
+    widecolumn: Any = None
     logger: Any = None
 
 
@@ -76,12 +85,18 @@ def run_migrations(migrations: dict[int, Migrate | Callable], container: Any) ->
     if any(v <= 0 for v in versions):
         raise MigrationError("migration versions must be positive integers")
 
+    extra = getattr(container, "extra_datasources", {})
     ds = Datasource(
         sql=container.sql,
         redis=container.redis,
         kv_store=container.kv_store,
         pubsub=container.pubsub,
         tpu=container.tpu,
+        file=container.file,
+        document=extra.get("document"),
+        search=extra.get("search"),
+        timeseries=extra.get("timeseries"),
+        widecolumn=extra.get("widecolumn"),
         logger=logger,
     )
 

@@ -63,7 +63,14 @@ def _source_hash(*paths: str) -> str:
 
 
 def pjrt_include_dirs() -> list[str]:
-    """Locate the PJRT C API headers (shipped in the image's tensorflow)."""
+    """Locate the PJRT C API headers (shipped in the image's tensorflow).
+    ``GOFR_PJRT_INCLUDE_DIRS`` (colon-separated) short-circuits the
+    tensorflow import — required under the ASan tier, where importing
+    TF's pybind11 dependency chain trips the sanitizer's exception
+    interceptor before our code even runs."""
+    env = os.environ.get("GOFR_PJRT_INCLUDE_DIRS")
+    if env:
+        return [d for d in env.split(":") if d]
     dirs = []
     try:
         import tensorflow  # noqa: F401  (cpu wheel, only used for headers)
@@ -86,7 +93,12 @@ def build_library(name: str, sources: list[str], extra_flags: list[str] | None =
     srcs = [os.path.join(_NATIVE_DIR, s) for s in sources]
     if not all(os.path.exists(s) for s in srcs):
         return None
+    # sanitizer tier (SURVEY §5.2): GOFR_NATIVE_EXTRA_CXXFLAGS joins the
+    # build AND the cache tag, so asan and release artifacts never collide
+    env_flags = os.environ.get("GOFR_NATIVE_EXTRA_CXXFLAGS", "").split()
     tag = _source_hash(*srcs)
+    if env_flags:
+        tag += "-" + hashlib.sha256(" ".join(env_flags).encode()).hexdigest()[:8]
     out = os.path.join(_BUILD_DIR, f"{name}-{tag}.so")
     if os.path.exists(out):
         return out
@@ -95,6 +107,7 @@ def build_library(name: str, sources: list[str], extra_flags: list[str] | None =
         os.environ.get("CXX", "g++"),
         "-O2", "-fPIC", "-std=c++17", "-shared", "-fvisibility=hidden",
         *(extra_flags or []),
+        *env_flags,
         "-o", out + ".tmp", *srcs, *(libs or []),
     ]
     try:

@@ -1,0 +1,225 @@
+"""In-process google.pubsub.v1 fake — the Pub/Sub emulator analogue.
+
+Serves the wire subset in datasource/pubsub/protos/pubsub_v1.proto over a
+real sync gRPC server (generic handlers + the same descriptor-set message
+classes the driver uses): topic CRUD, per-subscription cursors,
+**ack-deadline redelivery** (an unacked message returns to the pool when
+its deadline lapses; ModifyAckDeadline(0) nacks immediately), Pull
+long-polling. Stands in for the reference CI's service containers
+(SURVEY §4 tier 4) like testutil/kafka_broker.py does for Kafka.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from concurrent import futures
+from typing import Any
+
+import grpc
+
+from gofr_tpu.datasource.pubsub.google import MESSAGES, PUBSUB_FDS, _P
+
+
+class _Subscription:
+    def __init__(self, name: str, topic: str, ack_deadline_s: int) -> None:
+        self.name = name
+        self.topic = topic
+        self.ack_deadline_s = max(0, ack_deadline_s) or 10
+        self.cursor = 0  # next topic-log index to deliver fresh
+        self.outstanding: dict[str, tuple[int, float]] = {}  # ack_id → (idx, deadline)
+        self.redeliver: list[int] = []  # nacked/expired indexes, FIFO
+        self.acked: set[int] = set()
+
+
+class GooglePubSubServer:
+    def __init__(self, port: int = 0) -> None:
+        self._topics: dict[str, list[Any]] = {}  # path → [PubsubMessage]
+        self._subs: dict[str, _Subscription] = {}
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._data = threading.Condition(self._lock)
+
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers((self._handlers(),))
+        self.port = self._server.add_insecure_port(f"127.0.0.1:{port}")
+        self._server.start()
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def close(self) -> None:
+        self._server.stop(grace=0.2)
+
+    # -- wiring ------------------------------------------------------------
+    def _handlers(self) -> grpc.GenericRpcHandler:
+        def unary(fn, in_type: str, out_type: str):
+            in_cls = MESSAGES[f"{_P}.{in_type}"]
+            return grpc.unary_unary_rpc_method_handler(
+                fn,
+                request_deserializer=in_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            )
+
+        publisher = grpc.method_handlers_generic_handler(
+            f"{_P}.Publisher",
+            {
+                "CreateTopic": unary(self._create_topic, "Topic", "Topic"),
+                "DeleteTopic": unary(self._delete_topic, "DeleteTopicRequest", "Empty"),
+                "ListTopics": unary(self._list_topics, "ListTopicsRequest", "ListTopicsResponse"),
+                "Publish": unary(self._publish, "PublishRequest", "PublishResponse"),
+            },
+        )
+        subscriber = grpc.method_handlers_generic_handler(
+            f"{_P}.Subscriber",
+            {
+                "CreateSubscription": unary(self._create_sub, "Subscription", "Subscription"),
+                "DeleteSubscription": unary(self._delete_sub, "DeleteSubscriptionRequest", "Empty"),
+                "Pull": unary(self._pull, "PullRequest", "PullResponse"),
+                "Acknowledge": unary(self._ack, "AcknowledgeRequest", "Empty"),
+                "ModifyAckDeadline": unary(self._modify, "ModifyAckDeadlineRequest", "Empty"),
+            },
+        )
+
+        class Both(grpc.GenericRpcHandler):
+            def service(self, details):
+                return publisher.service(details) or subscriber.service(details)
+
+        return Both()
+
+    # -- Publisher ---------------------------------------------------------
+    def _create_topic(self, request: Any, context: Any) -> Any:
+        with self._lock:
+            if request.name in self._topics:
+                context.abort(grpc.StatusCode.ALREADY_EXISTS, "topic exists")
+            self._topics[request.name] = []
+        return request
+
+    def _delete_topic(self, request: Any, context: Any) -> Any:
+        with self._lock:
+            if self._topics.pop(request.topic, None) is None:
+                context.abort(grpc.StatusCode.NOT_FOUND, "no such topic")
+        return MESSAGES[f"{_P}.Empty"]()
+
+    def _list_topics(self, request: Any, context: Any) -> Any:
+        resp = MESSAGES[f"{_P}.ListTopicsResponse"]()
+        with self._lock:
+            for name in sorted(self._topics):
+                if not request.project or name.startswith(request.project + "/"):
+                    resp.topics.add(name=name)
+        return resp
+
+    def _publish(self, request: Any, context: Any) -> Any:
+        resp = MESSAGES[f"{_P}.PublishResponse"]()
+        with self._data:
+            log = self._topics.get(request.topic)
+            if log is None:
+                context.abort(grpc.StatusCode.NOT_FOUND, "no such topic")
+            for m in request.messages:
+                mid = str(next(self._ids))
+                stored = MESSAGES[f"{_P}.PubsubMessage"]()
+                stored.CopyFrom(m)
+                stored.message_id = mid
+                stored.publish_time = time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                )
+                log.append(stored)
+                resp.message_ids.append(mid)
+            self._data.notify_all()
+        return resp
+
+    # -- Subscriber --------------------------------------------------------
+    def _create_sub(self, request: Any, context: Any) -> Any:
+        with self._lock:
+            if request.name in self._subs:
+                context.abort(grpc.StatusCode.ALREADY_EXISTS, "subscription exists")
+            if request.topic not in self._topics:
+                context.abort(grpc.StatusCode.NOT_FOUND, "no such topic")
+            sub = _Subscription(request.name, request.topic, request.ack_deadline_seconds)
+            # Pub/Sub semantics: a new subscription sees messages published
+            # AFTER it exists
+            sub.cursor = len(self._topics[request.topic])
+            self._subs[request.name] = sub
+        return request
+
+    def _delete_sub(self, request: Any, context: Any) -> Any:
+        with self._lock:
+            if self._subs.pop(request.subscription, None) is None:
+                context.abort(grpc.StatusCode.NOT_FOUND, "no such subscription")
+        return MESSAGES[f"{_P}.Empty"]()
+
+    def _expire_locked(self, sub: _Subscription) -> None:
+        now = time.monotonic()
+        expired = [aid for aid, (_i, dl) in sub.outstanding.items() if dl <= now]
+        for aid in expired:
+            idx, _ = sub.outstanding.pop(aid)
+            if idx not in sub.acked:
+                sub.redeliver.append(idx)
+
+    def _pull(self, request: Any, context: Any) -> Any:
+        resp = MESSAGES[f"{_P}.PullResponse"]()
+        with self._data:
+            sub = self._subs.get(request.subscription)
+            if sub is None:
+                context.abort(grpc.StatusCode.NOT_FOUND, "no such subscription")
+            self._expire_locked(sub)
+            log = self._topics.get(sub.topic, [])
+            n = max(1, request.max_messages)
+            while n > 0:
+                if sub.redeliver:
+                    idx = sub.redeliver.pop(0)
+                elif sub.cursor < len(log):
+                    idx = sub.cursor
+                    sub.cursor += 1
+                else:
+                    break
+                ack_id = f"{sub.name}:{idx}:{next(self._ids)}"
+                sub.outstanding[ack_id] = (
+                    idx, time.monotonic() + sub.ack_deadline_s
+                )
+                resp.received_messages.add(ack_id=ack_id, message=log[idx])
+                n -= 1
+        return resp
+
+    def _ack(self, request: Any, context: Any) -> Any:
+        with self._lock:
+            sub = self._subs.get(request.subscription)
+            if sub is None:
+                context.abort(grpc.StatusCode.NOT_FOUND, "no such subscription")
+            for aid in request.ack_ids:
+                entry = sub.outstanding.pop(aid, None)
+                if entry is not None:
+                    sub.acked.add(entry[0])
+        return MESSAGES[f"{_P}.Empty"]()
+
+    def _modify(self, request: Any, context: Any) -> Any:
+        """deadline 0 = nack (immediate redelivery), else extend."""
+        with self._data:
+            sub = self._subs.get(request.subscription)
+            if sub is None:
+                context.abort(grpc.StatusCode.NOT_FOUND, "no such subscription")
+            for aid in request.ack_ids:
+                entry = sub.outstanding.pop(aid, None)
+                if entry is None:
+                    continue
+                idx, _ = entry
+                if request.ack_deadline_seconds <= 0:
+                    if idx not in sub.acked:
+                        sub.redeliver.append(idx)
+                else:
+                    sub.outstanding[aid] = (
+                        idx, time.monotonic() + request.ack_deadline_seconds
+                    )
+            self._data.notify_all()
+        return MESSAGES[f"{_P}.Empty"]()
+
+    # -- test inspection ---------------------------------------------------
+    def topic_size(self, topic_path: str) -> int:
+        with self._lock:
+            return len(self._topics.get(topic_path, []))
+
+
+def start_google_pubsub(**kw: Any) -> GooglePubSubServer:
+    return GooglePubSubServer(**kw)

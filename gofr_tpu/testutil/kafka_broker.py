@@ -1,11 +1,15 @@
 """In-process Kafka mini-broker for tests.
 
-Speaks the same v0 wire subset as the driver (datasource/pubsub/
-kafka_wire.py): Produce, Fetch (with max_wait long-polling), ListOffsets,
-Metadata, OffsetCommit/OffsetFetch (consumer-group offsets), CreateTopics/
-DeleteTopics. Single-node, any number of single-partition topics,
-append-only in-memory logs. Stands in for the reference CI's Kafka service
-container (SURVEY §4 tier 4) the way testutil/mqtt_broker.py does for MQTT.
+Speaks the wire subset the driver uses (datasource/pubsub/kafka_wire.py):
+**Produce v3 / Fetch v4 with record-batch v2 only** — like a real
+Kafka ≥0.11 broker it answers legacy Produce/Fetch versions with
+UNSUPPORTED_VERSION and magic-0/1 payloads with CORRUPT_MESSAGE, so the
+driver is no longer validated by its own mirror (VERDICT r2 item 5) —
+plus ListOffsets, Metadata, OffsetCommit/OffsetFetch (consumer-group
+offsets), CreateTopics/DeleteTopics. Single-node, any number of
+single-partition topics, append-only in-memory logs. Stands in for the
+reference CI's Kafka service container (SURVEY §4 tier 4) the way
+testutil/mqtt_broker.py does for MQTT.
 """
 
 from __future__ import annotations
@@ -17,6 +21,9 @@ from typing import Any
 
 from gofr_tpu.datasource.pubsub import kafka_wire as wire
 
+# (key, value, headers) triples
+LogEntry = tuple  # type alias for readability
+
 
 class MiniKafkaBroker:
     def __init__(self, port: int = 0, auto_create_topics: bool = True) -> None:
@@ -27,7 +34,7 @@ class MiniKafkaBroker:
         self.port = self._server.getsockname()[1]
         self.auto_create_topics = auto_create_topics
 
-        self._logs: dict[str, list[tuple[bytes | None, bytes]]] = {}
+        self._logs: dict[str, list[tuple[bytes | None, bytes, list]]] = {}
         self._group_offsets: dict[tuple[str, str, int], int] = {}
         self._lock = threading.Lock()
         self._data = threading.Condition(self._lock)
@@ -70,10 +77,10 @@ class MiniKafkaBroker:
                 frame = wire.read_frame(lambda n: wire.recv_exact(conn, n))
                 r = wire.Reader(frame)
                 api_key = r.int16()
-                r.int16()  # api_version (only v0 spoken)
+                api_version = r.int16()
                 correlation_id = r.int32()
                 r.string()  # client_id
-                body = self._dispatch(api_key, r)
+                body = self._dispatch(api_key, api_version, r)
                 resp = wire.int32(correlation_id) + body
                 conn.sendall(wire.int32(len(resp)) + resp)
         except (ConnectionError, OSError, struct.error, wire.KafkaError):
@@ -84,7 +91,14 @@ class MiniKafkaBroker:
             except OSError:
                 pass
 
-    def _dispatch(self, api_key: int, r: wire.Reader) -> bytes:
+    def _dispatch(self, api_key: int, api_version: int, r: wire.Reader) -> bytes:
+        # record-batch-v2 era strictness: a real ≥0.11 broker does not
+        # accept the legacy produce/fetch framings this repo used to speak
+        if api_key == wire.PRODUCE and api_version < wire.PRODUCE_API_VERSION:
+            return self._produce_error_response(r, wire.UNSUPPORTED_VERSION,
+                                                legacy_version=api_version)
+        if api_key == wire.FETCH and api_version < wire.FETCH_API_VERSION:
+            return self._fetch_error_response_legacy(r)
         handler = {
             wire.PRODUCE: self._handle_produce,
             wire.FETCH: self._handle_fetch,
@@ -99,6 +113,46 @@ class MiniKafkaBroker:
             raise wire.KafkaError(-1, f"unsupported api {api_key}")
         return handler(r)
 
+    def _produce_error_response(
+        self, r: wire.Reader, code: int, legacy_version: int
+    ) -> bytes:
+        """UNSUPPORTED_VERSION for a legacy (v0-v2) produce, framed the
+        way that client expects so it surfaces as a typed error, not a
+        hang."""
+        r.int16(), r.int32()  # acks, timeout (no transactional_id pre-v3)
+        topics_out = []
+        for _ in range(r.int32()):
+            topic = r.string() or ""
+            parts_out = []
+            for _ in range(r.int32()):
+                partition = r.int32()
+                r._take(r.int32())  # payload, ignored
+                part = wire.int32(partition) + wire.int16(code) + wire.int64(-1)
+                if legacy_version >= 2:
+                    part += wire.int64(-1)
+                parts_out.append(part)
+            topics_out.append(wire.string(topic) + wire.array(parts_out))
+        return wire.array(topics_out)
+
+    def _fetch_error_response_legacy(self, r: wire.Reader) -> bytes:
+        """UNSUPPORTED_VERSION per partition in v0 response shape."""
+        r.int32(), r.int32(), r.int32()  # replica, max_wait, min_bytes
+        topics_out = []
+        for _ in range(r.int32()):
+            topic = r.string() or ""
+            parts_out = []
+            for _ in range(r.int32()):
+                partition = r.int32()
+                r.int64(), r.int32()  # offset, max_bytes
+                parts_out.append(
+                    wire.int32(partition)
+                    + wire.int16(wire.UNSUPPORTED_VERSION)
+                    + wire.int64(-1)
+                    + wire.bytes_(b"")
+                )
+            topics_out.append(wire.string(topic) + wire.array(parts_out))
+        return wire.array(topics_out)
+
     # -- api handlers --------------------------------------------------------------
     def _topic_exists_or_create(self, topic: str) -> bool:
         if topic in self._logs:
@@ -109,6 +163,9 @@ class MiniKafkaBroker:
         return False
 
     def _handle_produce(self, r: wire.Reader) -> bytes:
+        """Produce v3: record-batch v2 payloads only; magic 0/1 →
+        CORRUPT_MESSAGE (what a modern broker does)."""
+        r.string()  # transactional_id
         r.int16()  # acks
         r.int32()  # timeout
         topics_out = []
@@ -117,30 +174,45 @@ class MiniKafkaBroker:
             parts_out = []
             for _ in range(r.int32()):
                 partition = r.int32()
-                msg_set = r._take(r.int32())
+                record_set = r._take(r.int32())
+
+                def part_resp(err: int, base: int) -> bytes:
+                    return (
+                        wire.int32(partition)
+                        + wire.int16(err)
+                        + wire.int64(base)
+                        + wire.int64(-1)  # log append time (v2+)
+                    )
+
+                try:
+                    entries = wire.decode_record_batches(record_set)
+                except wire.KafkaError as exc:
+                    parts_out.append(part_resp(
+                        exc.code if exc.code > 0 else wire.CORRUPT_MESSAGE, -1
+                    ))
+                    continue
                 with self._data:
                     if not self._topic_exists_or_create(topic):
                         parts_out.append(
-                            wire.int32(partition)
-                            + wire.int16(wire.UNKNOWN_TOPIC_OR_PARTITION)
-                            + wire.int64(-1)
+                            part_resp(wire.UNKNOWN_TOPIC_OR_PARTITION, -1)
                         )
                         continue
                     log = self._logs[topic]
                     base = len(log)
-                    for _, key, value in wire.decode_message_set(msg_set):
-                        log.append((key, value))
+                    for _, key, value, headers in entries:
+                        log.append((key, value, headers))
                     self._data.notify_all()
-                parts_out.append(
-                    wire.int32(partition) + wire.int16(wire.NONE) + wire.int64(base)
-                )
+                parts_out.append(part_resp(wire.NONE, base))
             topics_out.append(wire.string(topic) + wire.array(parts_out))
         return wire.array(topics_out)
 
     def _handle_fetch(self, r: wire.Reader) -> bytes:
+        """Fetch v4: record-batch v2 record sets, v4 partition headers."""
         r.int32()  # replica_id
         max_wait_ms = r.int32()
         r.int32()  # min_bytes
+        r.int32()  # max_bytes (response-wide, v3+)
+        r.int8()  # isolation_level (v4+)
         requests = []
         for _ in range(r.int32()):
             topic = r.string() or ""
@@ -149,6 +221,16 @@ class MiniKafkaBroker:
                 offset = r.int64()
                 max_bytes = r.int32()
                 requests.append((topic, partition, offset, max_bytes))
+
+        def part_v4(partition: int, err: int, high: int, records: bytes) -> bytes:
+            return (
+                wire.int32(partition)
+                + wire.int16(err)
+                + wire.int64(high)
+                + wire.int64(high)  # last stable offset
+                + wire.array([])  # aborted transactions
+                + wire.bytes_(records)
+            )
 
         # long-poll: wait up to max_wait for any requested topic to grow
         deadline = max_wait_ms / 1000.0
@@ -166,10 +248,7 @@ class MiniKafkaBroker:
                     topics_out.append(
                         wire.string(topic)
                         + wire.array([
-                            wire.int32(partition)
-                            + wire.int16(wire.UNKNOWN_TOPIC_OR_PARTITION)
-                            + wire.int64(-1)
-                            + wire.bytes_(b"")
+                            part_v4(partition, wire.UNKNOWN_TOPIC_OR_PARTITION, -1, b"")
                         ])
                     )
                     continue
@@ -179,31 +258,25 @@ class MiniKafkaBroker:
                     topics_out.append(
                         wire.string(topic)
                         + wire.array([
-                            wire.int32(partition)
-                            + wire.int16(wire.OFFSET_OUT_OF_RANGE)
-                            + wire.int64(high)
-                            + wire.bytes_(b"")
+                            part_v4(partition, wire.OFFSET_OUT_OF_RANGE, high, b"")
                         ])
                     )
                     continue
                 entries, size = [], 0
                 for idx in range(offset, high):
-                    key, value = log[idx]
-                    size += 26 + len(key or b"") + len(value)
+                    key, value, headers = log[idx]
+                    size += 70 + len(key or b"") + len(value)
                     if entries and size > max_bytes:
                         break
-                    entries.append((idx, key, value))
-                msg_set = wire.encode_message_set(entries)
+                    entries.append((key, value, headers))
+                records = (
+                    wire.encode_record_batch(offset, entries) if entries else b""
+                )
                 topics_out.append(
                     wire.string(topic)
-                    + wire.array([
-                        wire.int32(partition)
-                        + wire.int16(wire.NONE)
-                        + wire.int64(high)
-                        + wire.bytes_(msg_set)
-                    ])
+                    + wire.array([part_v4(partition, wire.NONE, high, records)])
                 )
-            return wire.array(topics_out)
+            return wire.int32(0) + wire.array(topics_out)  # throttle_time + topics
 
     def _handle_list_offsets(self, r: wire.Reader) -> bytes:
         r.int32()  # replica_id
@@ -319,7 +392,8 @@ class MiniKafkaBroker:
         return wire.array(topics_out)
 
     # -- test inspection -----------------------------------------------------------
-    def log(self, topic: str) -> list[tuple[bytes | None, bytes]]:
+    def log(self, topic: str) -> list[tuple[bytes | None, bytes, list]]:
+        """[(key, value, headers)] appended to the topic."""
         with self._lock:
             return list(self._logs.get(topic, []))
 

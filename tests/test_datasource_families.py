@@ -1,0 +1,262 @@
+"""New datasource families (VERDICT r2 item 6): search (Elasticsearch
+shape), time-series (Influx/OpenTSDB shape, dogfooded with TPU HBM
+telemetry), and Mongo-style document transactions — each with health
+checks and migration-facade reachability.
+"""
+
+import time
+
+import pytest
+
+from gofr_tpu.config import MapConfig
+from gofr_tpu.datasource.document.embedded import EmbeddedDocumentStore, TransactionAborted
+from gofr_tpu.datasource.search import EmbeddedSearch, IndexNotFound, SearchError
+from gofr_tpu.datasource.timeseries import (
+    EmbeddedTimeSeries,
+    TimeSeriesError,
+    TPUTelemetryRecorder,
+)
+
+
+# ---------------------------------------------------------------- search
+class TestSearch:
+    @pytest.fixture
+    def es(self):
+        s = EmbeddedSearch()
+        s.connect()
+        s.create_index("articles")
+        s.index_document("articles", "1", {"title": "TPU serving at scale", "views": 100})
+        s.index_document("articles", "2", {"title": "Serving LLMs on TPU pods", "views": 250})
+        s.index_document("articles", "3", {"title": "A gardening guide", "views": 5})
+        return s
+
+    def test_match_query_ranks_by_bm25(self, es):
+        res = es.search("articles", {"query": {"match": {"title": "tpu serving"}}})
+        assert res["hits"]["total"]["value"] == 2
+        ids = [h["_id"] for h in res["hits"]["hits"]]
+        assert set(ids) == {"1", "2"}
+        scores = [h["_score"] for h in res["hits"]["hits"]]
+        assert scores == sorted(scores, reverse=True)
+        assert all(s > 0 for s in scores)
+
+    def test_term_and_range_and_bool(self, es):
+        res = es.search("articles", {"query": {"term": {"views": 100}}})
+        assert [h["_id"] for h in res["hits"]["hits"]] == ["1"]
+
+        res = es.search("articles", {"query": {"range": {"views": {"gte": 100}}}})
+        assert {h["_id"] for h in res["hits"]["hits"]} == {"1", "2"}
+
+        res = es.search("articles", {"query": {"bool": {
+            "must": [{"match": {"title": "tpu"}}],
+            "must_not": [{"term": {"views": 100}}],
+        }}})
+        assert [h["_id"] for h in res["hits"]["hits"]] == ["2"]
+
+    def test_document_crud(self, es):
+        assert es.get_document("articles", "1")["views"] == 100
+        es.update_document("articles", "1", {"views": 101})
+        assert es.get_document("articles", "1")["views"] == 101
+        # the index follows the update
+        res = es.search("articles", {"query": {"term": {"views": 101}}})
+        assert res["hits"]["total"]["value"] == 1
+        es.delete_document("articles", "1")
+        assert es.get_document("articles", "1") is None
+        res = es.search("articles", {"query": {"match": {"title": "scale"}}})
+        assert res["hits"]["total"]["value"] == 0
+
+    def test_bulk_and_errors(self, es):
+        result = es.bulk([
+            {"index": {"_index": "articles", "_id": "9", "doc": {"title": "bulk doc"}}},
+            {"delete": {"_index": "articles", "_id": "no-such"}},
+        ])
+        assert result["errors"] is True
+        assert result["items"][0]["index"]["status"] == 201
+        assert es.get_document("articles", "9")["title"] == "bulk doc"
+
+    def test_index_admin_and_health(self, es):
+        with pytest.raises(SearchError):
+            es.create_index("articles")
+        with pytest.raises(IndexNotFound):
+            es.delete_index("nope")
+        health = es.health_check()
+        assert health["status"] == "UP"
+        assert health["details"]["documents"] == 3
+        es.delete_index("articles")
+        assert es.indices() == []
+
+
+# ---------------------------------------------------------------- time-series
+class TestTimeSeries:
+    def test_write_query_window_aggregation(self):
+        ts = EmbeddedTimeSeries()
+        ts.connect()
+        base = 1000.0
+        for i in range(10):
+            ts.write_point("latency", {"route": "/generate"},
+                           {"ms": float(i)}, timestamp=base + i)
+        # raw points in range
+        rows = ts.query("latency", "ms", start=base + 2, end=base + 4)
+        assert [r["value"] for r in rows] == [2.0, 3.0, 4.0]
+        # 5s windows, mean: [0..4]→2.0, [5..9]→7.0
+        rows = ts.query("latency", "ms", aggregation="mean", every=5.0)
+        assert [(r["time"], r["value"]) for r in rows] == [(1000.0, 2.0), (1005.0, 7.0)]
+        rows = ts.query("latency", "ms", aggregation="max", every=5.0)
+        assert [r["value"] for r in rows] == [4.0, 9.0]
+        rows = ts.query("latency", "ms", aggregation="count", every=5.0)
+        assert [r["value"] for r in rows] == [5.0, 5.0]
+
+    def test_tag_filtering_and_series(self):
+        ts = EmbeddedTimeSeries()
+        ts.write_point("m", {"host": "a"}, {"v": 1.0}, timestamp=1)
+        ts.write_point("m", {"host": "b"}, {"v": 2.0}, timestamp=1)
+        assert ts.series_count("m") == 2
+        rows = ts.query("m", "v", tags={"host": "b"})
+        assert [r["value"] for r in rows] == [2.0]
+        assert ts.delete_series("m", tags={"host": "a"}) == 1
+        assert ts.series_count("m") == 1
+
+    def test_retention_trims(self):
+        ts = EmbeddedTimeSeries(retention_seconds=10)
+        ts.write_point("m", {}, {"v": 1.0}, timestamp=100)
+        ts.write_point("m", {}, {"v": 2.0}, timestamp=200)
+        rows = ts.query("m", "v")
+        assert [r["value"] for r in rows] == [2.0], "old point trimmed"
+
+    def test_unknown_aggregation_and_empty_fields(self):
+        ts = EmbeddedTimeSeries()
+        with pytest.raises(TimeSeriesError):
+            ts.write_point("m", {}, {})
+        ts.write_point("m", {}, {"v": 1.0}, timestamp=1)
+        with pytest.raises(TimeSeriesError):
+            ts.query("m", "v", aggregation="median", every=5)
+
+    def test_tpu_telemetry_dogfood(self):
+        """The framework's own HBM telemetry lands in the family."""
+
+        class FakeTPU:
+            def hbm_stats(self):
+                return {"devices": [
+                    {"device": "0", "kind": "v5e", "bytes_in_use": 7.0,
+                     "bytes_limit": 16.0, "peak_bytes_in_use": 9.0},
+                    {"device": "1", "kind": "v5e", "bytes_in_use": 3.0,
+                     "bytes_limit": 16.0, "peak_bytes_in_use": 4.0},
+                ]}
+
+        ts = EmbeddedTimeSeries()
+        rec = TPUTelemetryRecorder(FakeTPU(), ts)
+        assert rec.sample() == 2
+        rows = ts.query("tpu", "hbm_bytes_in_use", tags={"device": "0"})
+        assert [r["value"] for r in rows] == [7.0]
+        health = ts.health_check()
+        assert health["details"]["points_written"] == 2
+
+    def test_from_config(self):
+        ts = EmbeddedTimeSeries.from_config(
+            MapConfig({"TSDB_RETENTION_SECONDS": "60"}, use_env=False)
+        )
+        assert ts.retention_seconds == 60.0
+
+
+# ------------------------------------------------- document transactions
+class TestDocumentTransactions:
+    @pytest.fixture
+    def store(self):
+        s = EmbeddedDocumentStore()
+        s.insert_one("accounts", {"_id": "a", "balance": 100})
+        s.insert_one("accounts", {"_id": "b", "balance": 50})
+        return s
+
+    def test_commit_applies_atomically(self, store):
+        session = store.start_session()
+        with session.start_transaction():
+            session.update_by_id("accounts", "a", {"$inc": {"balance": -30}})
+            session.update_by_id("accounts", "b", {"$inc": {"balance": 30}})
+        assert store.find_one("accounts", {"_id": "a"})["balance"] == 70
+        assert store.find_one("accounts", {"_id": "b"})["balance"] == 80
+
+    def test_exception_rolls_back_everything(self, store):
+        session = store.start_session()
+        with pytest.raises(RuntimeError, match="boom"):
+            with session.start_transaction():
+                session.update_by_id("accounts", "a", {"$inc": {"balance": -30}})
+                session.insert_one("audit", {"event": "transfer"})
+                raise RuntimeError("boom")
+        assert store.find_one("accounts", {"_id": "a"})["balance"] == 100
+        assert store.count_documents("audit", {}) == 0
+
+    def test_deliberate_abort_is_silent(self, store):
+        session = store.start_session()
+        with session.start_transaction():
+            session.update_by_id("accounts", "a", {"$set": {"balance": 0}})
+            raise TransactionAborted()
+        assert store.find_one("accounts", {"_id": "a"})["balance"] == 100
+
+    def test_with_transaction_callback(self, store):
+        session = store.start_session()
+
+        def transfer(s):
+            s.update_by_id("accounts", "a", {"$inc": {"balance": -10}})
+            s.update_by_id("accounts", "b", {"$inc": {"balance": 10}})
+            return "ok"
+
+        assert session.with_transaction(transfer) == "ok"
+        assert store.find_one("accounts", {"_id": "b"})["balance"] == 60
+
+    def test_reads_inside_txn_see_own_writes(self, store):
+        session = store.start_session()
+        with session.start_transaction():
+            session.update_by_id("accounts", "a", {"$set": {"balance": 1}})
+            assert session.find_one("accounts", {"_id": "a"})["balance"] == 1
+
+    def test_nested_transaction_rejected(self, store):
+        session = store.start_session()
+        with session.start_transaction():
+            with pytest.raises(RuntimeError):
+                session.start_transaction()
+
+    def test_commit_without_begin_rejected(self, store):
+        session = store.start_session()
+        with pytest.raises(RuntimeError):
+            session.commit_transaction()
+
+
+# ------------------------------------------------- migration facade reach
+def test_migration_facade_reaches_new_families():
+    from gofr_tpu.migration import Migrate, run_migrations
+    from gofr_tpu.testutil import new_mock_container
+
+    container, _ = new_mock_container()
+    es = EmbeddedSearch()
+    ts = EmbeddedTimeSeries()
+    doc = EmbeddedDocumentStore()
+    container.register_datasource("search", es)
+    container.register_datasource("timeseries", ts)
+    container.register_datasource("document", doc)
+
+    def up(ds):
+        assert ds.search is es and ds.timeseries is ts and ds.document is doc
+        ds.search.create_index("migrated")
+        ds.timeseries.write_point("migrations", {}, {"applied": 1.0})
+        ds.document.insert_one("meta", {"migrated": True})
+
+    run_migrations({1: Migrate(up=up)}, container)
+    assert es.indices() == ["migrated"]
+    assert ts.measurements() == ["migrations"]
+    assert doc.count_documents("meta", {"migrated": True}) == 1
+
+
+def test_explicit_abort_mid_block_is_clean():
+    """abort_transaction() inside the with block must not make __exit__
+    trip over the already-ended transaction."""
+    store = EmbeddedDocumentStore()
+    store.insert_one("t", {"_id": "x", "n": 1})
+    session = store.start_session()
+    with session.start_transaction():
+        session.update_by_id("t", "x", {"$set": {"n": 2}})
+        session.abort_transaction()
+    assert store.find_one("t", {"_id": "x"})["n"] == 1
+    # and an explicit commit mid-block also exits cleanly
+    with session.start_transaction():
+        session.update_by_id("t", "x", {"$set": {"n": 3}})
+        session.commit_transaction()
+    assert store.find_one("t", {"_id": "x"})["n"] == 3

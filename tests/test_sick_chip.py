@@ -1,0 +1,210 @@
+"""Sick-chip circuit breaker (SURVEY §5.3, VERDICT r2 item 7).
+
+Injects executable failures into the TPU datasource and asserts the full
+recovery arc: typed 503s below the threshold, breaker trip → device
+excluded → mesh rebuilt over the healthy remainder → the tripping call
+RETRIES and succeeds (no process death, no lost request), health turns
+DEGRADED naming the chip, and the half-open cooldown probe restores the
+full mesh.
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.config import MapConfig
+from gofr_tpu.datasource.tpu import TPUClient
+from gofr_tpu.datasource.tpu.client import DeviceBreaker, TPUError, _shrink_spec
+from gofr_tpu.parallel.mesh import MeshSpec
+
+
+class _FlakyExecutable:
+    """Wraps the real compiled executable; fails the first N calls."""
+
+    def __init__(self, real, failures: int) -> None:
+        self.real = real
+        self.remaining = failures
+        self.calls = 0
+
+    def __call__(self, *args):
+        self.calls += 1
+        if self.remaining > 0:
+            self.remaining -= 1
+            raise RuntimeError("injected device failure (wedged chip)")
+        return self.real(*args)
+
+
+@pytest.fixture
+def tpu():
+    client = TPUClient(
+        mesh_spec="dp=8", breaker_threshold=3, breaker_cooldown_s=0.5
+    )
+    client.connect()
+    # the injected fault lives on device 0: per-device probing finds it
+    client._probe_device = lambda d: d.id != 0
+    return client
+
+
+def test_breaker_trips_and_call_recovers(tpu):
+    tpu.compile("inc", lambda x: x + 1, jnp.zeros((4,), jnp.float32))
+    tpu._executables["inc"] = _FlakyExecutable(tpu._executables["inc"], failures=10)
+
+    # below threshold: typed 503s, still full mesh
+    for _ in range(2):
+        with pytest.raises(TPUError) as err:
+            tpu.execute("inc", jnp.ones((4,), jnp.float32))
+        assert err.value.status_code == 503
+    assert tpu.device_count() == 8
+
+    # third failure trips the breaker: device excluded, mesh rebuilt,
+    # recompiled from the recipe, THIS call retried and succeeds
+    out = tpu.execute("inc", np.ones((4,), np.float32), block=True)
+    np.testing.assert_array_equal(np.asarray(out), [2, 2, 2, 2])
+    assert tpu.device_count() == 7  # dp=8 shrunk to dp=7 over survivors
+
+    health = tpu.health_check()
+    assert health["status"] == "DEGRADED"
+    assert health["details"]["excluded_devices"], "DEGRADED must name the chip"
+    assert health["details"]["devices_discovered"] == 8
+
+    # subsequent calls keep working on the shrunk mesh
+    out = tpu.execute("inc", np.zeros((4,), np.float32), block=True)
+    np.testing.assert_array_equal(np.asarray(out), [1, 1, 1, 1])
+
+
+def test_cooldown_probe_restores_full_mesh(tpu):
+    tpu.compile("inc", lambda x: x + 1, jnp.zeros((2,), jnp.float32))
+    tpu._executables["inc"] = _FlakyExecutable(tpu._executables["inc"], failures=3)
+    for _ in range(2):
+        with pytest.raises(TPUError):
+            tpu.execute("inc", jnp.ones((2,), jnp.float32))
+    out = tpu.execute("inc", np.ones((2,), np.float32), block=True)  # trips + recovers
+    np.testing.assert_array_equal(np.asarray(out), [2, 2])
+    assert tpu.health_check()["status"] == "DEGRADED"
+
+    time.sleep(0.6)  # > cooldown
+    out = tpu.execute("inc", np.ones((2,), np.float32), block=True)
+    np.testing.assert_array_equal(np.asarray(out), [2, 2])
+    assert tpu.device_count() == 8, "half-open probe must restore the full set"
+    assert tpu.health_check()["status"] == "UP"
+
+
+def test_mesh_bound_shardings_fail_loudly_on_failover():
+    """Concrete NamedShardings reference the dead mesh; failover must say
+    so instead of silently recompiling something wrong."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    client = TPUClient(mesh_spec="dp=8", breaker_threshold=1, breaker_cooldown_s=60)
+    client.connect()
+    client._probe_device = lambda d: d.id != 0
+    client.compile(
+        "sharded", lambda x: x * 2, jnp.zeros((8, 4), jnp.float32),
+        in_shardings=NamedSharding(client.mesh(), PartitionSpec("dp")),
+    )
+    client._executables["sharded"] = _FlakyExecutable(
+        client._executables["sharded"], failures=1
+    )
+    with pytest.raises(TPUError) as err:
+        client.execute("sharded", np.ones((8, 4), np.float32))
+    assert "recompile" in str(err.value)
+
+
+def test_callable_shardings_survive_failover():
+    """mesh -> shardings factories stay rebuildable across a shrink."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    client = TPUClient(mesh_spec="dp=8", breaker_threshold=1, breaker_cooldown_s=60)
+    client.connect()
+    client._probe_device = lambda d: d.id != 0
+    client.compile(
+        "sharded", lambda x: x * 2, jnp.zeros((56, 4), jnp.float32),
+        in_shardings=lambda mesh: NamedSharding(mesh, PartitionSpec("dp")),
+    )
+    client._executables["sharded"] = _FlakyExecutable(
+        client._executables["sharded"], failures=1
+    )
+    # batch 56 divides by both dp=8 and the shrunk dp=7
+    out = client.execute("sharded", np.ones((56, 4), np.float32), block=True)
+    np.testing.assert_array_equal(np.asarray(out), np.full((56, 4), 2.0))
+    assert client.device_count() == 7
+
+
+def test_all_devices_excluded_is_terminal():
+    client = TPUClient(mesh_spec="dp=-1", breaker_threshold=1, breaker_cooldown_s=60)
+    client.connect()
+    client._all_devices = client._all_devices[:1]
+    client._rebuild_mesh()
+    client._probe_device = lambda d: False  # every chip is sick
+    client.compile("inc", lambda x: x + 1, jnp.zeros((2,), jnp.float32))
+    client._executables["inc"] = _FlakyExecutable(client._executables["inc"], failures=99)
+    with pytest.raises(TPUError) as err:
+        client.execute("inc", jnp.ones((2,), jnp.float32))
+    assert "excluded" in str(err.value) or "failed" in str(err.value)
+
+
+def test_shrink_spec_policy():
+    # dp absorbs the loss when model axes fit
+    s = _shrink_spec(MeshSpec(dp=2, tp=4), 7)
+    assert (s.tp, s.dp) == (4, 1)
+    # model axes halve when they no longer fit
+    s = _shrink_spec(MeshSpec(tp=8), 7)
+    assert s.tp == 4 and s.dp == 1
+    # pure-dp mesh uses every survivor
+    s = _shrink_spec(MeshSpec(dp=8), 5)
+    assert s.dp == 5
+    # None spec
+    s = _shrink_spec(None, 3)
+    assert s.dp == 3
+
+
+def test_device_breaker_unit():
+    b = DeviceBreaker(threshold=2, cooldown_s=0.05)
+    assert b.record_failure("f") is False
+    assert b.record_failure("f") is True  # trips, count resets
+    assert b.record_failure("f") is False
+    b.record_success("g")  # unknown name: no-op
+    b.record_failure("g")
+    b.record_success("g")
+    assert b.record_failure("g") is False  # success reset the count
+    b.exclude([3])
+    assert 3 in b.excluded
+    assert not b.cooldown_elapsed()
+    time.sleep(0.06)
+    assert b.cooldown_elapsed()
+    b.reset()
+    assert not b.excluded
+
+
+def test_restore_keeps_mesh_bound_executables():
+    """The half-open restore rebuilds the SAME device set — compiled
+    executables (including mesh-bound ones) must survive it."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    client = TPUClient(mesh_spec="dp=8", breaker_threshold=1, breaker_cooldown_s=0.2)
+    client.connect()
+    client._probe_device = lambda d: d.id != 0
+    client.compile(
+        "bound", lambda x: x + 1, jnp.zeros((8,), jnp.float32),
+        in_shardings=NamedSharding(client.mesh(), PartitionSpec("dp")),
+    )
+    client.compile("plain", lambda x: x * 3, jnp.zeros((2,), jnp.float32))
+    # trip on the plain executable → shrink (mesh-bound "bound" is evicted)
+    client._executables["plain"] = _FlakyExecutable(client._executables["plain"], 1)
+    out = client.execute("plain", np.ones((2,), np.float32), block=True)
+    np.testing.assert_array_equal(np.asarray(out), [3, 3])
+    assert client.device_count() == 7
+
+    time.sleep(0.25)  # cooldown → next execute probes + restores full set
+    client._probe_device = lambda d: True  # chip recovered
+    client.execute("plain", np.ones((2,), np.float32), block=True)
+    assert client.device_count() == 8
+    # recompile "bound" on the restored mesh and confirm it sticks through
+    # a restore-rebuild (same device set → no eviction)
+    client.compile(
+        "bound", lambda x: x + 1, jnp.zeros((8,), jnp.float32),
+        in_shardings=NamedSharding(client.mesh(), PartitionSpec("dp")),
+    )
+    out = client.execute("bound", np.ones((8,), np.float32), block=True)
+    np.testing.assert_array_equal(np.asarray(out), np.full((8,), 2.0))
