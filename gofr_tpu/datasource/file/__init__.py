@@ -3,9 +3,12 @@
 The FileSystem/File contracts (interface.go:12-133) with a local
 implementation (local_fs.go), JSON/text RowReaders (row_reader.go), and an
 observability wrapper logging every operation (observability.go). Object
-stores (S3/GCS/FTP/SFTP in the reference's external modules) plug in behind
-the same contract; GCS is the weight-loading path in the TPU build
+stores (S3/GCS in the reference's external modules) plug in behind the
+same contract; GCS is the weight-loading path in the TPU build
 (SURVEY §5.4: checkpoint load = model weights through this abstraction).
+SFTP (sftp.py) rides the from-scratch SSH 2.0 transport
+(ssh_transport.py: curve25519 kex, ed25519 host keys, aes128-ctr +
+hmac-sha2-256).
 """
 
 from gofr_tpu.datasource.file.gcs import GCSProvider
@@ -14,6 +17,7 @@ from gofr_tpu.datasource.file.object_store import ObjectFileSystem, ObjectInfo
 from gofr_tpu.datasource.file.observability import ObservedFileSystem
 from gofr_tpu.datasource.file.row_reader import JSONRowReader, TextRowReader
 from gofr_tpu.datasource.file.s3 import S3Provider
+from gofr_tpu.datasource.file.sftp import SFTPFileSystem
 
 __all__ = [
     "LocalFileSystem",
@@ -24,4 +28,5 @@ __all__ = [
     "ObjectInfo",
     "GCSProvider",
     "S3Provider",
+    "SFTPFileSystem",
 ]

@@ -1,0 +1,515 @@
+"""SFTP file system — version 3 protocol over the SSH transport.
+
+Reference parity: pkg/gofr/datasource/file/sftp (github.com/pkg/sftp):
+the same FileSystem contract as local/S3/GCS (interface.go:12-133) —
+create/open/open_file/remove/rename/mkdir/remove_all/read_dir/stat/
+chdir/getwd — over draft-ietf-secsh-filexfer-02 packets
+(OPEN/CLOSE/READ/WRITE/OPENDIR/READDIR/REMOVE/MKDIR/RMDIR/RENAME/STAT/
+REALPATH) on an encrypted, authenticated SSH session
+(ssh_transport.py). Configure via ``SFTP_HOST``/``SFTP_PORT``/
+``SFTP_USER``/``SFTP_PASSWORD``.
+"""
+
+from __future__ import annotations
+
+import io
+import posixpath
+import socket
+import stat as stat_mod
+import struct
+import threading
+from typing import Any
+
+from gofr_tpu.datasource.file.local import FileInfo
+from gofr_tpu.datasource.file.ssh_transport import (
+    Reader,
+    SSHError,
+    SSHTransport,
+    sstr,
+    u32,
+)
+
+# packet types (filexfer-02)
+FXP_INIT = 1
+FXP_VERSION = 2
+FXP_OPEN = 3
+FXP_CLOSE = 4
+FXP_READ = 5
+FXP_WRITE = 6
+FXP_LSTAT = 7
+FXP_FSTAT = 8
+FXP_SETSTAT = 9
+FXP_OPENDIR = 11
+FXP_READDIR = 12
+FXP_REMOVE = 13
+FXP_MKDIR = 14
+FXP_RMDIR = 15
+FXP_REALPATH = 16
+FXP_STAT = 17
+FXP_RENAME = 18
+FXP_STATUS = 101
+FXP_HANDLE = 102
+FXP_DATA = 103
+FXP_NAME = 104
+FXP_ATTRS = 105
+
+# status codes
+FX_OK = 0
+FX_EOF = 1
+FX_NO_SUCH_FILE = 2
+FX_PERMISSION_DENIED = 3
+FX_FAILURE = 4
+FX_OP_UNSUPPORTED = 8
+
+# pflags
+FXF_READ = 0x01
+FXF_WRITE = 0x02
+FXF_APPEND = 0x04
+FXF_CREAT = 0x08
+FXF_TRUNC = 0x10
+FXF_EXCL = 0x20
+
+# attr flags
+ATTR_SIZE = 0x01
+ATTR_UIDGID = 0x02
+ATTR_PERMISSIONS = 0x04
+ATTR_ACMODTIME = 0x08
+
+_MODE_PFLAGS = {
+    "r": FXF_READ, "rb": FXF_READ,
+    "w": FXF_WRITE | FXF_CREAT | FXF_TRUNC, "wb": FXF_WRITE | FXF_CREAT | FXF_TRUNC,
+    "a": FXF_WRITE | FXF_CREAT | FXF_APPEND, "ab": FXF_WRITE | FXF_CREAT | FXF_APPEND,
+    "r+": FXF_READ | FXF_WRITE, "rb+": FXF_READ | FXF_WRITE, "r+b": FXF_READ | FXF_WRITE,
+    "w+": FXF_READ | FXF_WRITE | FXF_CREAT | FXF_TRUNC,
+    "w+b": FXF_READ | FXF_WRITE | FXF_CREAT | FXF_TRUNC,
+    "wb+": FXF_READ | FXF_WRITE | FXF_CREAT | FXF_TRUNC,
+}
+
+
+class SFTPError(OSError):
+    def __init__(self, code: int, message: str) -> None:
+        self.code = code
+        super().__init__(f"sftp error {code}: {message}")
+
+
+def encode_attrs(size: int | None = None, perms: int | None = None,
+                 mtime: float | None = None) -> bytes:
+    flags, body = 0, b""
+    if size is not None:
+        flags |= ATTR_SIZE
+        body += struct.pack(">Q", size)
+    if perms is not None:
+        flags |= ATTR_PERMISSIONS
+        body += u32(perms)
+    if mtime is not None:
+        flags |= ATTR_ACMODTIME
+        body += u32(int(mtime)) + u32(int(mtime))
+    return u32(flags) + body
+
+
+def decode_attrs(r: Reader) -> dict[str, Any]:
+    flags = r.uint32()
+    out: dict[str, Any] = {}
+    if flags & ATTR_SIZE:
+        out["size"] = r.uint64()
+    if flags & ATTR_UIDGID:
+        out["uid"], out["gid"] = r.uint32(), r.uint32()
+    if flags & ATTR_PERMISSIONS:
+        out["permissions"] = r.uint32()
+    if flags & ATTR_ACMODTIME:
+        out["atime"], out["mtime"] = r.uint32(), r.uint32()
+    return out
+
+
+class _PacketStream:
+    """SFTP length-prefixed packets over the channel byte stream (channel
+    frames do not align with SFTP packets)."""
+
+    def __init__(self, transport: SSHTransport) -> None:
+        self.t = transport
+        self._buf = b""
+
+    def _fill(self, n: int) -> None:
+        while len(self._buf) < n:
+            self._buf += self.t.recv_channel_data()
+
+    def read_packet(self) -> tuple[int, Reader]:
+        self._fill(4)
+        (length,) = struct.unpack(">I", self._buf[:4])
+        if length < 1 or length > 1 << 26:
+            raise SSHError(f"bad sftp packet length {length}")
+        self._fill(4 + length)
+        packet, self._buf = self._buf[4 : 4 + length], self._buf[4 + length :]
+        return packet[0], Reader(packet[1:])
+
+    def write_packet(self, ptype: int, payload: bytes) -> None:
+        self.t.send_channel_data(u32(len(payload) + 1) + bytes([ptype]) + payload)
+
+
+class SFTPClient:
+    """Protocol client: one request in flight (lock-serialized), request
+    ids checked on every response."""
+
+    def __init__(self, transport: SSHTransport) -> None:
+        self.stream = _PacketStream(transport)
+        self._id = 0
+        self._lock = threading.Lock()
+        self.stream.write_packet(FXP_INIT, u32(3))
+        ptype, r = self.stream.read_packet()
+        if ptype != FXP_VERSION:
+            raise SSHError("expected FXP_VERSION")
+        self.server_version = r.uint32()
+
+    def _call(self, ptype: int, payload: bytes) -> tuple[int, Reader]:
+        with self._lock:
+            self._id += 1
+            rid = self._id
+            self.stream.write_packet(ptype, u32(rid) + payload)
+            rtype, r = self.stream.read_packet()
+            got = r.uint32()
+            if got != rid:
+                raise SSHError(f"sftp request id mismatch {got} != {rid}")
+            return rtype, r
+
+    def _expect_status_ok(self, ptype: int, payload: bytes) -> None:
+        rtype, r = self._call(ptype, payload)
+        if rtype != FXP_STATUS:
+            raise SSHError(f"expected FXP_STATUS, got {rtype}")
+        code = r.uint32()
+        if code != FX_OK:
+            raise SFTPError(code, r.string().decode() if r.remaining() else "")
+
+    def _status_or(self, rtype: int, r: Reader, want: int) -> Reader:
+        if rtype == want:
+            return r
+        if rtype == FXP_STATUS:
+            code = r.uint32()
+            raise SFTPError(code, r.string().decode() if r.remaining() else "")
+        raise SSHError(f"unexpected sftp response {rtype}")
+
+    # -- operations --------------------------------------------------------
+    def open(self, path: str, pflags: int, attrs: bytes = b"") -> bytes:
+        rtype, r = self._call(
+            FXP_OPEN, sstr(path.encode()) + u32(pflags) + (attrs or encode_attrs())
+        )
+        return self._status_or(rtype, r, FXP_HANDLE).string()
+
+    def close(self, handle: bytes) -> None:
+        self._expect_status_ok(FXP_CLOSE, sstr(handle))
+
+    def read(self, handle: bytes, offset: int, length: int) -> bytes:
+        rtype, r = self._call(
+            FXP_READ, sstr(handle) + struct.pack(">Q", offset) + u32(length)
+        )
+        if rtype == FXP_STATUS:
+            code = r.uint32()
+            if code == FX_EOF:
+                return b""
+            raise SFTPError(code, r.string().decode() if r.remaining() else "")
+        return self._status_or(rtype, r, FXP_DATA).string()
+
+    def write(self, handle: bytes, offset: int, data: bytes) -> None:
+        self._expect_status_ok(
+            FXP_WRITE, sstr(handle) + struct.pack(">Q", offset) + sstr(data)
+        )
+
+    def stat(self, path: str) -> dict[str, Any]:
+        rtype, r = self._call(FXP_STAT, sstr(path.encode()))
+        return decode_attrs(self._status_or(rtype, r, FXP_ATTRS))
+
+    def lstat(self, path: str) -> dict[str, Any]:
+        """Like stat but does NOT follow symlinks (recursive deletion must
+        see the link, not its target)."""
+        rtype, r = self._call(FXP_LSTAT, sstr(path.encode()))
+        return decode_attrs(self._status_or(rtype, r, FXP_ATTRS))
+
+    def realpath(self, path: str) -> str:
+        rtype, r = self._call(FXP_REALPATH, sstr(path.encode()))
+        rr = self._status_or(rtype, r, FXP_NAME)
+        rr.uint32()  # count (always 1)
+        return rr.string().decode()
+
+    def listdir(self, path: str) -> list[tuple[str, dict[str, Any]]]:
+        handle = self.open_dir(path)
+        out: list[tuple[str, dict[str, Any]]] = []
+        try:
+            while True:
+                rtype, r = self._call(FXP_READDIR, sstr(handle))
+                if rtype == FXP_STATUS:
+                    code = r.uint32()
+                    if code == FX_EOF:
+                        break
+                    raise SFTPError(code, r.string().decode() if r.remaining() else "")
+                rr = self._status_or(rtype, r, FXP_NAME)
+                for _ in range(rr.uint32()):
+                    name = rr.string().decode()
+                    rr.string()  # longname
+                    attrs = decode_attrs(rr)
+                    if name not in (".", ".."):
+                        out.append((name, attrs))
+        finally:
+            self.close(handle)
+        return out
+
+    def open_dir(self, path: str) -> bytes:
+        rtype, r = self._call(FXP_OPENDIR, sstr(path.encode()))
+        return self._status_or(rtype, r, FXP_HANDLE).string()
+
+    def remove(self, path: str) -> None:
+        self._expect_status_ok(FXP_REMOVE, sstr(path.encode()))
+
+    def mkdir(self, path: str) -> None:
+        self._expect_status_ok(FXP_MKDIR, sstr(path.encode()) + encode_attrs())
+
+    def rmdir(self, path: str) -> None:
+        self._expect_status_ok(FXP_RMDIR, sstr(path.encode()))
+
+    def rename(self, old: str, new: str) -> None:
+        self._expect_status_ok(FXP_RENAME, sstr(old.encode()) + sstr(new.encode()))
+
+
+class SFTPFile(io.RawIOBase):
+    """File-like over an SFTP handle (offset tracked client-side)."""
+
+    def __init__(self, client: SFTPClient, handle: bytes, mode: str,
+                 append: bool = False, size: int = 0) -> None:
+        super().__init__()
+        self._client = client
+        self._handle = handle
+        self._mode = mode
+        self._pos = size if append else 0
+        self._closed = False
+
+    def readable(self) -> bool:
+        return "r" in self._mode or "+" in self._mode
+
+    def writable(self) -> bool:
+        return any(c in self._mode for c in "wa+")
+
+    def read(self, n: int = -1) -> bytes:
+        chunks = []
+        remaining = n if n >= 0 else None
+        while remaining is None or remaining > 0:
+            ask = min(remaining or 32768, 32768)
+            data = self._client.read(self._handle, self._pos, ask)
+            if not data:
+                break
+            self._pos += len(data)
+            chunks.append(data)
+            if remaining is not None:
+                remaining -= len(data)
+        return b"".join(chunks)
+
+    def write(self, data: bytes) -> int:
+        if isinstance(data, str):
+            data = data.encode()
+        view = memoryview(bytes(data))
+        while view:
+            chunk = bytes(view[:32768])
+            self._client.write(self._handle, self._pos, chunk)
+            self._pos += len(chunk)
+            view = view[len(chunk):]
+        return len(data)
+
+    def seek(self, offset: int, whence: int = 0) -> int:
+        if whence == 0:
+            self._pos = offset
+        elif whence == 1:
+            self._pos += offset
+        else:
+            raise OSError("SEEK_END unsupported on sftp files")
+        return self._pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._client.close(self._handle)
+        super().close()
+
+
+class SFTPFileSystem:
+    """The FileSystem-contract driver (provider pattern + health), like
+    local/S3/GCS (datasource/file/)."""
+
+    def __init__(self, host: str = "localhost", port: int = 2222,
+                 user: str = "gofr", password: str = "",
+                 connect_timeout: float = 5.0) -> None:
+        self.host, self.port = host, port
+        self.user, self.password = user, password
+        self.connect_timeout = connect_timeout
+        self._transport: SSHTransport | None = None
+        self._client: SFTPClient | None = None
+        self._cwd = "/"
+        self._logger: Any = None
+        self._metrics: Any = None
+
+    @classmethod
+    def from_config(cls, config: Any) -> "SFTPFileSystem":
+        return cls(
+            host=config.get_or_default("SFTP_HOST", "localhost"),
+            port=int(config.get_or_default("SFTP_PORT", "22")),
+            user=config.get_or_default("SFTP_USER", "gofr"),
+            password=config.get_or_default("SFTP_PASSWORD", ""),
+        )
+
+    # -- provider pattern --------------------------------------------------
+    def use_logger(self, logger: Any) -> None:
+        self._logger = logger
+
+    def use_metrics(self, metrics: Any) -> None:
+        self._metrics = metrics
+
+    def use_tracer(self, tracer: Any) -> None:
+        pass
+
+    def connect(self) -> None:
+        sock = socket.create_connection(
+            (self.host, self.port), timeout=self.connect_timeout
+        )
+        transport = SSHTransport(sock)
+        transport.handshake()
+        transport.auth_password(self.user, self.password)
+        transport.open_sftp_channel()
+        self._transport = transport
+        self._client = SFTPClient(transport)
+        self._cwd = self._client.realpath(".")
+        if self._logger:
+            self._logger.debug(
+                f"sftp connected to {self.user}@{self.host}:{self.port} "
+                f"(server sftp v{self._client.server_version})"
+            )
+
+    def _c(self) -> SFTPClient:
+        if self._client is None:
+            raise SSHError("sftp file system not connected")
+        return self._client
+
+    def _abs(self, name: str) -> str:
+        return name if name.startswith("/") else posixpath.join(self._cwd, name)
+
+    # -- FileSystem contract ----------------------------------------------
+    def create(self, name: str) -> SFTPFile:
+        return self.open_file(name, "w+b")
+
+    def open(self, name: str) -> SFTPFile:
+        return self.open_file(name, "rb")
+
+    def open_file(self, name: str, mode: str = "r"):
+        pflags = _MODE_PFLAGS.get(mode)
+        if pflags is None:
+            raise ValueError(f"unsupported mode {mode!r}")
+        path = self._abs(name)
+        size = 0
+        if pflags & FXF_APPEND:
+            try:
+                size = self._c().stat(path).get("size", 0)
+            except SFTPError:
+                size = 0
+        handle = self._c().open(path, pflags)
+        f = SFTPFile(self._c(), handle, mode, append=bool(pflags & FXF_APPEND),
+                     size=size)
+        if "b" not in mode:
+            # text-mode contract parity with LocalFileSystem (local.py:51):
+            # 'r'/'w'/'a' must yield str, not bytes
+            return io.TextIOWrapper(io.BufferedRWPair(f, f) if f.writable() and f.readable()
+                                    else (io.BufferedReader(f) if f.readable()
+                                          else io.BufferedWriter(f)),
+                                    encoding="utf-8")
+        return f
+
+    def remove(self, name: str) -> None:
+        self._c().remove(self._abs(name))
+
+    def remove_all(self, name: str) -> None:
+        path = self._abs(name)
+        try:
+            # lstat: a symlinked directory must be unlinked, never recursed
+            # into (deleting the target's contents) — and symlink cycles
+            # must not loop forever
+            attrs = self._c().lstat(path)
+        except SFTPError:
+            return
+        if stat_mod.S_ISDIR(attrs.get("permissions", 0)):
+            for entry, eattrs in self._c().listdir(path):
+                self.remove_all(posixpath.join(path, entry))
+            self._c().rmdir(path)
+        else:
+            self._c().remove(path)
+
+    def rename(self, old: str, new: str) -> None:
+        self._c().rename(self._abs(old), self._abs(new))
+
+    def mkdir(self, name: str, parents: bool = True) -> None:
+        path = self._abs(name)
+        if not parents:
+            self._c().mkdir(path)
+            return
+        parts = path.strip("/").split("/")
+        cur = ""
+        for p in parts:
+            cur += "/" + p
+            try:
+                self._c().mkdir(cur)
+            except SFTPError as exc:
+                if exc.code not in (FX_FAILURE, FX_PERMISSION_DENIED):
+                    raise
+                # exists already — FX_FAILURE per filexfer-02
+
+    def read_dir(self, name: str = ".") -> list[FileInfo]:
+        out = []
+        for entry, attrs in self._c().listdir(self._abs(name)):
+            out.append(FileInfo(
+                entry,
+                attrs.get("size", 0),
+                stat_mod.S_ISDIR(attrs.get("permissions", 0)),
+                float(attrs.get("mtime", 0)),
+            ))
+        return sorted(out, key=lambda f: f.name)
+
+    def stat(self, name: str) -> FileInfo:
+        path = self._abs(name)
+        attrs = self._c().stat(path)
+        return FileInfo(
+            posixpath.basename(path),
+            attrs.get("size", 0),
+            stat_mod.S_ISDIR(attrs.get("permissions", 0)),
+            float(attrs.get("mtime", 0)),
+        )
+
+    def chdir(self, name: str) -> None:
+        path = self._c().realpath(self._abs(name))
+        attrs = self._c().stat(path)
+        if not stat_mod.S_ISDIR(attrs.get("permissions", 0)):
+            raise NotADirectoryError(path)
+        self._cwd = path
+
+    def getwd(self) -> str:
+        return self._cwd
+
+    # -- lifecycle / health ------------------------------------------------
+    def health_check(self) -> dict[str, Any]:
+        try:
+            self._c().realpath(".")
+            return {
+                "status": "UP",
+                "details": {
+                    "backend": "sftp",
+                    "host": f"{self.user}@{self.host}:{self.port}",
+                    "cwd": self._cwd,
+                },
+            }
+        except Exception as exc:
+            return {
+                "status": "DOWN",
+                "details": {"backend": "sftp", "host": f"{self.host}:{self.port}",
+                            "error": str(exc)},
+            }
+
+    def close(self) -> None:
+        if self._transport is not None:
+            self._transport.close()
+            self._transport = None
+            self._client = None

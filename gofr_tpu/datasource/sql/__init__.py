@@ -8,6 +8,7 @@ dataclasses (db.go:214-334), transactions (db.go:124-185), health
 """
 
 from gofr_tpu.datasource.sql.sqlite import SQLite, new_sql
+from gofr_tpu.datasource.sql.postgres import PostgresDB
 from gofr_tpu.datasource.sql.query_builder import (
     delete_by_id_query,
     insert_query,
@@ -18,6 +19,7 @@ from gofr_tpu.datasource.sql.query_builder import (
 
 __all__ = [
     "SQLite",
+    "PostgresDB",
     "new_sql",
     "insert_query",
     "select_all_query",

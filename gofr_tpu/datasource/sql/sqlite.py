@@ -34,6 +34,50 @@ class SQLLog:
         return f"SQL {self.duration}µs {self.query}"
 
 
+def observe_query(logger: Any, metrics: Any, dialect: str, host: str,
+                  query: str, start: float) -> None:
+    """Per-query structured log + app_sql_stats histogram (db.go:47-66),
+    shared by every SQL dialect."""
+    duration_us = int((time.perf_counter() - start) * 1e6)
+    if logger:
+        logger.debug(SQLLog(query, duration_us))
+    if metrics:
+        metrics.record_histogram(
+            "app_sql_stats", duration_us / 1000.0, hostname=host, database=dialect,
+        )
+
+
+def sql_span(tracer: Any, op: str):
+    if tracer is not None:
+        return tracer.start_span(f"sql {op}", kind="client")
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+def bind_rows(rows: list[dict[str, Any]], target: Any) -> Any:
+    """db.go:214-334 — bind row dicts into a list of dataclasses (or pass
+    them through for dict targets). Shared by every SQL dialect."""
+    if target is None or target is dict:
+        return rows
+    if isinstance(target, type) and dataclasses.is_dataclass(target):
+        hints = typing.get_type_hints(target)
+        names = {f.name for f in dataclasses.fields(target)}
+        out = []
+        for row in rows:
+            kwargs = {}
+            for col, val in row.items():
+                key = col if col in names else col.lower()
+                if key in names:
+                    hint = hints.get(key)
+                    if hint in (int, float, str, bool) and val is not None:
+                        val = hint(val)
+                    kwargs[key] = val
+            out.append(target(**kwargs))
+        return out
+    raise TypeError("select target must be dict or a dataclass type")
+
+
 class Tx:
     def __init__(self, db: "SQLite") -> None:
         self._db = db
@@ -93,20 +137,11 @@ class SQLite:
 
     # -- DB contract -----------------------------------------------------------
     def _observe(self, query: str, start: float) -> None:
-        duration_us = int((time.perf_counter() - start) * 1e6)
-        if self._logger:
-            self._logger.debug(SQLLog(query, duration_us))
-        if self._metrics:
-            self._metrics.record_histogram(
-                "app_sql_stats", duration_us / 1000.0, hostname=self.database, database=self.dialect,
-            )
+        observe_query(self._logger, self._metrics, self.dialect, self.database,
+                      query, start)
 
     def _span(self, op: str):
-        if self._tracer is not None:
-            return self._tracer.start_span(f"sql {op}", kind="client")
-        import contextlib
-
-        return contextlib.nullcontext()
+        return sql_span(self._tracer, op)
 
     def _rows(self, cursor: sqlite3.Cursor) -> list[dict[str, Any]]:
         return [dict(row) for row in cursor.fetchall()]
@@ -133,25 +168,7 @@ class SQLite:
 
     def select(self, target: Any, sql: str, *args: Any) -> Any:
         """db.go:214-334 — bind rows into a list of dataclasses/dicts."""
-        rows = self.query(sql, *args)
-        if target is None or target is dict:
-            return rows
-        if isinstance(target, type) and dataclasses.is_dataclass(target):
-            hints = typing.get_type_hints(target)
-            names = {f.name for f in dataclasses.fields(target)}
-            out = []
-            for row in rows:
-                kwargs = {}
-                for col, val in row.items():
-                    key = col if col in names else col.lower()
-                    if key in names:
-                        hint = hints.get(key)
-                        if hint in (int, float, str, bool) and val is not None:
-                            val = hint(val)
-                        kwargs[key] = val
-                out.append(target(**kwargs))
-            return out
-        raise TypeError("select target must be dict or a dataclass type")
+        return bind_rows(self.query(sql, *args), target)
 
     def begin(self) -> Tx:
         self._lock.acquire()
@@ -174,13 +191,19 @@ class SQLite:
             return {"status": "DOWN", "details": {"database": self.database, "error": str(exc)}}
 
 
-def new_sql(config: Any) -> SQLite:
-    """Dialect dispatch (sql.go:212-237). Only sqlite is in-image; other
-    dialects raise with a clear message so apps fail fast."""
+def new_sql(config: Any) -> Any:
+    """Dialect dispatch (sql.go:212-237): sqlite (embedded) and postgres
+    (own v3 wire client, sql/postgres.py) ship in-tree; other dialects
+    raise with a clear message so apps fail fast."""
     dialect = config.get_or_default("DB_DIALECT", "sqlite").lower()
-    if dialect != "sqlite":
-        raise ValueError(
-            f"DB_DIALECT={dialect} requires an external driver module; "
-            "in-tree support is sqlite"
-        )
-    return SQLite.from_config(config)
+    if dialect == "sqlite":
+        return SQLite.from_config(config)
+    if dialect in ("postgres", "postgresql", "supabase", "cockroachdb"):
+        # supabase/cockroach speak the postgres wire protocol (sql.go:223-234)
+        from gofr_tpu.datasource.sql.postgres import PostgresDB
+
+        return PostgresDB.from_config(config)
+    raise ValueError(
+        f"DB_DIALECT={dialect} requires an external driver module; "
+        "in-tree dialects: sqlite, postgres"
+    )

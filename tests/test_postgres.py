@@ -1,0 +1,143 @@
+"""Postgres dialect (VERDICT r2 item 10): the v3 wire client against the
+sqlite-backed mini server — md5 auth, extended-protocol parameterized
+queries, transactions, dialect dispatch, typed errors, health.
+"""
+
+import dataclasses
+
+import pytest
+
+from gofr_tpu.config import MapConfig
+from gofr_tpu.datasource.sql import PostgresDB, new_sql
+from gofr_tpu.datasource.sql.pg_wire import PgError, md5_password
+from gofr_tpu.datasource.sql.postgres import rewrite_placeholders
+from gofr_tpu.testutil.postgres_server import MiniPostgresServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = MiniPostgresServer(user="gofr", password="secret")
+    yield s
+    s.close()
+
+
+@pytest.fixture
+def db(server):
+    d = PostgresDB(host="127.0.0.1", port=server.port, user="gofr",
+                   password="secret", database="gofrdb")
+    d.connect()
+    yield d
+    d.close()
+
+
+def test_md5_auth_and_handshake(db):
+    # the session negotiated params like a real backend
+    assert "server_version" in db._server_params
+
+
+def test_wrong_password_rejected(server):
+    bad = PostgresDB(host="127.0.0.1", port=server.port, user="gofr",
+                     password="wrong")
+    with pytest.raises(PgError) as err:
+        bad.connect()
+    assert err.value.code == "28P01"
+
+
+def test_md5_digest_formula():
+    # known-answer: md5("md5(pw+user)" + salt)
+    assert md5_password("u", "p", b"salt").startswith("md5")
+    assert md5_password("u", "p", b"salt") != md5_password("u", "p", b"tlas")
+
+
+def test_crud_roundtrip_with_placeholders(db):
+    db.exec("CREATE TABLE IF NOT EXISTS users (id INTEGER PRIMARY KEY, name TEXT, age INTEGER)")
+    db.exec("DELETE FROM users")
+    tag = db.exec("INSERT INTO users (id, name, age) VALUES (?, ?, ?)", 1, "ada", 36)
+    assert tag.startswith("INSERT")
+    db.exec("INSERT INTO users (id, name, age) VALUES (?, ?, ?)", 2, "alan", 41)
+    rows = db.query("SELECT id, name, age FROM users WHERE age > ? ORDER BY id", 30)
+    assert [(r["id"], r["name"]) for r in rows] == [(1, "ada"), (2, "alan")]
+    row = db.query_row("SELECT name FROM users WHERE id = ?", 2)
+    assert row == {"name": "alan"}
+    assert db.query_row("SELECT name FROM users WHERE id = ?", 99) is None
+
+
+def test_select_into_dataclass(db):
+    @dataclasses.dataclass
+    class User:
+        id: int
+        name: str
+        age: int
+
+    db.exec("CREATE TABLE IF NOT EXISTS users (id INTEGER PRIMARY KEY, name TEXT, age INTEGER)")
+    db.exec("DELETE FROM users")
+    db.exec("INSERT INTO users (id, name, age) VALUES (?, ?, ?)", 7, "grace", 50)
+    users = db.select(User, "SELECT id, name, age FROM users")
+    assert users == [User(id=7, name="grace", age=50)]
+
+
+def test_transaction_commit_and_rollback(db):
+    db.exec("CREATE TABLE IF NOT EXISTS acct (id TEXT PRIMARY KEY, bal INTEGER)")
+    db.exec("DELETE FROM acct")
+    db.exec("INSERT INTO acct VALUES (?, ?)", "a", 100)
+
+    tx = db.begin()
+    tx.exec("UPDATE acct SET bal = bal - ? WHERE id = ?", 40, "a")
+    assert tx.query_row("SELECT bal FROM acct WHERE id = ?", "a")["bal"] == 60
+    tx.commit()
+    assert db.query_row("SELECT bal FROM acct WHERE id = ?", "a")["bal"] == 60
+
+    tx = db.begin()
+    tx.exec("UPDATE acct SET bal = 0 WHERE id = ?", "a")
+    tx.rollback()
+    assert db.query_row("SELECT bal FROM acct WHERE id = ?", "a")["bal"] == 60
+
+
+def test_sql_error_is_typed_and_session_survives(db):
+    with pytest.raises(PgError) as err:
+        db.query("SELECT * FROM no_such_table")
+    assert err.value.code  # SQLSTATE-ish populated
+    # session still usable afterwards
+    assert db.query("SELECT 1 AS one")[0]["one"] == 1
+
+
+def test_health_and_dialect_dispatch(server, db):
+    health = db.health_check()
+    assert health["status"] == "UP"
+    assert health["details"]["dialect"] == "postgres"
+    assert "gofr-mini" in health["details"]["server"]
+
+    built = new_sql(MapConfig({
+        "DB_DIALECT": "postgres", "DB_HOST": "127.0.0.1",
+        "DB_PORT": str(server.port), "DB_USER": "gofr",
+        "DB_PASSWORD": "secret", "DB_NAME": "gofrdb",
+    }, use_env=False))
+    assert isinstance(built, PostgresDB)
+    built.connect()
+    built.close()
+
+    down = PostgresDB(host="127.0.0.1", port=1, connect_timeout=0.3)
+    assert down.health_check()["status"] == "DOWN"
+
+
+def test_rewrite_placeholders():
+    assert rewrite_placeholders("SELECT ?") == "SELECT $1"
+    assert rewrite_placeholders("a = ? AND b = ?") == "a = $1 AND b = $2"
+    # literals keep their question marks
+    assert rewrite_placeholders("SELECT '?' , ?") == "SELECT '?' , $1"
+    assert rewrite_placeholders("no params") == "no params"
+
+
+def test_shared_database_across_connections(server, db):
+    """Two driver connections see one server-side database, like a real
+    postgres — not per-connection sqlite silos."""
+    db.exec("CREATE TABLE IF NOT EXISTS shared (v INTEGER)")
+    db.exec("DELETE FROM shared")
+    db.exec("INSERT INTO shared VALUES (?)", 42)
+    other = PostgresDB(host="127.0.0.1", port=server.port, user="gofr",
+                       password="secret")
+    other.connect()
+    try:
+        assert other.query("SELECT v FROM shared")[0]["v"] == 42
+    finally:
+        other.close()

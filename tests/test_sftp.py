@@ -1,0 +1,174 @@
+"""SFTP file system over the real SSH transport (VERDICT r2 item 10).
+
+The client derives its session keys independently from the server via
+the curve25519 exchange, verifies the ed25519 host signature, speaks
+aes128-ctr + hmac-sha2-256 packets, authenticates by password, and runs
+SFTP v3 — against the in-process server rooted in a temp dir. Includes a
+multi-megabyte transfer to force CHANNEL_WINDOW_ADJUST flow control.
+"""
+
+import os
+
+import pytest
+
+from gofr_tpu.datasource.file.sftp import SFTPError, SFTPFileSystem
+from gofr_tpu.datasource.file.ssh_transport import SSHAuthError
+from gofr_tpu.testutil.sftp_server import MiniSFTPServer
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("sftp-root")
+    s = MiniSFTPServer(str(root), user="gofr", password="secret")
+    yield s
+    s.close()
+
+
+@pytest.fixture
+def fs(server):
+    f = SFTPFileSystem(host="127.0.0.1", port=server.port, user="gofr",
+                       password="secret")
+    f.connect()
+    yield f
+    f.close()
+
+
+def test_handshake_and_auth(fs):
+    assert fs.getwd() == "/"
+    assert fs.health_check()["status"] == "UP"
+
+
+def test_wrong_password_rejected(server):
+    bad = SFTPFileSystem(host="127.0.0.1", port=server.port, user="gofr",
+                         password="nope")
+    with pytest.raises(SSHAuthError):
+        bad.connect()
+
+
+def test_file_roundtrip(fs, server):
+    with fs.create("hello.txt") as f:
+        f.write(b"hello over ssh")
+    with fs.open("hello.txt") as f:
+        assert f.read() == b"hello over ssh"
+    # the bytes really landed in the server's root on disk
+    with open(os.path.join(server.root, "hello.txt"), "rb") as disk:
+        assert disk.read() == b"hello over ssh"
+    info = fs.stat("hello.txt")
+    assert info.size == 14 and not info.is_dir
+
+
+def test_append_mode(fs):
+    with fs.open_file("log.txt", "wb") as f:
+        f.write(b"line1\n")
+    with fs.open_file("log.txt", "ab") as f:
+        f.write(b"line2\n")
+    with fs.open("log.txt") as f:
+        assert f.read() == b"line1\nline2\n"
+
+
+def test_dirs_rename_remove(fs):
+    fs.mkdir("a/b/c")  # parents
+    fs.stat("a/b/c")
+    with fs.create("a/b/c/f.bin") as f:
+        f.write(b"x" * 100)
+    entries = fs.read_dir("a/b")
+    assert [e.name for e in entries] == ["c"]
+    assert entries[0].is_dir
+
+    fs.rename("a/b/c/f.bin", "a/b/c/g.bin")
+    assert fs.stat("a/b/c/g.bin").size == 100
+    with pytest.raises(SFTPError):
+        fs.stat("a/b/c/f.bin")
+
+    fs.remove_all("a")  # recursive
+    with pytest.raises(SFTPError):
+        fs.stat("a")
+
+
+def test_chdir_and_relative_paths(fs):
+    fs.mkdir("workdir")
+    fs.chdir("workdir")
+    assert fs.getwd() == "/workdir"
+    with fs.create("rel.txt") as f:
+        f.write(b"relative")
+    assert fs.stat("/workdir/rel.txt").size == 8
+    fs.chdir("/")
+    fs.remove_all("workdir")
+
+
+def test_path_escape_contained(fs, server):
+    """chroot containment: ../ cannot leave the server root."""
+    secret = os.path.join(os.path.dirname(server.root), "outside.txt")
+    with open(secret, "w") as f:
+        f.write("secret")
+    try:
+        # normalization pins the path inside the root → no such file there
+        with pytest.raises(SFTPError):
+            fs.open("../outside.txt").read()
+    finally:
+        os.remove(secret)
+
+
+def test_large_transfer_exercises_flow_control(fs):
+    """> window/2 bytes each way forces CHANNEL_WINDOW_ADJUST."""
+    blob = os.urandom(3 * 1024 * 1024)
+    with fs.create("big.bin") as f:
+        f.write(blob)
+    with fs.open("big.bin") as f:
+        assert f.read() == blob
+    fs.remove("big.bin")
+
+
+def test_seek_and_partial_read(fs):
+    with fs.create("seek.bin") as f:
+        f.write(b"0123456789")
+    with fs.open("seek.bin") as f:
+        f.seek(4)
+        assert f.read(3) == b"456"
+        assert f.tell() == 7
+    fs.remove("seek.bin")
+
+
+def test_from_config():
+    from gofr_tpu.config import MapConfig
+
+    f = SFTPFileSystem.from_config(MapConfig({
+        "SFTP_HOST": "h", "SFTP_PORT": "2022", "SFTP_USER": "u",
+        "SFTP_PASSWORD": "p",
+    }, use_env=False))
+    assert (f.host, f.port, f.user, f.password) == ("h", 2022, "u", "p")
+
+
+def test_health_down_when_disconnected():
+    f = SFTPFileSystem(host="127.0.0.1", port=1, connect_timeout=0.3)
+    assert f.health_check()["status"] == "DOWN"
+
+
+def test_text_mode_returns_str(fs):
+    with fs.open_file("text.txt", "w") as f:
+        f.write("line1\nline2\n")
+    with fs.open_file("text.txt", "r") as f:
+        content = f.read()
+    assert isinstance(content, str) and content.splitlines() == ["line1", "line2"]
+    fs.remove("text.txt")
+
+
+def test_remove_all_unlinks_symlink_without_recursing(fs, server):
+    """A symlinked directory inside the tree is unlinked, not descended —
+    its target's contents must survive."""
+    target = os.path.join(os.path.dirname(server.root), "shared-data")
+    os.makedirs(target, exist_ok=True)
+    keep = os.path.join(target, "keep.txt")
+    with open(keep, "w") as f:
+        f.write("precious")
+    try:
+        fs.mkdir("staging")
+        os.symlink(target, os.path.join(server.root, "staging", "shared"))
+        fs.remove_all("staging")
+        assert os.path.exists(keep), "symlink target contents must survive"
+        with pytest.raises(SFTPError):
+            fs.stat("staging")
+    finally:
+        import shutil
+
+        shutil.rmtree(target, ignore_errors=True)
