@@ -286,6 +286,9 @@ class SFTPFile(io.RawIOBase):
     def writable(self) -> bool:
         return any(c in self._mode for c in "wa+")
 
+    def seekable(self) -> bool:
+        return True  # offsets are client-side; BufferedRandom relies on this
+
     def read(self, n: int = -1) -> bytes:
         chunks = []
         remaining = n if n >= 0 else None
@@ -299,6 +302,13 @@ class SFTPFile(io.RawIOBase):
             if remaining is not None:
                 remaining -= len(data)
         return b"".join(chunks)
+
+    def readinto(self, b) -> int:
+        # BufferedReader/BufferedRandom drive raw streams through readinto
+        data = self._client.read(self._handle, self._pos, len(b))
+        self._pos += len(data)
+        b[: len(data)] = data
+        return len(data)
 
     def write(self, data: bytes) -> int:
         if isinstance(data, str):
@@ -336,9 +346,15 @@ class SFTPFileSystem:
 
     def __init__(self, host: str = "localhost", port: int = 2222,
                  user: str = "gofr", password: str = "",
+                 host_key_fingerprint: str = "",
                  connect_timeout: float = 5.0) -> None:
         self.host, self.port = host, port
         self.user, self.password = user, password
+        # sha256 hex of the server's ssh-ed25519 host key blob; when set,
+        # a mismatch aborts BEFORE the password is sent (MITM protection —
+        # the known_hosts analogue). Empty = trust-on-first-use with a
+        # warning, like a first `ssh` connection.
+        self.host_key_fingerprint = host_key_fingerprint.lower().replace(":", "")
         self.connect_timeout = connect_timeout
         self._transport: SSHTransport | None = None
         self._client: SFTPClient | None = None
@@ -353,6 +369,7 @@ class SFTPFileSystem:
             port=int(config.get_or_default("SFTP_PORT", "22")),
             user=config.get_or_default("SFTP_USER", "gofr"),
             password=config.get_or_default("SFTP_PASSWORD", ""),
+            host_key_fingerprint=config.get_or_default("SFTP_HOST_KEY_FINGERPRINT", ""),
         )
 
     # -- provider pattern --------------------------------------------------
@@ -366,11 +383,27 @@ class SFTPFileSystem:
         pass
 
     def connect(self) -> None:
+        import hashlib
+
         sock = socket.create_connection(
             (self.host, self.port), timeout=self.connect_timeout
         )
         transport = SSHTransport(sock)
         transport.handshake()
+        fingerprint = hashlib.sha256(transport.server_host_key_blob).hexdigest()
+        if self.host_key_fingerprint:
+            if fingerprint != self.host_key_fingerprint:
+                transport.close()
+                raise SSHError(
+                    f"host key fingerprint mismatch for {self.host}:{self.port}: "
+                    f"got {fingerprint}, pinned {self.host_key_fingerprint} "
+                    "(possible man-in-the-middle)"
+                )
+        elif self._logger:
+            self._logger.warn(
+                f"sftp: no SFTP_HOST_KEY_FINGERPRINT pinned for {self.host}; "
+                f"trusting presented key {fingerprint} (first-use)"
+            )
         transport.auth_password(self.user, self.password)
         transport.open_sftp_channel()
         self._transport = transport
@@ -413,11 +446,16 @@ class SFTPFileSystem:
                      size=size)
         if "b" not in mode:
             # text-mode contract parity with LocalFileSystem (local.py:51):
-            # 'r'/'w'/'a' must yield str, not bytes
-            return io.TextIOWrapper(io.BufferedRWPair(f, f) if f.writable() and f.readable()
-                                    else (io.BufferedReader(f) if f.readable()
-                                          else io.BufferedWriter(f)),
-                                    encoding="utf-8")
+            # 'r'/'w'/'a' must yield str, not bytes. BufferedRandom (not
+            # RWPair) for '+' modes: one seekable raw stream, coherent
+            # read-back after write.
+            if f.readable() and f.writable():
+                buffered: Any = io.BufferedRandom(f)
+            elif f.readable():
+                buffered = io.BufferedReader(f)
+            else:
+                buffered = io.BufferedWriter(f)
+            return io.TextIOWrapper(buffered, encoding="utf-8", write_through=True)
         return f
 
     def remove(self, name: str) -> None:

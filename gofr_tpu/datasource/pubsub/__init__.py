@@ -31,6 +31,10 @@ def build_pubsub(config):
         from gofr_tpu.datasource.pubsub.google import GooglePubSubClient
 
         return GooglePubSubClient.from_config(config)
+    if backend == "NATS":
+        from gofr_tpu.datasource.pubsub.nats import NatsClient
+
+        return NatsClient.from_config(config)
     if backend == "MEMORY":
         return InMemoryBroker.from_config(config)
     raise ValueError(f"unknown PUBSUB_BACKEND {backend!r}")

@@ -27,18 +27,50 @@ from gofr_tpu.datasource.sql.sqlite import observe_query, sql_span
 
 
 def rewrite_placeholders(sql: str) -> str:
-    """``?`` → ``$1..$n`` outside string literals, so the same handler SQL
-    runs on both in-tree dialects (query_builder.py emits ``?``)."""
-    out, n, in_str = [], 0, False
+    """``?`` → ``$1..$n`` so the same handler SQL runs on both in-tree
+    dialects (query_builder.py emits ``?``). The scanner skips single- and
+    double-quoted regions and ``--`` line comments; ``??`` escapes to a
+    literal ``?`` (the lib/pq-ecosystem convention, for Postgres JSONB
+    operators); SQL already using ``$n`` placeholders passes through
+    untouched."""
+    import re
+
+    if re.search(r"\$\d", sql):
+        return sql
+    out: list[str] = []
+    n = 0
     i = 0
+    in_sq = in_dq = in_comment = False
     while i < len(sql):
         ch = sql[i]
-        if ch == "'":
-            in_str = not in_str
+        if in_comment:
             out.append(ch)
-        elif ch == "?" and not in_str:
-            n += 1
-            out.append(f"${n}")
+            if ch == "\n":
+                in_comment = False
+        elif in_sq:
+            out.append(ch)
+            if ch == "'":
+                in_sq = False
+        elif in_dq:
+            out.append(ch)
+            if ch == '"':
+                in_dq = False
+        elif ch == "'":
+            in_sq = True
+            out.append(ch)
+        elif ch == '"':
+            in_dq = True
+            out.append(ch)
+        elif ch == "-" and sql[i : i + 2] == "--":
+            in_comment = True
+            out.append(ch)
+        elif ch == "?":
+            if sql[i : i + 2] == "??":  # escaped: literal ? operator
+                out.append("?")
+                i += 1
+            else:
+                n += 1
+                out.append(f"${n}")
         else:
             out.append(ch)
         i += 1

@@ -131,11 +131,16 @@ class MiniPostgresServer:
             out = b""
             if cur.description:
                 names = [d[0] for d in cur.description]
-                first = rows[0] if rows else None
-                cols = [
-                    (name, wire.oid_for_python(first[i]) if first is not None else wire.OID_TEXT)
-                    for i, name in enumerate(names)
-                ]
+
+                def col_oid(i: int) -> int:
+                    # first NON-NULL value decides the column type — a NULL
+                    # in row 1 must not stringify the whole column
+                    for row in rows:
+                        if row[i] is not None:
+                            return wire.oid_for_python(row[i])
+                    return wire.OID_TEXT
+
+                cols = [(name, col_oid(i)) for i, name in enumerate(names)]
                 out += wire.encode_row_description(cols)
                 for row in rows:
                     out += wire.encode_data_row(list(row))

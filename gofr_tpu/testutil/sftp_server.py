@@ -194,7 +194,8 @@ class _SFTPSubsystem:
                 raise FileNotFoundError(path)
             entries = sorted(os.listdir(path))
             h = self._new_handle(None)
-            self._dirs[h] = [(e, os.stat(os.path.join(path, e))) for e in entries]
+            # lstat: dangling symlinks must list, not fail the directory
+            self._dirs[h] = [(e, os.lstat(os.path.join(path, e))) for e in entries]
             self.stream.write_packet(fx.FXP_HANDLE, u32(rid) + sstr(h))
         elif ptype == fx.FXP_READDIR:
             h = r.string()

@@ -1,0 +1,122 @@
+"""Graph family (Dgraph shape, datasources.go:408-491): JSON mutations
+with blank-node allocation, root functions + filters + nested expansion,
+reverse edges, shortest path, transactions, health.
+"""
+
+import pytest
+
+from gofr_tpu.datasource.graph import EmbeddedGraph, GraphError
+
+
+@pytest.fixture
+def g():
+    g = EmbeddedGraph()
+    g.connect()
+    assigned = g.mutate(set=[
+        {"uid": "_:alice", "name": "Alice", "age": 31},
+        {"uid": "_:bob", "name": "Bob", "age": 40},
+        {"uid": "_:carol", "name": "Carol Santana", "age": 25},
+        {"uid": "_:alice", "friend": {"uid": "_:bob"}},
+        {"uid": "_:bob", "friend": {"uid": "_:carol"}},
+        {"uid": "_:alice", "manages": [{"uid": "_:bob"}, {"uid": "_:carol"}]},
+    ])
+    g.uids = assigned
+    return g
+
+
+def test_blank_nodes_allocated_consistently(g):
+    assert set(g.uids) == {"_:alice", "_:bob", "_:carol"}
+    assert len(set(g.uids.values())) == 3
+
+
+def test_root_functions_and_filters(g):
+    assert [n["name"] for n in g.query(func={"eq": ["name", "Alice"]})] == ["Alice"]
+    assert {n["name"] for n in g.query(func={"ge": ["age", 31]})} == {"Alice", "Bob"}
+    assert {n["name"] for n in g.query(func={"has": "friend"})} == {"Alice", "Bob"}
+    # anyofterms tokenizes
+    assert [n["name"] for n in g.query(func={"anyofterms": ["name", "santana x"]})] == ["Carol Santana"]
+    # filter with boolean combinators
+    rows = g.query(func={"has": "age"},
+                   filter={"and": [{"gt": ["age", 24]}, {"not": {"eq": ["name", "Bob"]}}]})
+    assert {n["name"] for n in rows} == {"Alice", "Carol Santana"}
+
+
+def test_nested_expansion_and_reverse_edges(g):
+    rows = g.query(func={"eq": ["name", "Alice"]},
+                   expand={"friend": {"expand": {"friend": {}}}})
+    alice = rows[0]
+    assert alice["friend"][0]["name"] == "Bob"
+    assert alice["friend"][0]["friend"][0]["name"] == "Carol Santana"
+    # reverse edge: who manages Carol?
+    rows = g.query(func={"eq": ["name", "Carol Santana"]}, expand={"~manages": {}})
+    assert rows[0]["~manages"][0]["name"] == "Alice"
+    # expansion filter
+    rows = g.query(func={"eq": ["name", "Alice"]},
+                   expand={"manages": {"filter": {"lt": ["age", 30]}}})
+    assert [n["name"] for n in rows[0]["manages"]] == ["Carol Santana"]
+
+
+def test_uid_function_and_first(g):
+    alice = g.uids["_:alice"]
+    assert g.query(func={"uid": alice})[0]["name"] == "Alice"
+    assert len(g.query(func={"has": "age"}, first=2)) == 2
+
+
+def test_shortest_path(g):
+    a, c = g.uids["_:alice"], g.uids["_:carol"]
+    path = g.shortest_path(a, c, predicates=["friend"])
+    assert path[0] == a and path[-1] == c and len(path) == 3
+    assert g.shortest_path(c, a) == []  # directed
+    # any-predicate path is shorter (manages is a direct edge)
+    assert len(g.shortest_path(a, c)) == 2
+
+
+def test_delete_semantics(g):
+    bob = g.uids["_:bob"]
+    alice = g.uids["_:alice"]
+    # drop one edge
+    g.mutate(delete=[{"uid": alice, "predicate": "manages", "target": bob}])
+    rows = g.query(func={"uid": alice}, expand={"manages": {}})
+    assert [n["name"] for n in rows[0]["manages"]] == ["Carol Santana"]
+    # drop a whole node: edges to/from it vanish
+    g.mutate(delete=[{"uid": bob}])
+    rows = g.query(func={"uid": alice}, expand={"friend": {}})
+    assert "friend" not in rows[0]
+    assert g.query(func={"eq": ["name", "Bob"]}) == []
+
+
+def test_transactions(g):
+    txn = g.new_txn()
+    txn.mutate(set=[{"uid": "_:dave", "name": "Dave"}])
+    assert g.query(func={"eq": ["name", "Dave"]}) == [], "staged until commit"
+    assigned = txn.commit()
+    assert "_:dave" in assigned
+    assert g.query(func={"eq": ["name", "Dave"]})[0]["name"] == "Dave"
+    with pytest.raises(GraphError):
+        txn.commit()
+
+    txn2 = g.new_txn()
+    txn2.mutate(set=[{"uid": "_:eve", "name": "Eve"}])
+    txn2.discard()
+    assert g.query(func={"eq": ["name", "Eve"]}) == []
+
+
+def test_alter_drop_all_and_health(g):
+    assert g.health_check()["details"]["nodes"] == 3
+    g.alter(drop_all=True)
+    health = g.health_check()
+    assert health["status"] == "UP"
+    assert health["details"] == {"backend": "embedded-graph", "nodes": 0, "edges": 0}
+
+
+def test_bad_mutation_rejected(g):
+    with pytest.raises(GraphError):
+        g.mutate(set=[{"name": "no uid"}])
+
+
+def test_has_false_after_last_edge_deleted(g):
+    a, b = g.uids["_:alice"], g.uids["_:bob"]
+    g.mutate(set=[{"uid": a, "knows": {"uid": b}}])
+    assert any(n["uid"] == a for n in g.query(func={"has": "knows"}))
+    g.mutate(delete=[{"uid": a, "predicate": "knows", "target": b}])
+    assert g.query(func={"has": "knows"}) == []

@@ -141,3 +141,24 @@ def test_shared_database_across_connections(server, db):
         assert other.query("SELECT v FROM shared")[0]["v"] == 42
     finally:
         other.close()
+
+
+def test_placeholder_rewrite_jsonb_and_escapes():
+    # ?? escapes to the literal JSONB existence operator
+    assert rewrite_placeholders("SELECT data ?? 'k' FROM t WHERE id = ?") == \
+        "SELECT data ? 'k' FROM t WHERE id = $1"
+    # double-quoted identifiers and -- comments keep their ?
+    assert rewrite_placeholders('SELECT "odd?col" FROM t -- why?\nWHERE a = ?') == \
+        'SELECT "odd?col" FROM t -- why?\nWHERE a = $1'
+    # SQL already using $n is untouched
+    assert rewrite_placeholders("SELECT $1, '?'") == "SELECT $1, '?'"
+
+
+def test_null_first_row_keeps_column_type(db):
+    db.exec("CREATE TABLE IF NOT EXISTS nully (id INTEGER PRIMARY KEY, v INTEGER)")
+    db.exec("DELETE FROM nully")
+    db.exec("INSERT INTO nully VALUES (?, ?)", 1, None)
+    db.exec("INSERT INTO nully VALUES (?, ?)", 2, 42)
+    rows = db.query("SELECT v FROM nully ORDER BY id")
+    assert rows[0]["v"] is None
+    assert rows[1]["v"] == 42 and isinstance(rows[1]["v"], int)

@@ -172,3 +172,44 @@ def test_remove_all_unlinks_symlink_without_recursing(fs, server):
         import shutil
 
         shutil.rmtree(target, ignore_errors=True)
+
+
+def test_text_plus_mode_read_write_seek(fs):
+    """'w+'/'r+' text modes use BufferedRandom: write, seek, read back."""
+    with fs.open_file("rw.txt", "w+") as f:
+        f.write("alpha beta")
+        f.seek(0)
+        assert f.read() == "alpha beta"
+    with fs.open_file("rw.txt", "r+") as f:
+        assert f.read(5) == "alpha"
+    fs.remove("rw.txt")
+
+
+def test_host_key_pinning(fs, server):
+    import hashlib
+
+    from gofr_tpu.datasource.file.ssh_transport import ed25519_blob
+
+    good = hashlib.sha256(ed25519_blob(server.host_key.public_key())).hexdigest()
+    pinned = SFTPFileSystem(host="127.0.0.1", port=server.port, user="gofr",
+                            password="secret", host_key_fingerprint=good)
+    pinned.connect()
+    assert pinned.health_check()["status"] == "UP"
+    pinned.close()
+
+    from gofr_tpu.datasource.file.ssh_transport import SSHError
+
+    wrong = SFTPFileSystem(host="127.0.0.1", port=server.port, user="gofr",
+                           password="secret", host_key_fingerprint="ab" * 32)
+    with pytest.raises(SSHError, match="fingerprint mismatch"):
+        wrong.connect()
+
+
+def test_dangling_symlink_lists_and_deletes(fs, server):
+    fs.mkdir("dangling")
+    os.symlink("/no/such/target", os.path.join(server.root, "dangling", "dead"))
+    names = [e.name for e in fs.read_dir("dangling")]
+    assert names == ["dead"]
+    fs.remove_all("dangling")
+    with pytest.raises(SFTPError):
+        fs.stat("dangling")
