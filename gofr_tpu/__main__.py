@@ -1,0 +1,102 @@
+"""The gofr-tpu CLI — the gofr-cli analogue, built on the framework's own
+CMD transport (cli/cmd.py):
+
+    python -m gofr_tpu version
+    python -m gofr_tpu grpc-generate --proto=chat.proto --out=gen/
+    python -m gofr_tpu protos --dir=protos/ --out=gen/
+    python -m gofr_tpu bench
+
+The reference ships gofr-cli as a separate protoc-wrapping tool whose
+output is the typed `*_gofr.go` services; here `grpc-generate` drives
+grpcx/codegen.py the same way.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any
+
+from gofr_tpu.app import new_cmd
+
+
+def _version(ctx: Any) -> str:
+    from gofr_tpu import version
+
+    return f"gofr-tpu {version.FRAMEWORK}"
+
+
+def _grpc_generate(ctx: Any) -> str:
+    from gofr_tpu.grpcx.codegen import generate, load_input
+
+    proto = ctx.param("proto") or ctx.param("p")
+    if not proto:
+        raise ValueError("--proto <file.proto|file.binpb> is required")
+    out_dir = ctx.param("out") or "."
+    includes = [d for d in ctx.params("include") if d]
+    fds = load_input(proto, includes)
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+    for fname, source in generate(fds).items():
+        dest = os.path.join(out_dir, fname)
+        with open(dest, "w") as f:
+            f.write(source)
+        written.append(dest)
+    return "generated:\n  " + "\n  ".join(written)
+
+
+def _protos(ctx: Any) -> str:
+    """Batch grpc-generate over every .proto in a directory."""
+    from gofr_tpu.grpcx.codegen import generate, load_input
+
+    src_dir = ctx.param("dir") or "."
+    out_dir = ctx.param("out") or src_dir
+    written = []
+    for name in sorted(os.listdir(src_dir)):
+        if not name.endswith(".proto"):
+            continue
+        fds = load_input(os.path.join(src_dir, name))
+        os.makedirs(out_dir, exist_ok=True)
+        for fname, source in generate(fds).items():
+            dest = os.path.join(out_dir, fname)
+            with open(dest, "w") as f:
+                f.write(source)
+            written.append(dest)
+    if not written:
+        return f"no .proto files in {src_dir}"
+    return "generated:\n  " + "\n  ".join(written)
+
+
+def _bench(ctx: Any) -> str:
+    """Run the repo bench contract (delegates to bench.py when present)."""
+    import subprocess
+
+    bench = os.path.join(os.getcwd(), "bench.py")
+    if not os.path.exists(bench):
+        raise FileNotFoundError("no bench.py in the current directory")
+    r = subprocess.run([sys.executable, bench], capture_output=True, text=True)
+    if r.returncode != 0:
+        # a failed bench must fail the CLI, not print stderr as a result
+        raise RuntimeError(
+            f"bench.py exited {r.returncode}: "
+            f"{(r.stderr or r.stdout).strip().splitlines()[-1:] or ['no output']}"
+        )
+    return r.stdout.strip()
+
+
+def main(argv: list[str] | None = None) -> int:
+    from gofr_tpu.cli import run_cmd
+    from gofr_tpu.config import MapConfig
+
+    app = new_cmd(MapConfig({}, use_env=True))
+    app.sub_command("version", _version, "print the framework version")
+    app.sub_command("grpc-generate", _grpc_generate,
+                    "typed gRPC codegen: --proto=FILE [--out=DIR] [--include=DIR]")
+    app.sub_command("protos", _protos,
+                    "batch codegen: --dir=DIR [--out=DIR]")
+    app.sub_command("bench", _bench, "run ./bench.py and print its contract line")
+    return run_cmd(app, argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

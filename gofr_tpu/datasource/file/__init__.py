@@ -18,6 +18,7 @@ from gofr_tpu.datasource.file.observability import ObservedFileSystem
 from gofr_tpu.datasource.file.row_reader import JSONRowReader, TextRowReader
 from gofr_tpu.datasource.file.s3 import S3Provider
 from gofr_tpu.datasource.file.sftp import SFTPFileSystem
+from gofr_tpu.datasource.file.ftp import FTPFileSystem
 
 __all__ = [
     "LocalFileSystem",
@@ -29,4 +30,5 @@ __all__ = [
     "GCSProvider",
     "S3Provider",
     "SFTPFileSystem",
+    "FTPFileSystem",
 ]

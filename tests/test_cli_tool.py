@@ -1,0 +1,70 @@
+"""The gofr-tpu CLI (gofr-cli analogue, __main__.py): subcommand routing
+through the CMD transport, typed codegen end to end, help and errors.
+"""
+
+import importlib.util
+import subprocess
+import sys
+
+from gofr_tpu.__main__ import main
+
+PING_PROTO = """
+syntax = "proto3";
+package ping.v1;
+service Ping { rpc Send(PingRequest) returns (PingResponse); }
+message PingRequest { string msg = 1; }
+message PingResponse { string echo = 1; }
+"""
+
+
+def test_version_subcommand(capsys):
+    assert main(["version"]) == 0
+    out = capsys.readouterr().out
+    assert "gofr-tpu" in out
+
+
+def test_help_lists_subcommands(capsys):
+    assert main(["--help"]) == 0
+    out = capsys.readouterr().out
+    for cmd in ("version", "grpc-generate", "protos", "bench"):
+        assert cmd in out
+
+
+def test_grpc_generate_produces_importable_module(tmp_path, capsys):
+    proto = tmp_path / "ping.proto"
+    proto.write_text(PING_PROTO)
+    rc = main([
+        "grpc-generate", f"--proto={proto}", f"--out={tmp_path / 'gen'}"
+    ])
+    assert rc == 0
+    dest = tmp_path / "gen" / "ping_gofr.py"
+    assert dest.exists()
+    spec = importlib.util.spec_from_file_location("ping_gofr_cli_test", dest)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.PingGofrServicer.SERVICE_NAME == "ping.v1.Ping"
+    assert mod.PingGofrServicer.METHODS["Send"][0] == "unary_unary"
+
+
+def test_protos_batch(tmp_path, capsys):
+    (tmp_path / "a.proto").write_text(PING_PROTO)
+    rc = main(["protos", f"--dir={tmp_path}", f"--out={tmp_path / 'out'}"])
+    assert rc == 0
+    assert (tmp_path / "out" / "a_gofr.py").exists()
+
+
+def test_missing_proto_flag_is_an_error(capsys):
+    rc = main(["grpc-generate"])
+    assert rc != 0
+
+
+def test_module_entrypoint_runs():
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-m", "gofr_tpu", "version"],
+        capture_output=True, text=True, cwd=repo_root,
+    )
+    assert r.returncode == 0
+    assert "gofr-tpu" in r.stdout

@@ -1,0 +1,140 @@
+"""FTP file system against the in-process RFC 959 server: auth, passive
+data connections, whole-file semantics, directories, rename, recursive
+delete, chroot containment, health.
+"""
+
+import ftplib
+import os
+
+import pytest
+
+from gofr_tpu.datasource.file.ftp import FTPFileSystem
+from gofr_tpu.testutil.ftp_server import MiniFTPServer
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("ftp-root")
+    s = MiniFTPServer(str(root), user="gofr", password="secret")
+    yield s
+    s.close()
+
+
+@pytest.fixture
+def fs(server):
+    f = FTPFileSystem(host="127.0.0.1", port=server.port, user="gofr",
+                      password="secret")
+    f.connect()
+    yield f
+    f.close()
+
+
+def test_login_and_health(fs):
+    health = fs.health_check()
+    assert health["status"] == "UP"
+    assert fs.getwd() == "/"
+
+
+def test_bad_login_rejected(server):
+    bad = FTPFileSystem(host="127.0.0.1", port=server.port, user="gofr",
+                        password="wrong")
+    with pytest.raises(ftplib.error_perm):
+        bad.connect()
+
+
+def test_roundtrip_and_on_disk(fs, server):
+    with fs.create("report.bin") as f:
+        f.write(b"ftp payload")
+    assert fs.open("report.bin").read() == b"ftp payload"
+    with open(os.path.join(server.root, "report.bin"), "rb") as disk:
+        assert disk.read() == b"ftp payload"
+    assert fs.stat("report.bin").size == 11
+
+
+def test_text_and_append_modes(fs):
+    with fs.open_file("notes.txt", "w") as f:
+        f.write("alpha\n")
+    with fs.open_file("notes.txt", "a") as f:
+        f.write("beta\n")
+    with fs.open_file("notes.txt", "r") as f:
+        assert f.read() == "alpha\nbeta\n"
+    fs.remove("notes.txt")
+
+
+def test_dirs_rename_recursive_delete(fs):
+    fs.mkdir("x/y/z")
+    with fs.create("x/y/z/deep.bin") as f:
+        f.write(b"d" * 64)
+    entries = fs.read_dir("x/y")
+    assert [e.name for e in entries] == ["z"] and entries[0].is_dir
+    fs.rename("x/y/z/deep.bin", "x/y/z/deeper.bin")
+    assert fs.stat("x/y/z/deeper.bin").size == 64
+    fs.remove_all("x")
+    with pytest.raises(FileNotFoundError):
+        fs.stat("x")
+
+
+def test_chdir(fs):
+    fs.mkdir("sub")
+    fs.chdir("sub")
+    assert fs.getwd() == "/sub"
+    with fs.create("in_sub.txt") as f:
+        f.write(b"s")
+    fs.chdir("/")
+    assert fs.stat("/sub/in_sub.txt").size == 1
+    fs.remove_all("sub")
+
+
+def test_chroot_containment(fs, server):
+    outside = os.path.join(os.path.dirname(server.root), "ftp-secret.txt")
+    with open(outside, "w") as f:
+        f.write("secret")
+    try:
+        # 550 maps to FileNotFoundError: the path does not exist within
+        # the visible (chrooted) tree
+        with pytest.raises(FileNotFoundError):
+            fs.open("../ftp-secret.txt")
+    finally:
+        os.remove(outside)
+
+
+def test_from_config():
+    from gofr_tpu.config import MapConfig
+
+    f = FTPFileSystem.from_config(MapConfig({
+        "FTP_HOST": "h", "FTP_PORT": "2121", "FTP_USER": "u", "FTP_PASSWORD": "p",
+    }, use_env=False))
+    assert (f.host, f.port, f.user, f.password) == ("h", 2121, "u", "p")
+
+
+def test_health_down_when_dark():
+    f = FTPFileSystem(host="127.0.0.1", port=1, connect_timeout=0.3)
+    assert f.health_check()["status"] == "DOWN"
+
+
+def test_missing_file_maps_to_filenotfound(fs):
+    with pytest.raises(FileNotFoundError):
+        fs.open("no-such.bin")
+    with pytest.raises(FileNotFoundError):
+        fs.remove("no-such.bin")
+
+
+def test_mtime_populated_from_mlsx_facts(fs):
+    with fs.create("timed.bin") as f:
+        f.write(b"t")
+    try:
+        entries = [e for e in fs.read_dir(".") if e.name == "timed.bin"]
+        assert entries and entries[0].mod_time > 0
+        assert fs.stat("timed.bin").mod_time > 0
+    finally:
+        fs.remove("timed.bin")
+
+
+def test_mkdir_over_existing_file_raises(fs):
+    with fs.create("blocker") as f:
+        f.write(b"x")
+    try:
+        with pytest.raises(ftplib.error_perm):
+            fs.mkdir("blocker/sub")
+    finally:
+        fs.remove("blocker")
