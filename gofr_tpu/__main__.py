@@ -26,15 +26,12 @@ def _version(ctx: Any) -> str:
     return f"gofr-tpu {version.FRAMEWORK}"
 
 
-def _grpc_generate(ctx: Any) -> str:
+def _write_generated(proto_path: str, out_dir: str,
+                     includes: list[str] | None = None) -> list[str]:
+    """Shared generate-and-write step for both codegen subcommands."""
     from gofr_tpu.grpcx.codegen import generate, load_input
 
-    proto = ctx.param("proto") or ctx.param("p")
-    if not proto:
-        raise ValueError("--proto <file.proto|file.binpb> is required")
-    out_dir = ctx.param("out") or "."
-    includes = [d for d in ctx.params("include") if d]
-    fds = load_input(proto, includes)
+    fds = load_input(proto_path, includes or [])
     os.makedirs(out_dir, exist_ok=True)
     written = []
     for fname, source in generate(fds).items():
@@ -42,26 +39,27 @@ def _grpc_generate(ctx: Any) -> str:
         with open(dest, "w") as f:
             f.write(source)
         written.append(dest)
+    return written
+
+
+def _grpc_generate(ctx: Any) -> str:
+    proto = ctx.param("proto") or ctx.param("p")
+    if not proto:
+        raise ValueError("--proto <file.proto|file.binpb> is required")
+    out_dir = ctx.param("out") or "."
+    includes = [d for d in ctx.params("include") if d]
+    written = _write_generated(proto, out_dir, includes)
     return "generated:\n  " + "\n  ".join(written)
 
 
 def _protos(ctx: Any) -> str:
     """Batch grpc-generate over every .proto in a directory."""
-    from gofr_tpu.grpcx.codegen import generate, load_input
-
     src_dir = ctx.param("dir") or "."
     out_dir = ctx.param("out") or src_dir
     written = []
     for name in sorted(os.listdir(src_dir)):
-        if not name.endswith(".proto"):
-            continue
-        fds = load_input(os.path.join(src_dir, name))
-        os.makedirs(out_dir, exist_ok=True)
-        for fname, source in generate(fds).items():
-            dest = os.path.join(out_dir, fname)
-            with open(dest, "w") as f:
-                f.write(source)
-            written.append(dest)
+        if name.endswith(".proto"):
+            written.extend(_write_generated(os.path.join(src_dir, name), out_dir))
     if not written:
         return f"no .proto files in {src_dir}"
     return "generated:\n  " + "\n  ".join(written)
@@ -77,9 +75,9 @@ def _bench(ctx: Any) -> str:
     r = subprocess.run([sys.executable, bench], capture_output=True, text=True)
     if r.returncode != 0:
         # a failed bench must fail the CLI, not print stderr as a result
+        lines = (r.stderr or r.stdout).strip().splitlines()
         raise RuntimeError(
-            f"bench.py exited {r.returncode}: "
-            f"{(r.stderr or r.stdout).strip().splitlines()[-1:] or ['no output']}"
+            f"bench.py exited {r.returncode}: {lines[-1] if lines else 'no output'}"
         )
     return r.stdout.strip()
 

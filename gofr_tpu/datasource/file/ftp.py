@@ -50,10 +50,12 @@ class _FTPWriteSpool(io.BytesIO):
 
     def close(self) -> None:
         if not self._flushed:
-            self._flushed = True
-            payload = self.getvalue()
+            # STOR straight from the spool (no getvalue copy); flag flips
+            # only on SUCCESS so a failed upload raises again on retry
+            # instead of silently dropping the data
             self.seek(0)
-            self._fs._conn().storbinary(f"STOR {self._path}", io.BytesIO(payload))
+            self._fs._conn().storbinary(f"STOR {self._path}", self)
+            self._flushed = True
         super().close()
 
 
@@ -128,7 +130,7 @@ class FTPFileSystem:
         elif mode in ("a", "ab"):
             try:
                 existing = self.open(name).getvalue()
-            except ftplib.error_perm:
+            except FileNotFoundError:  # open() maps 550 already
                 existing = b""
             spool = _FTPWriteSpool(self, name, initial=existing)
         else:
@@ -149,11 +151,14 @@ class FTPFileSystem:
         try:
             entries = self.read_dir(name)
         except ftplib.error_perm:
-            # not a directory (or absent): plain delete
+            # not a directory (or absent): plain delete — tolerate only
+            # genuinely-gone, never a denied delete (Go RemoveAll parity)
             try:
                 conn.delete(name)
-            except ftplib.error_perm:
-                pass
+            except ftplib.error_perm as exc:
+                if str(exc)[:3] == "550" and not self._exists(name):
+                    return
+                raise
             return
         for e in entries:
             child = posixpath.join(name, e.name)
@@ -162,6 +167,13 @@ class FTPFileSystem:
             else:
                 conn.delete(child)
         conn.rmd(name)
+
+    def _exists(self, name: str) -> bool:
+        try:
+            self.stat(name)
+            return True
+        except (FileNotFoundError, ftplib.error_perm):
+            return False
 
     def rename(self, old: str, new: str) -> None:
         self._conn().rename(old, new)

@@ -76,7 +76,8 @@ class _FTPSession:
 
     def _open_data(self) -> socket.socket:
         if self._data_listener is None:
-            raise RuntimeError("no PASV issued")
+            # surfaces as a 550 protocol error, not a dead session
+            raise OSError("use PASV first")
         data, _ = self._data_listener.accept()
         self._data_listener.close()
         self._data_listener = None

@@ -1,0 +1,110 @@
+"""Columnar (ClickHouse-shape) driver against the in-process HTTP
+server: auth, server-side parameter binding, JSONEachRow select into
+dicts/dataclasses, bulk insert, async_insert, typed errors, health.
+"""
+
+import dataclasses
+
+import pytest
+
+from gofr_tpu.config import MapConfig
+from gofr_tpu.datasource.columnar import ClickHouseClient, ColumnarError
+from gofr_tpu.testutil.clickhouse_server import MiniClickHouseServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = MiniClickHouseServer(user="gofr", password="ck")
+    yield s
+    s.close()
+
+
+@pytest.fixture
+def ch(server):
+    c = ClickHouseClient(url=server.url, user="gofr", password="ck")
+    c.connect()
+    return c
+
+
+def test_auth_enforced(server):
+    bad = ClickHouseClient(url=server.url, user="gofr", password="wrong")
+    with pytest.raises(ColumnarError) as err:
+        bad.connect()
+    assert err.value.http_status == 403
+
+
+def test_exec_insert_select_roundtrip(ch, server):
+    ch.exec("CREATE TABLE IF NOT EXISTS events (ts INTEGER, route TEXT, ms REAL)")
+    ch.exec("DELETE FROM events")
+    ch.insert_rows("events", [
+        {"ts": 1, "route": "/generate", "ms": 12.5},
+        {"ts": 2, "route": "/embed", "ms": 3.25},
+        {"ts": 3, "route": "/generate", "ms": 14.0},
+    ])
+    rows = ch.select(
+        dict,
+        "SELECT route, count(*) AS n, avg(ms) AS mean FROM events "
+        "WHERE route = {r:String} GROUP BY route",
+        params={"r": "/generate"},
+    )
+    assert rows == [{"route": "/generate", "n": 2, "mean": 13.25}]
+    assert server.rows("SELECT count(*) FROM events") == [(3,)]
+
+
+def test_select_into_dataclass(ch):
+    @dataclasses.dataclass
+    class Row:
+        route: str
+        ms: float
+
+    ch.exec("CREATE TABLE IF NOT EXISTS lat (route TEXT, ms REAL)")
+    ch.exec("DELETE FROM lat")
+    ch.insert_rows("lat", [{"route": "/x", "ms": 1.5}])
+    out = ch.select(Row, "SELECT route, ms FROM lat")
+    assert out == [Row(route="/x", ms=1.5)]
+
+
+def test_async_insert_applies(ch):
+    ch.exec("CREATE TABLE IF NOT EXISTS logs (msg TEXT)")
+    ch.exec("DELETE FROM logs")
+    ch.async_insert("INSERT INTO logs VALUES ({m:String})", params={"m": "hello"})
+    rows = ch.select(dict, "SELECT msg FROM logs")
+    assert rows == [{"msg": "hello"}]
+
+
+def test_param_binding_never_concatenates(ch):
+    ch.exec("CREATE TABLE IF NOT EXISTS users2 (name TEXT)")
+    ch.exec("DELETE FROM users2")
+    evil = "x'; DROP TABLE users2; --"
+    ch.exec("INSERT INTO users2 VALUES ({n:String})", params={"n": evil})
+    rows = ch.select(dict, "SELECT name FROM users2")
+    assert rows == [{"name": evil}]  # stored verbatim, not executed
+
+
+def test_sql_error_is_typed(ch):
+    with pytest.raises(ColumnarError) as err:
+        ch.exec("SELECT FROM nonsense")
+    assert "DB::Exception" in str(err.value)
+    with pytest.raises(ColumnarError):
+        ch.select(dict, "SELECT {missing:String}")
+
+
+def test_health_and_from_config(server, ch):
+    health = ch.health_check()
+    assert health["status"] == "UP"
+    assert "gofr-mini" in health["details"]["version"]
+
+    built = ClickHouseClient.from_config(MapConfig({
+        "CLICKHOUSE_URL": server.url, "CLICKHOUSE_USER": "gofr",
+        "CLICKHOUSE_PASSWORD": "ck",
+    }, use_env=False))
+    built.connect()
+
+    dark = ClickHouseClient(url="http://127.0.0.1:1", timeout=0.3)
+    assert dark.health_check()["status"] == "DOWN"
+
+
+def test_select_rejects_own_format_and_strips_semicolon(ch):
+    assert ch.select(dict, "SELECT 1 AS x;") == [{"x": 1}]
+    with pytest.raises(ColumnarError):
+        ch.select(dict, "SELECT 1 FORMAT TSV")

@@ -138,3 +138,12 @@ def test_mkdir_over_existing_file_raises(fs):
             fs.mkdir("blocker/sub")
     finally:
         fs.remove("blocker")
+
+
+def test_append_creates_missing_file(fs):
+    """'a' on a file that does not exist yet must create it, like open()."""
+    with fs.open_file("fresh.log", "a") as f:
+        f.write("first\n")
+    with fs.open_file("fresh.log", "r") as f:
+        assert f.read() == "first\n"
+    fs.remove("fresh.log")

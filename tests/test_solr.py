@@ -1,0 +1,120 @@
+"""Solr driver against the in-process mini server: collection admin,
+add/upsert, standard-query-parser subset (field, range, AND/OR, free
+text ranked by BM25), delete by id and by query, pagination/sort,
+typed errors, health.
+"""
+
+import pytest
+
+from gofr_tpu.config import MapConfig
+from gofr_tpu.datasource.search.solr import SolrClient, SolrError
+from gofr_tpu.testutil.solr_server import MiniSolrServer, solr_q_to_query
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = MiniSolrServer()
+    yield s
+    s.close()
+
+
+@pytest.fixture
+def solr(server):
+    c = SolrClient(url=server.url)
+    c.connect()
+    # fresh collection per test
+    if "books" in c.list_collections():
+        c.delete_collection("books")
+    c.create_collection("books")
+    c.add("books", [
+        {"id": "1", "title": "TPU serving systems", "year": 2024, "pages": 300},
+        {"id": "2", "title": "Distributed serving at scale", "year": 2023, "pages": 450},
+        {"id": "3", "title": "Gardening", "year": 2020, "pages": 120},
+    ])
+    return c
+
+
+def test_q_translation_unit():
+    assert solr_q_to_query("*:*") == {"match_all": {}}
+    assert solr_q_to_query("year:2024") == {"term": {"year": 2024}}
+    assert solr_q_to_query("pages:[200 TO 500]") == {
+        "range": {"pages": {"gte": 200, "lte": 500}}
+    }
+    assert solr_q_to_query("pages:[* TO 200]") == {"range": {"pages": {"lte": 200}}}
+    q = solr_q_to_query("year:2024 AND pages:[200 TO *]")
+    assert set(q["bool"]) == {"must"}
+    assert solr_q_to_query("serving")["match"]["_all"] == "serving"
+
+
+def test_search_field_range_bool(solr):
+    resp = solr.search("books", "year:2024")
+    assert resp["response"]["numFound"] == 1
+    assert resp["response"]["docs"][0]["id"] == "1"
+
+    resp = solr.search("books", "pages:[200 TO 500]")
+    assert {d["id"] for d in resp["response"]["docs"]} == {"1", "2"}
+
+    resp = solr.search("books", "year:[2023 TO *] AND pages:[400 TO *]")
+    assert [d["id"] for d in resp["response"]["docs"]] == ["2"]
+
+
+def test_free_text_ranked(solr):
+    resp = solr.search("books", "serving")
+    docs = resp["response"]["docs"]
+    assert {d["id"] for d in docs} == {"1", "2"}
+
+
+def test_upsert_and_delete(solr):
+    solr.update("books", [{"id": "1", "title": "TPU serving systems 2e",
+                           "year": 2025, "pages": 320}])
+    resp = solr.search("books", "year:2025")
+    assert resp["response"]["docs"][0]["title"].endswith("2e")
+
+    solr.delete_by_id("books", ["3"])
+    assert solr.search("books", "*:*")["response"]["numFound"] == 2
+
+    solr.delete_by_query("books", "pages:[400 TO *]")
+    remaining = solr.search("books", "*:*")["response"]["docs"]
+    assert [d["id"] for d in remaining] == ["1"]
+
+
+def test_pagination_and_sort(solr):
+    resp = solr.search("books", "*:*", rows=2, sort="year desc")
+    years = [d["year"] for d in resp["response"]["docs"]]
+    assert years == sorted(years, reverse=True)
+    resp = solr.search("books", "*:*", rows=1, start=1)
+    assert len(resp["response"]["docs"]) == 1
+
+
+def test_unknown_collection_404(solr):
+    with pytest.raises(SolrError) as err:
+        solr.search("nope", "*:*")
+    assert err.value.http_status == 404
+
+
+def test_doc_without_id_rejected(solr):
+    with pytest.raises(SolrError) as err:
+        solr.add("books", [{"title": "anonymous"}])
+    assert err.value.http_status == 400
+
+
+def test_health_and_config(server, solr):
+    health = solr.health_check()
+    assert health["status"] == "UP"
+    assert health["details"]["collections"] >= 1
+
+    built = SolrClient.from_config(
+        MapConfig({"SOLR_URL": server.url}, use_env=False)
+    )
+    built.connect()
+
+    dark = SolrClient(url="http://127.0.0.1:1", timeout=0.3)
+    assert dark.health_check()["status"] == "DOWN"
+
+
+def test_sort_covers_full_result_set(solr):
+    """sort must order ALL matches before start/rows slicing."""
+    resp = solr.search("books", "*:*", rows=1, sort="year asc")
+    assert resp["response"]["docs"][0]["year"] == 2020
+    resp = solr.search("books", "*:*", rows=1, start=1, sort="year asc")
+    assert resp["response"]["docs"][0]["year"] == 2023
