@@ -1,21 +1,33 @@
-"""Benchmark entry point (driver contract): prints ONE JSON line
-``{"metric", "value", "unit", "vs_baseline"}`` — ALWAYS, even when the
-TPU backend is unreachable (then with an ``"error"`` field; never a bare
-traceback). Round-2 post-mortem: one unguarded ``jax.devices()`` erased
-the round's perf record when the axon tunnel flaked.
+"""Benchmark entry point (driver contract): prints contract JSON lines
+``{"metric", "value", "unit", "vs_baseline"}`` — the HEADLINE llama-decode
+line first, then one line per additional benchmark phase. Every line is
+contract-shaped (never a bare traceback); a failed phase carries an
+``"error"`` field instead of a value. Round-2 post-mortem: one unguarded
+``jax.devices()`` erased the round's perf record when the axon tunnel
+flaked; round-3 verdict: the CPU-runnable phases (gRPC unary echo =
+BASELINE configs[0], BERT /embed = configs[1]) must produce numbers
+whether or not the tunnel is up.
 
-Headline benchmark: **memory-honest 8B-class decode** — Llama-3-8B shape
-(32L/32H/8KV/4096d/14336ff/128256V) with weight-only int8 matmul weights
-(per-channel scales, dequant fused into the dot; models/llama.py
-``quantize_weight``), bf16 activations/KV. That is the largest Llama
-config that fits one 16 GB v5e chip (~8.6 GB weights + ~3.4 GB KV at
-B=128), so ``vs_baseline`` against the 8B-derived target is apples to
-apples: BASELINE.json's north star is >1,000 req/s aggregate on v5e-8
-for Llama-3-8B /generate; at ~128 output tokens per request that is
-~128k tok/s over 8 chips ⇒ **16k tok/s per chip**. Beside tok/s the
-bench reports ``est_hbm_gbps`` and ``hbm_util`` (fraction of the v5e's
-819 GB/s peak) — decode at this scale is HBM-bound, so utilization is
-the honest "how close to the hardware ceiling" number.
+Phases:
+1. ``llama_decode_tokens_per_sec_*`` — memory-honest 8B-class decode
+   (Llama-3-8B shape, weight-only int8, bf16 activations/KV; largest
+   config that fits one 16 GB v5e chip). vs_baseline against the
+   north-star-derived 16k tok/s/chip (BASELINE.json: >1k req/s on v5e-8
+   at ~128 tok/req ⇒ 128k tok/s / 8 chips). Reports est_hbm_gbps and
+   hbm_util (fraction of 819 GB/s peak) — decode is HBM-bound, so
+   utilization is the honest "how close to the ceiling" number.
+2. ``engine_sustained_*`` — the continuous-batching ServingEngine under
+   closed-loop concurrency for a fixed wall duration (statistically real:
+   hundreds of requests, not 6 — VERDICT r3 weak #3), TTFT percentiles
+   from per-request measurements.
+3. ``http_generate_*`` — same engine behind the real HTTP server
+   (``/generate``), closed-loop load: the number the round-3 verdict said
+   had never been measured through the HTTP layer.
+4. ``grpc_unary_echo_*`` — BASELINE configs[0]: framework overhead
+   through the full gRPC stack (interceptors, observability), no TPU at
+   all (ref analogue pkg/gofr/grpc.go:21-197 + handler.go:55-113).
+5. ``bert_embed_http_*`` — BASELINE configs[1]: BERT ``/embed`` over the
+   real HTTP server (models/bert.py; base config on TPU, tiny on CPU).
 
 Backend acquisition: the axon sitecustomize forces jax_platforms=axon
 (beating the JAX_PLATFORMS env var), and a downed tunnel makes backend
@@ -26,12 +38,6 @@ jax. On exhaustion the bench falls back to CPU tiny shapes and carries
 the error in the contract line. Every successful on-TPU run is appended
 to the committed ``BENCH_LOCAL.jsonl`` so a snapshot-time outage can
 never erase the round's evidence again.
-
-Decode loop: one fused dispatch per token (llama.decode_step_greedy:
-forward + argmax + length increment), launches pipelined, ONE
-``jax.device_get`` sync at the end — the only sync that provably drains
-the pipeline on proxied PJRT backends. The KV cache rides the scan
-carry with per-layer in-place updates (llama._layer_cached).
 """
 
 from __future__ import annotations
@@ -40,6 +46,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 import traceback
 from typing import Any
@@ -74,18 +81,22 @@ def _init_in_process_guarded(timeout_s: float) -> str:
     """Run the parent's own backend init under a watchdog: a hang here
     (tunnel drops between the probe subprocess and this call) cannot be
     interrupted, so the watchdog emits the contract error line and
-    hard-exits — the ALWAYS-one-JSON-line guarantee survives even this
-    window."""
-    import threading
-
+    hard-exits — the ALWAYS-contract-output guarantee survives even this
+    window. A fast RAISE (not hang) is distinguished and surfaces as the
+    real error so the CPU fallback still runs (ADVICE r3)."""
     import jax
 
     result: list[str] = []
+    raised: list[BaseException] = []
     done = threading.Event()
 
     def init() -> None:
-        result.append(jax.devices()[0].platform)
-        done.set()
+        try:
+            result.append(jax.devices()[0].platform)
+        except BaseException as exc:  # noqa: BLE001 - recorded, re-raised below
+            raised.append(exc)
+        finally:
+            done.set()
 
     t = threading.Thread(target=init, daemon=True)
     t.start()
@@ -96,6 +107,8 @@ def _init_in_process_guarded(timeout_s: float) -> str:
         )
         sys.stdout.flush()
         os._exit(1)
+    if raised:
+        raise raised[0]
     return result[0]
 
 
@@ -122,8 +135,12 @@ def _acquire_backend() -> tuple[str, str | None]:
         if platform is not None:
             # probe succeeded → in-process init should be fast now, but the
             # tunnel can still flake in this window: keep the watchdog on
-            return _init_in_process_guarded(max(per_try, 120.0)), None
-        last_err = err or "unknown"
+            try:
+                return _init_in_process_guarded(max(per_try, 120.0)), None
+            except Exception as exc:
+                last_err = f"in-process init raised: {type(exc).__name__}: {exc}"
+        else:
+            last_err = err or "unknown"
         print(f"bench: backend probe {attempt + 1} failed: {last_err}", file=sys.stderr)
         attempt += 1
         if time.monotonic() - start + backoff >= deadline_s:
@@ -134,6 +151,9 @@ def _acquire_backend() -> tuple[str, str | None]:
     return jax.devices()[0].platform, f"TPU backend unavailable after {attempt} probes: {last_err}"
 
 
+# --------------------------------------------------------------------------
+# phase 1: raw batched decode (headline)
+# --------------------------------------------------------------------------
 def _bench_decode(cfg: Any, params: Any, batch: int, prompt_len: int,
                   decode_steps: int) -> dict:
     """Timed batched decode: prefill once, then one fused dispatch per
@@ -198,6 +218,313 @@ def _bench_decode(cfg: Any, params: Any, batch: int, prompt_len: int,
     }
 
 
+# --------------------------------------------------------------------------
+# phase 2+3: sustained engine + HTTP load
+# --------------------------------------------------------------------------
+def _percentiles(samples: list[float]) -> dict[str, float]:
+    import math
+
+    s = sorted(samples)
+    n = len(s)
+    if not n:
+        return {}
+
+    def rank(q: float) -> int:  # nearest-rank: ceil(q*n)-1, clamped
+        return min(n - 1, max(0, math.ceil(q * n) - 1))
+
+    return {
+        "p50_ms": round(s[rank(0.50)] * 1e3, 2),
+        "p95_ms": round(s[rank(0.95)] * 1e3, 2),
+        "p99_ms": round(s[rank(0.99)] * 1e3, 2),
+        "n": n,
+    }
+
+
+def _closed_loop(
+    duration: float, concurrency: int, issue: Any
+) -> tuple[list, float, dict]:
+    """Fixed-wall-clock closed-loop load: ``concurrency`` threads each call
+    ``issue(wid, i)`` repeatedly until the deadline. Returns (results,
+    elapsed, error_stats). Workers survive transient errors (a worker that
+    died at t=1s would silently shrink the offered load for the rest of
+    the window) and every failure is counted; a phase whose every request
+    failed raises instead of reporting a 0-value success (code-review r4)."""
+    results: list[Any] = []
+    errors: list[BaseException] = []
+    lock = threading.Lock()
+    deadline = time.perf_counter() + duration
+
+    def worker(wid: int) -> None:
+        i = 0
+        while time.perf_counter() < deadline:
+            try:
+                r = issue(wid, i)
+            except Exception as exc:
+                with lock:
+                    errors.append(exc)
+                time.sleep(0.05)  # don't spin hot on a persistent failure
+                continue
+            finally:
+                i += 1
+            with lock:
+                results.append(r)
+
+    start = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration + 1200)
+    elapsed = time.perf_counter() - start
+    if not results and errors:
+        raise errors[0]
+    error_stats: dict[str, Any] = {"failed_requests": len(errors)}
+    if errors:
+        error_stats["first_error"] = f"{type(errors[0]).__name__}: {errors[0]}"
+    return results, elapsed, error_stats
+
+
+class _bench_app:
+    """Context manager: boots a real App on free ports with the given
+    route-registration hook, polls /.well-known/alive, and ALWAYS stops the
+    app on exit (a failed warm-up must not leak listener threads into the
+    phases timed after it — code-review r4)."""
+
+    def __init__(self, name: str, register: Any) -> None:
+        self.name = name
+        self.register = register
+
+    def __enter__(self) -> str:
+        import urllib.request
+
+        import gofr_tpu
+        from gofr_tpu.config import MapConfig
+        from gofr_tpu.testutil import new_server_configs
+
+        ports = new_server_configs(set_env=False)
+        config = MapConfig(
+            {
+                "HTTP_PORT": str(ports.http_port),
+                "GRPC_PORT": str(ports.grpc_port),
+                "METRICS_PORT": str(ports.metrics_port),
+                "APP_NAME": self.name,
+                "LOG_LEVEL": "ERROR",
+            },
+            use_env=False,
+        )
+        self.app = gofr_tpu.App(config)
+        self.register(self.app)
+        self.thread = threading.Thread(target=self.app.run, daemon=True)
+        self.thread.start()
+        base = f"http://127.0.0.1:{ports.http_port}"
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                urllib.request.urlopen(base + "/.well-known/alive", timeout=1)
+                return base
+            except OSError:
+                time.sleep(0.05)
+        self.__exit__(None, None, None)
+        raise RuntimeError(f"bench app {self.name} did not come up")
+
+    def __exit__(self, *exc: Any) -> None:
+        self.app.stop()
+        self.thread.join(timeout=15)
+
+
+def _post_json(url: str, payload: dict) -> float:
+    """One timed HTTP POST; returns client-measured latency in seconds."""
+    import urllib.request
+
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(req, timeout=1200) as resp:
+        resp.read()
+    return time.perf_counter() - t0
+
+
+def _engine_sustained(cfg: Any, params: Any, on_tpu: bool) -> tuple[dict, Any]:
+    """Closed-loop sustained load straight into the engine (tokenize →
+    schedule → prefill → pipelined batched decode → detokenize). Returns
+    (stats, engine) — the live engine is reused for the HTTP phase."""
+    from gofr_tpu.serving import ByteTokenizer, EngineConfig, ServingEngine
+
+    duration = float(os.environ.get("BENCH_SUSTAIN_S", "20" if on_tpu else "6"))
+    concurrency = 64 if on_tpu else 8
+    max_new = 32 if on_tpu else 16
+    prompt_pad = "request padding " * 3 if on_tpu else "abc "
+    engine = ServingEngine(
+        cfg,
+        params,
+        EngineConfig(
+            max_slots=32 if on_tpu else 4,
+            max_seq_len=256 if on_tpu else 64,
+            prefill_buckets=(64,) if on_tpu else (16,),
+            admission_per_step=8 if on_tpu else 4,
+            max_queue=2 * concurrency + 8,
+        ),
+        ByteTokenizer(cfg.vocab_size),
+        metrics=_engine_metrics(),
+    )
+    engine.start()
+    try:
+        # warm the two compiles (prefill bucket + decode step) off the clock
+        engine.submit(prompt_pad, max_new_tokens=2, temperature=0.0).result(timeout=1200)
+
+        def issue(wid: int, i: int) -> Any:
+            prompt = f"w{wid}r{i} {prompt_pad}"[: 60 if on_tpu else 12]
+            return engine.submit(
+                prompt, max_new_tokens=max_new, temperature=0.0
+            ).result(timeout=1200)
+
+        results, elapsed, err = _closed_loop(duration, concurrency, issue)
+    except BaseException:
+        engine.stop()  # a failed phase must not leak the engine thread
+        raise
+
+    gen_tokens = sum(r.completion_tokens for r in results)
+    stats = {
+        "requests": len(results),
+        "duration_s": round(elapsed, 2),
+        "concurrency": concurrency,
+        "max_new_tokens": max_new,
+        "req_per_s": round(len(results) / elapsed, 2),
+        "gen_tok_per_s": round(gen_tokens / elapsed, 2),
+        "ttft": _percentiles([r.ttft_s for r in results]),
+        **err,
+    }
+    return stats, engine
+
+
+def _http_generate_load(engine: Any, on_tpu: bool) -> dict:
+    """The same engine behind the real HTTP server: closed-loop POST
+    /generate, end-to-end latency measured at the client."""
+    from gofr_tpu.serving.handlers import register_generation_routes
+
+    duration = float(os.environ.get("BENCH_SUSTAIN_S", "20" if on_tpu else "6"))
+    concurrency = 32 if on_tpu else 8
+    max_new = 16 if on_tpu else 8
+
+    with _bench_app("bench-http", lambda app: register_generation_routes(app, engine)) as base:
+        def issue(wid: int, i: int) -> float:
+            return _post_json(
+                base + "/generate",
+                {"prompt": f"h{wid}r{i} bench", "max_tokens": max_new,
+                 "temperature": 0.0},
+            )
+
+        latencies, elapsed, err = _closed_loop(duration, concurrency, issue)
+
+    return {
+        "requests": len(latencies),
+        "duration_s": round(elapsed, 2),
+        "concurrency": concurrency,
+        "max_new_tokens": max_new,
+        "req_per_s": round(len(latencies) / elapsed, 2),
+        "latency": _percentiles(latencies),
+        **err,
+    }
+
+
+# --------------------------------------------------------------------------
+# phase 4: gRPC unary echo (BASELINE configs[0] — no TPU involved)
+# --------------------------------------------------------------------------
+def _grpc_unary_echo() -> dict:
+    """Framework-overhead calibration through the full gRPC stack:
+    recovery + observability interceptors, JSON body, asyncio server —
+    the TPU-framework analogue of GoFr's handler overhead (SURVEY §6:
+    span + 2 goroutines + JSON encode + log + histogram per request)."""
+    import asyncio
+
+    from gofr_tpu.config import MapConfig
+    from gofr_tpu.grpcx import GRPCServer, InferenceClient, InferenceService
+    from gofr_tpu.testutil import get_free_port, new_mock_container
+
+    duration = float(os.environ.get("BENCH_GRPC_S", "6"))
+    concurrency = 16
+
+    async def scenario() -> dict:
+        container, _ = new_mock_container()
+        port = get_free_port()
+        server = GRPCServer(container, port, MapConfig({}, use_env=False))
+        server.register(InferenceService())
+        await server.start()
+        client = InferenceClient(f"127.0.0.1:{port}")
+        latencies: list[float] = []
+        payload = {"ping": 1, "payload": "x" * 64}
+        try:
+            await client.echo(payload)  # warm the channel
+            end_at = time.perf_counter() + duration
+
+            async def worker() -> None:
+                while time.perf_counter() < end_at:
+                    t0 = time.perf_counter()
+                    await client.echo(payload)
+                    latencies.append(time.perf_counter() - t0)
+
+            start = time.perf_counter()
+            await asyncio.gather(*[worker() for _ in range(concurrency)])
+            elapsed = time.perf_counter() - start
+        finally:
+            await client.close()
+            await server.shutdown(grace=0.5)
+        return {
+            "requests": len(latencies),
+            "duration_s": round(elapsed, 2),
+            "concurrency": concurrency,
+            "req_per_s": round(len(latencies) / elapsed, 2),
+            "latency": _percentiles(latencies),
+        }
+
+    return asyncio.run(scenario())
+
+
+# --------------------------------------------------------------------------
+# phase 5: BERT /embed over HTTP (BASELINE configs[1])
+# --------------------------------------------------------------------------
+def _bert_embed_http(on_tpu: bool) -> dict:
+    import jax
+
+    from gofr_tpu.models import bert
+    from gofr_tpu.serving import ByteTokenizer
+    from gofr_tpu.serving.handlers import register_embedding_routes
+
+    cfg = bert.BertConfig.base() if on_tpu else bert.BertConfig.tiny()
+    params = jax.device_put(bert.init_params(cfg, jax.random.PRNGKey(0)))
+    tokenizer = ByteTokenizer(cfg.vocab_size)
+
+    duration = float(os.environ.get("BENCH_EMBED_S", "10" if on_tpu else "6"))
+    concurrency = 16
+    text = "the quick brown fox jumps over the lazy dog " * 2
+
+    with _bench_app(
+        "bench-embed",
+        lambda app: register_embedding_routes(app, cfg, params, tokenizer),
+    ) as base:
+        _post_json(base + "/embed", {"texts": [text]})  # warm the jit off the clock
+
+        def issue(wid: int, i: int) -> float:
+            return _post_json(base + "/embed", {"texts": [text]})
+
+        latencies, elapsed, err = _closed_loop(duration, concurrency, issue)
+
+    return {
+        "requests": len(latencies),
+        "duration_s": round(elapsed, 2),
+        "concurrency": concurrency,
+        "model": "bert-base" if on_tpu else "bert-tiny",
+        "req_per_s": round(len(latencies) / elapsed, 2),
+        "latency": _percentiles(latencies),
+        **err,
+    }
+
+
+# --------------------------------------------------------------------------
+# orchestration
+# --------------------------------------------------------------------------
 def main() -> None:
     wall_start = time.time()
     try:
@@ -231,6 +558,32 @@ def _emit_error_line(error: str, wall_start: float, init_error: str | None = Non
     print(json.dumps(line))
 
 
+def _phase_line(metric: str, unit: str, fn: Any, *, value_key: str,
+                vs_of: Any = None, on_tpu: bool = False,
+                init_error: str | None = None) -> dict:
+    """Run one phase fail-safe; always return a contract-shaped dict."""
+    try:
+        stats = fn()
+        vs = vs_of(stats) if (vs_of is not None and on_tpu) else None
+        line = {
+            "metric": metric,
+            "value": stats.get(value_key),
+            "unit": unit,
+            "vs_baseline": round(vs, 4) if vs is not None else None,
+            "details": stats,
+        }
+    except Exception as exc:
+        tb = traceback.format_exc(limit=3).strip().replace("\n", " | ")
+        line = {
+            "metric": metric, "value": None, "unit": unit,
+            "vs_baseline": None,
+            "error": f"{type(exc).__name__}: {exc} [{tb}]",
+        }
+    if init_error and "error" not in line:
+        line["details"]["init_error"] = init_error
+    return line
+
+
 def _run_benchmarks(platform: str, init_error: str | None, wall_start: float) -> None:
     import jax
     import jax.numpy as jnp
@@ -257,50 +610,94 @@ def _run_benchmarks(platform: str, init_error: str | None, wall_start: float) ->
         quantize = True  # exercise the same W8 code path as the headline
         batch, prompt_len, decode_steps = 4, 8, 4
 
-    params = llama.init_params(cfg, jax.random.PRNGKey(0), quantize=quantize)
-    params = jax.device_put(params)
-    n_params = llama.param_count(params)
-    weight_gb = llama.param_bytes(params) / 1e9
+    # the headline phase is fail-safed like every other phase: an OOM or
+    # mid-run tunnel flake here must not erase the CPU-only phases below
+    # (code-review r4)
+    params = None
 
-    decode = _bench_decode(cfg, params, batch, prompt_len, decode_steps)
-
-    # engine-under-load phase: the continuous-batching ServingEngine
-    # end-to-end (tokenize → schedule → prefill → batched decode →
-    # detokenize), TTFT from the engine's own measurements. Fail-safe:
-    # must never cost the headline number.
-    try:
-        engine_stats = _engine_load(cfg, params, on_tpu)
-    except Exception as exc:  # pragma: no cover - defensive
-        engine_stats = {"error": f"{type(exc).__name__}: {exc}"}
+    def run_decode() -> dict:
+        nonlocal params
+        params = jax.device_put(
+            llama.init_params(cfg, jax.random.PRNGKey(0), quantize=quantize)
+        )
+        stats = _bench_decode(cfg, params, batch, prompt_len, decode_steps)
+        stats["model"] = model_kind
+        stats["params"] = llama.param_count(params)
+        stats["weight_gb"] = round(llama.param_bytes(params) / 1e9, 2)
+        stats["wall_s"] = round(time.time() - wall_start, 1)
+        return stats
 
     # vs_baseline only scores the config the 16k tok/s target was derived
     # from (8B-class); a tiny/1B ratio against an 8B target flatters
-    # (VERDICT r2 weak #2)
-    vs = (
-        round(decode["tokens_per_sec"] / PER_CHIP_TARGET_TOKS, 4)
-        if model_kind == "8b-int8" else None
+    # (VERDICT r2 weak #2); a CPU fallback (init_error) must not score at
+    # all — _phase_line already gates on on_tpu, and init_error rides along
+    headline = _phase_line(
+        f"llama_decode_tokens_per_sec_{model_kind}_bs{batch}_{platform}",
+        "tokens/s", run_decode, value_key="tokens_per_sec",
+        vs_of=(lambda s: (s["tokens_per_sec"] / PER_CHIP_TARGET_TOKS)
+               if model_kind == "8b-int8" else None),
+        on_tpu=on_tpu and not init_error, init_error=init_error,
     )
-    line = {
-        "metric": f"llama_decode_tokens_per_sec_{model_kind}_bs{batch}_{platform}",
-        "value": decode["tokens_per_sec"],
-        "unit": "tokens/s",
-        "vs_baseline": vs,
-        "details": {
-            "model": model_kind,
-            "params": n_params,
-            "weight_gb": round(weight_gb, 2),
-            **decode,
-            "engine": engine_stats,
-            "wall_s": round(time.time() - wall_start, 1),
-        },
-    }
-    if init_error:
-        line["error"] = init_error
-        line["vs_baseline"] = None  # a CPU number must not score vs the TPU target
-    print(json.dumps(line))
+    print(json.dumps(headline), flush=True)
+    lines = [headline]
+
+    # --- sustained engine + HTTP phases (reuse the live engine) -----------
+    engine = None
+
+    def run_engine() -> dict:
+        nonlocal engine
+        if params is None:
+            raise RuntimeError("skipped: headline phase failed to build params")
+        stats, engine = _engine_sustained(cfg, params, on_tpu)
+        return stats
+
+    eng_line = _phase_line(
+        f"engine_sustained_tok_per_s_{model_kind}_{platform}", "tokens/s",
+        run_engine, value_key="gen_tok_per_s",
+        # same unit as the value so value/vs_baseline/unit stay consistent
+        # across lines (code-review r4); req/s detail lives in details
+        vs_of=(lambda s: (s["gen_tok_per_s"] / PER_CHIP_TARGET_TOKS)
+               if model_kind == "8b-int8" else None),
+        on_tpu=on_tpu and not init_error, init_error=init_error,
+    )
+    print(json.dumps(eng_line), flush=True)
+    lines.append(eng_line)
+
+    def run_http() -> dict:
+        if engine is None:
+            raise RuntimeError("skipped: engine_sustained phase failed")
+        return _http_generate_load(engine, on_tpu)
+
+    http_line = _phase_line(
+        f"http_generate_req_per_s_{model_kind}_{platform}", "req/s",
+        run_http, value_key="req_per_s",
+        on_tpu=on_tpu and not init_error, init_error=init_error,
+    )
+    if engine is not None:
+        engine.stop()
+    print(json.dumps(http_line), flush=True)
+    lines.append(http_line)
+
+    # --- framework-only phases (no TPU dependence at all) ------------------
+    echo_line = _phase_line(
+        "grpc_unary_echo_req_per_s", "req/s", _grpc_unary_echo,
+        value_key="req_per_s",
+    )
+    print(json.dumps(echo_line), flush=True)
+    lines.append(echo_line)
+
+    bert_line = _phase_line(
+        f"bert_embed_http_req_per_s_{platform}", "req/s",
+        lambda: _bert_embed_http(on_tpu), value_key="req_per_s",
+        on_tpu=on_tpu, init_error=init_error,
+    )
+    print(json.dumps(bert_line), flush=True)
+    lines.append(bert_line)
 
     if on_tpu and not init_error:
-        _append_local_record(line)
+        for line in lines:
+            if "error" not in line:
+                _append_local_record(line)
 
 
 def _append_local_record(line: dict) -> None:
@@ -314,53 +711,6 @@ def _append_local_record(line: dict) -> None:
             f.write(json.dumps(rec) + "\n")
     except OSError as exc:  # read-only checkout must not kill the contract
         print(f"bench: could not append BENCH_LOCAL.jsonl: {exc}", file=sys.stderr)
-
-
-def _engine_load(cfg: Any, params: Any, on_tpu: bool) -> dict:
-    from gofr_tpu.serving import ByteTokenizer, EngineConfig, ServingEngine
-
-    n_requests = 32 if on_tpu else 6
-    max_new = 16 if on_tpu else 4
-    engine = ServingEngine(
-        cfg,
-        params,
-        EngineConfig(
-            max_slots=32 if on_tpu else 4,
-            max_seq_len=256 if on_tpu else 32,
-            prefill_buckets=(64,) if on_tpu else (16,),
-            admission_per_step=8 if on_tpu else 2,
-            max_queue=n_requests + 8,
-        ),
-        ByteTokenizer(cfg.vocab_size),
-        metrics=_engine_metrics(),
-    )
-    engine.start()
-    try:
-        # warm the two compiles (prefill bucket + decode step) off the clock
-        prompt_pad = "request padding " * 3 if on_tpu else "abc "
-        engine.submit(prompt_pad, max_new_tokens=2, temperature=0.0).result(timeout=600)
-        start = time.perf_counter()
-        futures = [
-            engine.submit(f"r{i} {prompt_pad}"[:60 if on_tpu else 12],
-                          max_new_tokens=max_new, temperature=0.0)
-            for i in range(n_requests)
-        ]
-        results = [f.result(timeout=600) for f in futures]
-        elapsed = time.perf_counter() - start
-    finally:
-        engine.stop()
-
-    # TTFT percentiles from the timed requests' own measurements — the
-    # warm-up request (which absorbs XLA compiles) must not pollute them
-    ttfts_ms = sorted(r.ttft_s * 1e3 for r in results)
-    gen_tokens = sum(r.completion_tokens for r in results)
-    return {
-        "requests": n_requests,
-        "req_per_s": round(n_requests / elapsed, 2),
-        "gen_tok_per_s": round(gen_tokens / elapsed, 2),
-        "ttft_p50_ms": round(ttfts_ms[len(ttfts_ms) // 2], 2),
-        "ttft_p95_ms": round(ttfts_ms[min(len(ttfts_ms) - 1, int(0.95 * len(ttfts_ms)))], 2),
-    }
 
 
 def _engine_metrics() -> Any:

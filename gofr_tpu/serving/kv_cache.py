@@ -155,10 +155,14 @@ class PagedKVCache:
         )
 
     def tables_device(self) -> jnp.ndarray:
-        return jnp.asarray(self.tables)
+        # .copy(): host→device transfers are async, and the engine's
+        # pipelined dispatch mutates self.tables (extend_slot) while the
+        # previous step's transfer may still be pending — upload a snapshot
+        # the host never touches again
+        return jnp.asarray(self.tables.copy())
 
     def seq_lens_device(self) -> jnp.ndarray:
-        return jnp.asarray(self.seq_lens)
+        return jnp.asarray(self.seq_lens.copy())
 
     def close(self) -> None:
         self.allocator.close()
