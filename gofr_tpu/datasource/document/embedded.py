@@ -81,6 +81,7 @@ class EmbeddedDocumentStore:
         # re-entrant: a session transaction holds the lock across its ops
         self._lock = threading.RLock()
         self._in_txn = False
+        self._txn_owner: Any = None  # the Session holding the open transaction
         self._logger: Any = None
         self._metrics: Any = None
         self._tracer: Any = None
@@ -277,8 +278,19 @@ class Session:
     def start_transaction(self) -> "Session":
         if self._active:
             raise RuntimeError("transaction already active on this session")
-        self._store._lock.acquire()
-        self._store._in_txn = True
+        store = self._store
+        store._lock.acquire()
+        if store._txn_owner is not None:
+            # the RLock is re-entrant, so a SECOND session on the same
+            # thread would silently join (and later commit) the first
+            # session's transaction — reject instead of breaking the outer
+            # transaction's atomicity (ADVICE r3)
+            store._lock.release()
+            raise RuntimeError(
+                "another session's transaction is already open on this store"
+            )
+        store._txn_owner = self
+        store._in_txn = True
         self._active = True
         return self
 
@@ -299,6 +311,7 @@ class Session:
                 store._conn.rollback()
         finally:
             store._in_txn = False
+            store._txn_owner = None
             self._active = False
             store._lock.release()
 

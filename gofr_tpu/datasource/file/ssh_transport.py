@@ -179,6 +179,7 @@ class SSHTransport:
         self.remote_channel = 0
         self._recv_window = WINDOW_SIZE  # what we granted the peer
         self._send_window = 0  # what the peer granted us
+        self._remote_max_packet = MAX_PACKET  # peer's advertised cap (RFC 4254 §5.2)
         self._inbox: list[bytes] = []  # decrypted CHANNEL_DATA payloads
         self._eof = False
 
@@ -453,7 +454,7 @@ class SSHTransport:
         r.uint32()  # recipient (us)
         self.remote_channel = r.uint32()
         self._send_window = r.uint32()
-        r.uint32()  # remote max packet
+        self._remote_max_packet = r.uint32() or MAX_PACKET
         self.send_packet(
             bytes([MSG_CHANNEL_REQUEST]) + u32(self.remote_channel)
             + sstr(b"subsystem") + b"\x01" + sstr(b"sftp")
@@ -508,7 +509,12 @@ class SSHTransport:
                     raise SSHError(
                         f"unexpected message {payload[0]} while blocked on window"
                     )
-            n = min(len(view), self._send_window, MAX_PACKET - 64)
+            # chunk bound honors the PEER's advertised maximum packet size
+            # (RFC 4254 §5.2), not just our own — a non-gofr server may
+            # negotiate a smaller cap (ADVICE r3). Floor of 1 keeps the
+            # loop progressing even against a broken peer advertising ≤64.
+            n = max(1, min(len(view), self._send_window,
+                           min(self._remote_max_packet, MAX_PACKET) - 64))
             self._send_window -= n
             chunk = bytes(view[:n])
             view = view[n:]
@@ -577,7 +583,7 @@ class SSHServerSession:
             raise SSHError("expected session channel open")
         t.remote_channel = r.uint32()
         t._send_window = r.uint32()
-        r.uint32()  # max packet
+        t._remote_max_packet = r.uint32() or MAX_PACKET
         t.send_packet(
             bytes([MSG_CHANNEL_OPEN_CONFIRMATION]) + u32(t.remote_channel)
             + u32(t.local_channel) + u32(WINDOW_SIZE) + u32(MAX_PACKET)

@@ -117,7 +117,10 @@ class Reader:
         return struct.unpack(">i", self.take(4))[0]
 
     def cstr(self) -> str:
-        end = self.data.index(b"\x00", self.pos)
+        try:
+            end = self.data.index(b"\x00", self.pos)
+        except ValueError:  # malformed frame must surface as a typed PgError
+            raise PgError({"M": "unterminated string in message"}) from None
         out = self.data[self.pos : end].decode()
         self.pos = end + 1
         return out

@@ -214,6 +214,20 @@ class TestDocumentTransactions:
             with pytest.raises(RuntimeError):
                 session.start_transaction()
 
+    def test_second_session_same_thread_rejected(self, store):
+        """A second Session on the SAME thread must not silently join (and
+        commit) the first session's transaction through the re-entrant
+        store lock (ADVICE r3): the outer transaction stays atomic."""
+        outer = store.start_session()
+        with outer.start_transaction():
+            outer.update_by_id("accounts", "x", {"$set": {"balance": 5}})
+            inner = store.start_session()
+            with pytest.raises(RuntimeError, match="another session"):
+                inner.start_transaction()
+            outer.abort_transaction()
+        # the abort really rolled back — the inner attempt committed nothing
+        assert store.find_one("accounts", {"_id": "x"}) is None
+
     def test_commit_without_begin_rejected(self, store):
         session = store.start_session()
         with pytest.raises(RuntimeError):
