@@ -91,6 +91,20 @@ class App:
                 self.logger.error(f"pubsub backend connect failed: {exc}")
                 self.container.pubsub = broker  # health_check reports DOWN
 
+        # SQL from config (container.go:128-130 builds c.SQL whenever the
+        # DB_* configs are present): DB_DIALECT selects sqlite/postgres/
+        # mysql (sql.go:212-237). A dark database at boot is DEGRADED
+        # health, not a crash — the keepalive loop reconnects.
+        if self.config.get("DB_DIALECT"):
+            from gofr_tpu.datasource.sql import new_sql
+
+            db = new_sql(self.config)
+            try:
+                self.container.register_datasource("sql", db)
+            except Exception as exc:
+                self.logger.error(f"sql backend connect failed: {exc}")
+                self.container.sql = db  # health_check reports DOWN
+
         if not is_cmd:
             self._register_defaults()
 

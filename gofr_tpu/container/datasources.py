@@ -256,6 +256,90 @@ class TimeSeriesStore(Protocol):
 
 
 @runtime_checkable
+class OracleDB(Protocol):
+    """Oracle-shaped contract (datasources.go:210-230); served by
+    datasource/compat.OracleFacade over any in-tree SQL dialect."""
+
+    def exec(self, query: str, *args: Any) -> None: ...
+
+    def select(self, dest: Any, query: str, *args: Any) -> Any: ...
+
+    def begin(self) -> Any: ...
+
+
+@runtime_checkable
+class SurrealDB(Protocol):
+    """SurrealDB-shaped contract (datasources.go:302-344); served by
+    datasource/compat.SurrealFacade over the document family."""
+
+    def create_namespace(self, namespace: str) -> None: ...
+
+    def create_database(self, database: str) -> None: ...
+
+    def drop_namespace(self, namespace: str) -> None: ...
+
+    def drop_database(self, database: str) -> None: ...
+
+    def query(self, query: str, vars: dict | None = None) -> list[Any]: ...
+
+    def create(self, table: str, data: dict) -> dict: ...
+
+    def update(self, table: str, id: str, data: dict) -> Any: ...
+
+    def delete(self, table: str, id: str) -> Any: ...
+
+    def select(self, table: str) -> list[dict]: ...
+
+
+@runtime_checkable
+class ArangoDB(Protocol):
+    """ArangoDB-shaped contract (datasources.go:637-706); served by
+    datasource/compat.ArangoFacade over the document + graph families."""
+
+    def create_db(self, database: str) -> None: ...
+
+    def drop_db(self, database: str) -> None: ...
+
+    def create_collection(self, database: str, collection: str, is_edge: bool) -> None: ...
+
+    def drop_collection(self, database: str, collection: str) -> None: ...
+
+    def create_graph(self, database: str, graph: str, edge_definitions: Any) -> None: ...
+
+    def drop_graph(self, database: str, graph: str) -> None: ...
+
+    def create_document(self, db_name: str, collection: str, document: dict) -> str: ...
+
+    def get_document(self, db_name: str, collection: str, document_id: str) -> dict | None: ...
+
+    def update_document(self, db_name: str, collection: str, document_id: str, document: dict) -> None: ...
+
+    def delete_document(self, db_name: str, collection: str, document_id: str) -> None: ...
+
+    def get_edges(self, db_name: str, graph_name: str, edge_collection: str, vertex_id: str) -> list[dict]: ...
+
+
+@runtime_checkable
+class Couchbase(Protocol):
+    """Couchbase-shaped contract (datasources.go:748-788); served by
+    datasource/compat.CouchbaseFacade over the document family."""
+
+    def get(self, key: str) -> dict | None: ...
+
+    def insert(self, key: str, document: dict) -> dict: ...
+
+    def upsert(self, key: str, document: dict) -> dict: ...
+
+    def remove(self, key: str) -> None: ...
+
+    def query(self, statement: str, params: dict | None = None) -> list[dict]: ...
+
+    def analytics_query(self, statement: str, params: dict | None = None) -> list[dict]: ...
+
+    def run_transaction(self, logic: Any) -> Any: ...
+
+
+@runtime_checkable
 class Cache(Protocol):
     """TPU-build addition: response/KV-prefix cache contract used by the
     serving layer (prefix cache reuse across requests)."""

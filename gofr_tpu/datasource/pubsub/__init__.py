@@ -16,8 +16,9 @@ from gofr_tpu.datasource.pubsub.memory import InMemoryBroker
 
 def build_pubsub(config):
     """PUBSUB_BACKEND switch (container/container.go:132-172): KAFKA |
-    MQTT | GOOGLE | MEMORY → a connected-contract client, or None when
-    unset (apps wire their own via app.add_datasource)."""
+    MQTT | GOOGLE | NATS | EVENTHUB | MEMORY → a connected-contract
+    client, or None when unset (apps wire their own via
+    app.add_datasource)."""
     backend = (config.get("PUBSUB_BACKEND") or "").strip().upper()
     if not backend:
         return None
@@ -35,6 +36,10 @@ def build_pubsub(config):
         from gofr_tpu.datasource.pubsub.nats import NatsClient
 
         return NatsClient.from_config(config)
+    if backend == "EVENTHUB":
+        from gofr_tpu.datasource.pubsub.eventhub import EventHubClient
+
+        return EventHubClient.from_config(config)
     if backend == "MEMORY":
         return InMemoryBroker.from_config(config)
     raise ValueError(f"unknown PUBSUB_BACKEND {backend!r}")

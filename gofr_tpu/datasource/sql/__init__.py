@@ -9,6 +9,8 @@ dataclasses (db.go:214-334), transactions (db.go:124-185), health
 
 from gofr_tpu.datasource.sql.sqlite import SQLite, new_sql
 from gofr_tpu.datasource.sql.postgres import PostgresDB
+from gofr_tpu.datasource.sql.mysql import MySQLDB
+from gofr_tpu.datasource.sql.pool import ConnectionPool, PoolTimeout
 from gofr_tpu.datasource.sql.query_builder import (
     delete_by_id_query,
     insert_query,
@@ -20,6 +22,9 @@ from gofr_tpu.datasource.sql.query_builder import (
 __all__ = [
     "SQLite",
     "PostgresDB",
+    "MySQLDB",
+    "ConnectionPool",
+    "PoolTimeout",
     "new_sql",
     "insert_query",
     "select_all_query",

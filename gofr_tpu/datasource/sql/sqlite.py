@@ -192,9 +192,10 @@ class SQLite:
 
 
 def new_sql(config: Any) -> Any:
-    """Dialect dispatch (sql.go:212-237): sqlite (embedded) and postgres
-    (own v3 wire client, sql/postgres.py) ship in-tree; other dialects
-    raise with a clear message so apps fail fast."""
+    """Dialect dispatch (sql.go:212-237): sqlite (embedded), postgres
+    (own v3 wire client, sql/postgres.py), and mysql (own 4.1 wire
+    client, sql/mysql.py) ship in-tree; other dialects raise with a
+    clear message so apps fail fast."""
     dialect = config.get_or_default("DB_DIALECT", "sqlite").lower()
     if dialect == "sqlite":
         return SQLite.from_config(config)
@@ -203,7 +204,11 @@ def new_sql(config: Any) -> Any:
         from gofr_tpu.datasource.sql.postgres import PostgresDB
 
         return PostgresDB.from_config(config)
+    if dialect in ("mysql", "mariadb"):
+        from gofr_tpu.datasource.sql.mysql import MySQLDB
+
+        return MySQLDB.from_config(config)
     raise ValueError(
         f"DB_DIALECT={dialect} requires an external driver module; "
-        "in-tree dialects: sqlite, postgres"
+        "in-tree dialects: sqlite, postgres, mysql"
     )

@@ -78,6 +78,10 @@ def init_params(cfg: WhisperConfig, key: jax.Array) -> dict:
             "wq": winit(kk[0], (La, D, D), D), "wk": winit(kk[1], (La, D, D), D),
             "wv": winit(kk[2], (La, D, D), D), "wo": winit(kk[3], (La, D, D), D),
             "w1": winit(kk[4], (La, D, F), D), "w2": winit(kk[5], (La, F, D), F),
+            # q/v/o and MLP carry biases (k_proj has none — Whisper layout)
+            "bq": jnp.zeros((La, D), jnp.float32), "bv": jnp.zeros((La, D), jnp.float32),
+            "bo": jnp.zeros((La, D), jnp.float32),
+            "b1": jnp.zeros((La, F), jnp.float32), "b2": jnp.zeros((La, D), jnp.float32),
             "ln1_s": jnp.ones((La, D), jnp.float32), "ln1_b": jnp.zeros((La, D), jnp.float32),
             "ln2_s": jnp.ones((La, D), jnp.float32), "ln2_b": jnp.zeros((La, D), jnp.float32),
         }
@@ -90,6 +94,11 @@ def init_params(cfg: WhisperConfig, key: jax.Array) -> dict:
             "xwq": winit(kk[4], (Lt, D, D), D), "xwk": winit(kk[5], (Lt, D, D), D),
             "xwv": winit(kk[6], (Lt, D, D), D), "xwo": winit(kk[7], (Lt, D, D), D),
             "w1": winit(kk[8], (Lt, D, F), D), "w2": winit(kk[9], (Lt, F, D), F),
+            "bq": jnp.zeros((Lt, D), jnp.float32), "bv": jnp.zeros((Lt, D), jnp.float32),
+            "bo": jnp.zeros((Lt, D), jnp.float32),
+            "xbq": jnp.zeros((Lt, D), jnp.float32), "xbv": jnp.zeros((Lt, D), jnp.float32),
+            "xbo": jnp.zeros((Lt, D), jnp.float32),
+            "b1": jnp.zeros((Lt, F), jnp.float32), "b2": jnp.zeros((Lt, D), jnp.float32),
             "ln1_s": jnp.ones((Lt, D), jnp.float32), "ln1_b": jnp.zeros((Lt, D), jnp.float32),
             "lnx_s": jnp.ones((Lt, D), jnp.float32), "lnx_b": jnp.zeros((Lt, D), jnp.float32),
             "ln2_s": jnp.ones((Lt, D), jnp.float32), "ln2_b": jnp.zeros((Lt, D), jnp.float32),
@@ -112,11 +121,14 @@ def init_params(cfg: WhisperConfig, key: jax.Array) -> dict:
 
 
 def _conv1d(x: jnp.ndarray, w: jnp.ndarray, b: jnp.ndarray, stride: int) -> jnp.ndarray:
-    """[B, T, Cin] * [K, Cin, Cout] -> [B, T', Cout], SAME padding."""
+    """[B, T, Cin] * [K, Cin, Cout] -> [B, T', Cout]. Symmetric padding 1
+    (the published Whisper conv layout) — JAX's "SAME" pads stride-2
+    convs asymmetrically and shifts the sampling grid off the reference
+    weights' expectations."""
     out = jax.lax.conv_general_dilated(
         x, w,
         window_strides=(stride,),
-        padding="SAME",
+        padding=[(1, 1)],
         dimension_numbers=("NWC", "WIO", "NWC"),
     )
     return out + b.astype(out.dtype)
@@ -126,8 +138,8 @@ def _conv1d(x: jnp.ndarray, w: jnp.ndarray, b: jnp.ndarray, stride: int) -> jnp.
 def encode_audio(cfg: WhisperConfig, params: dict, mel: jnp.ndarray) -> jnp.ndarray:
     """[B, T_frames, n_mels] -> encoder states [B, T', D] (T' = T/2)."""
     x = mel.astype(cfg.dtype)
-    x = jax.nn.gelu(_conv1d(x, params["conv1"], params["conv1_b"], 1).astype(jnp.float32)).astype(cfg.dtype)
-    x = jax.nn.gelu(_conv1d(x, params["conv2"], params["conv2_b"], 2).astype(jnp.float32)).astype(cfg.dtype)
+    x = jax.nn.gelu(_conv1d(x, params["conv1"], params["conv1_b"], 1).astype(jnp.float32), approximate=False).astype(cfg.dtype)
+    x = jax.nn.gelu(_conv1d(x, params["conv2"], params["conv2_b"], 2).astype(jnp.float32), approximate=False).astype(cfg.dtype)
     T = x.shape[1]
     x = x + _sinusoids(T, cfg.d_model).astype(cfg.dtype)[None]
 
@@ -136,14 +148,17 @@ def encode_audio(cfg: WhisperConfig, params: dict, mel: jnp.ndarray) -> jnp.ndar
     def body(h, lp):
         B, S, D = h.shape
         a = layer_norm(h, lp["ln1_s"], lp["ln1_b"], cfg.norm_eps)
-        q = (a @ lp["wq"]).reshape(B, S, H, Dh)
-        k = (a @ lp["wk"]).reshape(B, S, H, Dh)
-        v = (a @ lp["wv"]).reshape(B, S, H, Dh)
+        q = (a @ lp["wq"] + lp["bq"].astype(a.dtype)).reshape(B, S, H, Dh)
+        k = (a @ lp["wk"]).reshape(B, S, H, Dh)  # k_proj has no bias
+        v = (a @ lp["wv"] + lp["bv"].astype(a.dtype)).reshape(B, S, H, Dh)
         attn = attention(q, k, v, causal=False).reshape(B, S, D)
-        h = h + attn @ lp["wo"]
+        h = h + attn @ lp["wo"] + lp["bo"].astype(h.dtype)
         m = layer_norm(h, lp["ln2_s"], lp["ln2_b"], cfg.norm_eps)
-        inter = jax.nn.gelu((m @ lp["w1"]).astype(jnp.float32)).astype(m.dtype)
-        h = h + inter @ lp["w2"]
+        inter = jax.nn.gelu(
+            (m @ lp["w1"] + lp["b1"].astype(m.dtype)).astype(jnp.float32),
+            approximate=False,  # Whisper uses exact (erf) GELU
+        ).astype(m.dtype)
+        h = h + inter @ lp["w2"] + lp["b2"].astype(h.dtype)
         return h, None
 
     x, _ = jax.lax.scan(body, x, params["enc"])
@@ -188,25 +203,28 @@ def decode_text_step(
     def body(h, xs):
         lp, kc, vc = xs
         a = layer_norm(h, lp["ln1_s"], lp["ln1_b"], cfg.norm_eps)
-        q = (a @ lp["wq"]).reshape(B, 1, H, Dh)
-        k = (a @ lp["wk"]).reshape(B, 1, H, Dh)
-        v = (a @ lp["wv"]).reshape(B, 1, H, Dh)
+        q = (a @ lp["wq"] + lp["bq"].astype(a.dtype)).reshape(B, 1, H, Dh)
+        k = (a @ lp["wk"]).reshape(B, 1, H, Dh)  # k_proj has no bias
+        v = (a @ lp["wv"] + lp["bv"].astype(a.dtype)).reshape(B, 1, H, Dh)
         b_idx = jnp.arange(B)
         kc = kc.at[b_idx, pos].set(k[:, 0])
         vc = vc.at[b_idx, pos].set(v[:, 0])
         attn = decode_attention(q, kc, vc, pos + 1).reshape(B, 1, D)
-        h = h + attn @ lp["wo"]
+        h = h + attn @ lp["wo"] + lp["bo"].astype(h.dtype)
 
         xa = layer_norm(h, lp["lnx_s"], lp["lnx_b"], cfg.norm_eps)
-        xq = (xa @ lp["xwq"]).reshape(B, 1, H, Dh)
+        xq = (xa @ lp["xwq"] + lp["xbq"].astype(xa.dtype)).reshape(B, 1, H, Dh)
         xk = (enc_states @ lp["xwk"]).reshape(B, -1, H, Dh)
-        xv = (enc_states @ lp["xwv"]).reshape(B, -1, H, Dh)
+        xv = (enc_states @ lp["xwv"] + lp["xbv"].astype(enc_states.dtype)).reshape(B, -1, H, Dh)
         xattn = attention(xq, xk, xv, causal=False).reshape(B, 1, D)
-        h = h + xattn @ lp["xwo"]
+        h = h + xattn @ lp["xwo"] + lp["xbo"].astype(h.dtype)
 
         m = layer_norm(h, lp["ln2_s"], lp["ln2_b"], cfg.norm_eps)
-        inter = jax.nn.gelu((m @ lp["w1"]).astype(jnp.float32)).astype(m.dtype)
-        h = h + inter @ lp["w2"]
+        inter = jax.nn.gelu(
+            (m @ lp["w1"] + lp["b1"].astype(m.dtype)).astype(jnp.float32),
+            approximate=False,
+        ).astype(m.dtype)
+        h = h + inter @ lp["w2"] + lp["b2"].astype(h.dtype)
         return h, (kc, vc)
 
     x, (nk, nv) = jax.lax.scan(body, x, (params["dec"], cache.k, cache.v))

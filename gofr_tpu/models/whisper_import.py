@@ -1,0 +1,151 @@
+"""HF-layout Whisper checkpoint import (VERDICT r3 weak #7).
+
+Loads ``WhisperForConditionalGeneration`` safetensors weights (the
+openai/whisper-* layout) into this repo's scan-stacked param tree
+(models/whisper.py) — the ASR twin of models/hf_import.load_llama_from_hf,
+so BASELINE configs[3] (Whisper via Pub/Sub) serves real checkpoints,
+not just random weights.
+
+Layout mapping (HF module path → our tree):
+- ``model.encoder.conv{1,2}.weight`` [D, Cin, K] → ``conv{1,2}`` [K, Cin, D]
+- ``model.encoder.layers.N.self_attn.{q,k,v,out}_proj`` → enc ``wq/wk/wv/wo``
+  (weights transposed to right-multiply form; k_proj has no bias)
+- ``model.decoder.layers.N.encoder_attn.*`` → dec ``xw*`` (cross-attention)
+- ``model.decoder.embed_tokens.weight`` → ``tok_embedding`` (tied proj_out)
+- ``model.decoder.embed_positions.weight`` → ``pos_embedding`` (learned)
+- encoder positions are NOT loaded: HF stores the same deterministic
+  sinusoid table models/whisper.py computes on the fly
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from gofr_tpu.models.hf_import import _open_checkpoint, jnp_dtype
+from gofr_tpu.models.whisper import WhisperConfig
+
+
+def whisper_config_from_hf(path: str, fs: Any = None, **overrides: Any) -> WhisperConfig:
+    cfg_path = os.path.join(path, "config.json")
+    if fs is not None and hasattr(fs, "open"):
+        with fs.open(cfg_path, "rb") as f:
+            raw = json.loads(f.read())
+    else:
+        with open(cfg_path) as f:
+            raw = json.load(f)
+    fields = dict(
+        n_mels=raw["num_mel_bins"],
+        vocab_size=raw["vocab_size"],
+        d_model=raw["d_model"],
+        n_audio_layers=raw["encoder_layers"],
+        n_text_layers=raw["decoder_layers"],
+        n_heads=raw["encoder_attention_heads"],
+        d_ff=raw["encoder_ffn_dim"],
+        max_audio_len=raw.get("max_source_positions", 1500),
+        max_text_len=raw.get("max_target_positions", 448),
+        sot_id=raw.get("decoder_start_token_id", 50258),
+        eot_id=raw.get("eos_token_id", 50257),
+    )
+    fields.update(overrides)
+    return WhisperConfig(**fields)
+
+
+def load_whisper_from_hf(
+    path: str,
+    *,
+    dtype: Any = None,
+    fs: Any = None,
+    **config_overrides: Any,
+) -> tuple[WhisperConfig, dict]:
+    """(cfg, params) from an HF Whisper checkpoint directory."""
+    cfg = whisper_config_from_hf(path, fs=fs, **config_overrides)
+    if dtype is not None:
+        cfg = WhisperConfig(**{**cfg.__dict__, "dtype": jnp_dtype(dtype)})
+    raw = _open_checkpoint(path, fs=fs)
+
+    def t(name: str) -> np.ndarray:
+        # some exports prefix everything with "model."
+        if name in raw:
+            return raw[name]
+        if "model." + name in raw:
+            return raw["model." + name]
+        raise KeyError(f"missing tensor {name}")
+
+    wdt = cfg.dtype
+
+    def wstack(fmt: str, n: int, transpose: bool = True) -> jnp.ndarray:
+        mats = [t(fmt.format(i)) for i in range(n)]
+        arr = np.stack([m.T if transpose else m for m in mats])
+        return jnp.asarray(arr, wdt)
+
+    def bstack(fmt: str, n: int) -> jnp.ndarray:
+        return jnp.asarray(np.stack([t(fmt.format(i)) for i in range(n)]), jnp.float32)
+
+    La, Lt = cfg.n_audio_layers, cfg.n_text_layers
+    e = "encoder.layers.{}."
+    d = "decoder.layers.{}."
+
+    enc = {
+        "wq": wstack(e + "self_attn.q_proj.weight", La),
+        "wk": wstack(e + "self_attn.k_proj.weight", La),
+        "wv": wstack(e + "self_attn.v_proj.weight", La),
+        "wo": wstack(e + "self_attn.out_proj.weight", La),
+        "bq": bstack(e + "self_attn.q_proj.bias", La),
+        "bv": bstack(e + "self_attn.v_proj.bias", La),
+        "bo": bstack(e + "self_attn.out_proj.bias", La),
+        "w1": wstack(e + "fc1.weight", La),
+        "b1": bstack(e + "fc1.bias", La),
+        "w2": wstack(e + "fc2.weight", La),
+        "b2": bstack(e + "fc2.bias", La),
+        "ln1_s": bstack(e + "self_attn_layer_norm.weight", La),
+        "ln1_b": bstack(e + "self_attn_layer_norm.bias", La),
+        "ln2_s": bstack(e + "final_layer_norm.weight", La),
+        "ln2_b": bstack(e + "final_layer_norm.bias", La),
+    }
+    dec = {
+        "wq": wstack(d + "self_attn.q_proj.weight", Lt),
+        "wk": wstack(d + "self_attn.k_proj.weight", Lt),
+        "wv": wstack(d + "self_attn.v_proj.weight", Lt),
+        "wo": wstack(d + "self_attn.out_proj.weight", Lt),
+        "bq": bstack(d + "self_attn.q_proj.bias", Lt),
+        "bv": bstack(d + "self_attn.v_proj.bias", Lt),
+        "bo": bstack(d + "self_attn.out_proj.bias", Lt),
+        "xwq": wstack(d + "encoder_attn.q_proj.weight", Lt),
+        "xwk": wstack(d + "encoder_attn.k_proj.weight", Lt),
+        "xwv": wstack(d + "encoder_attn.v_proj.weight", Lt),
+        "xwo": wstack(d + "encoder_attn.out_proj.weight", Lt),
+        "xbq": bstack(d + "encoder_attn.q_proj.bias", Lt),
+        "xbv": bstack(d + "encoder_attn.v_proj.bias", Lt),
+        "xbo": bstack(d + "encoder_attn.out_proj.bias", Lt),
+        "w1": wstack(d + "fc1.weight", Lt),
+        "b1": bstack(d + "fc1.bias", Lt),
+        "w2": wstack(d + "fc2.weight", Lt),
+        "b2": bstack(d + "fc2.bias", Lt),
+        "ln1_s": bstack(d + "self_attn_layer_norm.weight", Lt),
+        "ln1_b": bstack(d + "self_attn_layer_norm.bias", Lt),
+        "lnx_s": bstack(d + "encoder_attn_layer_norm.weight", Lt),
+        "lnx_b": bstack(d + "encoder_attn_layer_norm.bias", Lt),
+        "ln2_s": bstack(d + "final_layer_norm.weight", Lt),
+        "ln2_b": bstack(d + "final_layer_norm.bias", Lt),
+    }
+    params = {
+        # HF Conv1d weight [out, in, k] → our [k, in, out]
+        "conv1": jnp.asarray(t("encoder.conv1.weight").transpose(2, 1, 0), wdt),
+        "conv1_b": jnp.asarray(t("encoder.conv1.bias"), jnp.float32),
+        "conv2": jnp.asarray(t("encoder.conv2.weight").transpose(2, 1, 0), wdt),
+        "conv2_b": jnp.asarray(t("encoder.conv2.bias"), jnp.float32),
+        "enc": enc,
+        "enc_ln_s": jnp.asarray(t("encoder.layer_norm.weight"), jnp.float32),
+        "enc_ln_b": jnp.asarray(t("encoder.layer_norm.bias"), jnp.float32),
+        "tok_embedding": jnp.asarray(t("decoder.embed_tokens.weight"), wdt),
+        "pos_embedding": jnp.asarray(t("decoder.embed_positions.weight"), wdt),
+        "dec": dec,
+        "dec_ln_s": jnp.asarray(t("decoder.layer_norm.weight"), jnp.float32),
+        "dec_ln_b": jnp.asarray(t("decoder.layer_norm.bias"), jnp.float32),
+    }
+    return cfg, params

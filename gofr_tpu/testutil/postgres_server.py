@@ -34,6 +34,8 @@ class MiniPostgresServer:
         self._db.row_factory = sqlite3.Row
         self._db.isolation_level = None
         self._db_lock = threading.Lock()
+        self._conns: list[socket.socket] = []
+        self._conns_lock = threading.Lock()
         self._running = True
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -50,6 +52,20 @@ class MiniPostgresServer:
         except OSError:
             pass
 
+    def kill_connections(self) -> None:
+        """Sever every live session (reconnect-after-kill tests)."""
+        with self._conns_lock:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
     # -- connection handling ----------------------------------------------
     def _accept_loop(self) -> None:
         while self._running:
@@ -57,6 +73,8 @@ class MiniPostgresServer:
                 conn, _ = self._server.accept()
             except OSError:
                 return
+            with self._conns_lock:
+                self._conns.append(conn)
             threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
 
     def _serve(self, conn: socket.socket) -> None:

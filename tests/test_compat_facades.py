@@ -1,0 +1,180 @@
+"""Vendor-interface facades (VERDICT r3 missing #6): Oracle / SurrealDB /
+ArangoDB / Couchbase method surfaces (datasources.go:210-230, :302-344,
+:637-706, :748-788) delegating to the family engines — shape-complete on
+top of the capability-complete families, and satisfying the container
+Protocols.
+"""
+
+import pytest
+
+from gofr_tpu.container.datasources import (
+    ArangoDB,
+    Couchbase,
+    OracleDB,
+    SurrealDB,
+)
+from gofr_tpu.datasource.compat import (
+    ArangoFacade,
+    CouchbaseFacade,
+    OracleFacade,
+    SurrealFacade,
+)
+from gofr_tpu.datasource.document import EmbeddedDocumentStore
+from gofr_tpu.datasource.graph import EmbeddedGraph
+from gofr_tpu.datasource.sql import SQLite
+
+
+@pytest.fixture()
+def document():
+    d = EmbeddedDocumentStore()
+    d.connect()
+    return d
+
+
+def test_oracle_facade_exec_select_begin():
+    import dataclasses
+
+    sql = SQLite(":memory:")
+    sql.connect()
+    ora = OracleFacade(sql)
+    ora.connect()
+    assert isinstance(ora, OracleDB)
+
+    ora.exec("CREATE TABLE emp (id INTEGER PRIMARY KEY, name TEXT)")
+    ora.exec("INSERT INTO emp VALUES (?, ?)", 1, "scott")
+
+    @dataclasses.dataclass
+    class Emp:
+        id: int
+        name: str
+
+    assert ora.select(Emp, "SELECT id, name FROM emp") == [Emp(1, "scott")]
+
+    tx = ora.begin()
+    tx.exec_context("INSERT INTO emp VALUES (?, ?)", 2, "tiger")
+    tx.commit()
+    assert len(ora.select(Emp, "SELECT id, name FROM emp")) == 2
+
+    tx = ora.begin()
+    tx.exec_context("DELETE FROM emp")
+    tx.rollback()
+    assert len(ora.select(Emp, "SELECT id, name FROM emp")) == 2
+    assert ora.health_check()["status"] == "UP"
+    assert ora.health_check()["details"]["facade"] == "oracle"
+
+
+def test_surreal_facade_crud_and_query(document):
+    surreal = SurrealFacade(document)
+    surreal.connect()
+    assert isinstance(surreal, SurrealDB)
+
+    surreal.create_namespace("app")
+    surreal.create_database("prod")
+    surreal.use("app", "prod")
+
+    created = surreal.create("person", {"name": "ada", "role": "eng"})
+    assert created["_id"].startswith("person:")
+    surreal.create("person", {"name": "alan", "role": "eng"})
+
+    rows = surreal.select("person")
+    assert {r["name"] for r in rows} == {"ada", "alan"}
+
+    got = surreal.query("SELECT * FROM person WHERE name = $n", {"n": "ada"})
+    assert len(got) == 1 and got[0]["role"] == "eng"
+
+    updated = surreal.update("person", created["_id"], {"role": "founder"})
+    assert updated["role"] == "founder"
+    surreal.delete("person", created["_id"])
+    assert len(surreal.select("person")) == 1
+
+    # different database → different records
+    surreal.use("app", "staging")
+    assert surreal.select("person") == []
+    with pytest.raises(ValueError):
+        surreal.query("DELETE person")  # outside the supported core
+    assert surreal.health_check()["details"]["facade"] == "surrealdb"
+
+
+def test_arango_facade_documents_and_edges(document):
+    graph = EmbeddedGraph()
+    graph.connect()
+    arango = ArangoFacade(document, graph)
+    arango.connect()
+    assert isinstance(arango, ArangoDB)
+
+    arango.create_db("social")
+    arango.create_collection("social", "persons", is_edge=False)
+    arango.create_collection("social", "knows", is_edge=True)
+    arango.create_graph("social", "friends", {"edge_collection": "knows"})
+    with pytest.raises(ValueError):
+        arango.create_graph("social", "bad", None)  # nil edgeDefinitions
+
+    p1 = arango.create_document("social", "persons", {"name": "ada"})
+    p2 = arango.create_document("social", "persons", {"name": "alan"})
+    arango.create_document("social", "knows", {"_from": p1, "_to": p2})
+
+    doc = arango.get_document("social", "persons", p1)
+    assert doc["name"] == "ada"
+    arango.update_document("social", "persons", p1, {"name": "ada lovelace"})
+    assert arango.get_document("social", "persons", p1)["name"] == "ada lovelace"
+
+    edges = arango.get_edges("social", "friends", "knows", p1)
+    assert len(edges) == 1 and edges[0]["_to"] == p2
+    # edges are visible from both endpoints
+    assert len(arango.get_edges("social", "friends", "knows", p2)) == 1
+
+    arango.delete_document("social", "persons", p2)
+    assert arango.get_document("social", "persons", p2) is None
+    arango.drop_graph("social", "friends")
+    arango.drop_collection("social", "persons")
+    assert arango.health_check()["details"]["facade"] == "arangodb"
+
+
+def test_couchbase_facade_kv_query_txn(document):
+    cb = CouchbaseFacade(document, bucket="apps")
+    cb.connect()
+    assert isinstance(cb, Couchbase)
+
+    cb.insert("u:1", {"name": "ada", "plan": "pro"})
+    with pytest.raises(KeyError):
+        cb.insert("u:1", {"name": "dup"})
+    cb.upsert("u:2", {"name": "alan", "plan": "free"})
+    cb.upsert("u:2", {"name": "alan", "plan": "pro"})  # replace
+
+    assert cb.get("u:1") == {"name": "ada", "plan": "pro"}
+    assert cb.get("u:2")["plan"] == "pro"
+    assert cb.get("missing") is None
+
+    rows = cb.query("SELECT * FROM `apps` WHERE plan = $p", {"p": "pro"})
+    assert len(rows) == 2
+    assert cb.analytics_query("SELECT * FROM apps") == cb.query("SELECT * FROM apps")
+
+    # transaction: abort on exception rolls everything back
+    def bad_logic(session):
+        session.update_by_id("apps", "u:1", {"$set": {"plan": "canceled"}})
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError):
+        cb.run_transaction(bad_logic)
+    assert cb.get("u:1")["plan"] == "pro"  # rolled back
+
+    cb.remove("u:2")
+    assert cb.get("u:2") is None
+    assert cb.health_check()["details"]["facade"] == "couchbase"
+
+
+def test_couchbase_upsert_replaces_whole_document(document):
+    cb = CouchbaseFacade(document, bucket="r")
+    cb.upsert("k", {"a": 1, "b": 2})
+    cb.upsert("k", {"a": 9})
+    assert cb.get("k") == {"a": 9}  # 'b' must be gone — replace, not merge
+
+
+def test_surreal_create_after_delete_no_id_collision(document):
+    surreal = SurrealFacade(document)
+    a = surreal.create("t", {"n": 1})
+    surreal.create("t", {"n": 2})
+    surreal.delete("t", a["_id"])
+    c = surreal.create("t", {"n": 3})  # must not collide with survivor
+    assert len(surreal.select("t")) == 2
+    assert c["_id"] != a["_id"]

@@ -162,3 +162,64 @@ def test_null_first_row_keeps_column_type(db):
     rows = db.query("SELECT v FROM nully ORDER BY id")
     assert rows[0]["v"] is None
     assert rows[1]["v"] == 42 and isinstance(rows[1]["v"], int)
+
+
+def test_pool_exhaustion_and_reconnect(server):
+    """Pool contract on the postgres dialect too (sql.go:92-174): an
+    exhausted pool times out with a typed error; killed sessions heal via
+    the ErrBadConn-style retry and the keepalive loop."""
+    import time as _time
+
+    from gofr_tpu.datasource.sql.pool import PoolTimeout
+    from gofr_tpu.datasource.sql.postgres import PostgresDB
+
+    db = PostgresDB(
+        host="127.0.0.1", port=server.port, user=server.user,
+        password=server.password, database=server.database,
+        max_open_conns=1, ping_interval=0.2,
+    )
+    db.connect()
+    try:
+        db._pool.checkout_timeout = 0.3
+        tx = db.begin()  # pins the only connection
+        with pytest.raises(PoolTimeout):
+            db.query("SELECT 1")
+        tx.rollback()
+        assert db.query_row("SELECT 1 AS one")["one"] == 1
+
+        server.kill_connections()
+        deadline = _time.time() + 10
+        ok = False
+        while _time.time() < deadline:
+            try:
+                ok = db.query_row("SELECT 1 AS one")["one"] == 1
+                break
+            except Exception:
+                _time.sleep(0.05)
+        assert ok, "postgres driver never recovered after connection kill"
+    finally:
+        db.close()
+
+
+def test_tx_survives_server_side_sql_error(server):
+    """A clean server-side SQL error inside a transaction must NOT finish
+    the transaction or shred the pinned connection (code-review r4: the
+    PgError-is-ConnectionError trap) — the caller decides to rollback."""
+    from gofr_tpu.datasource.sql.pg_wire import PgError
+    from gofr_tpu.datasource.sql.postgres import PostgresDB
+
+    db = PostgresDB(host="127.0.0.1", port=server.port, user=server.user,
+                    password=server.password, database=server.database)
+    db.connect()
+    try:
+        db.exec("CREATE TABLE IF NOT EXISTS txerr (id INTEGER PRIMARY KEY)")
+        tx = db.begin()
+        with pytest.raises(PgError):
+            tx.exec("SELECT * FROM definitely_missing_table")
+        # transaction still open and usable → rollback cleanly
+        tx.rollback()
+        open_before = db.pool_stats()["open"]
+        assert db.query_row("SELECT 1 AS one")["one"] == 1
+        assert db.pool_stats()["open"] == open_before  # conn not shredded
+    finally:
+        db.close()

@@ -1,0 +1,128 @@
+"""Whisper real-weights oracle (VERDICT r3 weak #7): an HF-layout
+``WhisperForConditionalGeneration`` checkpoint loaded via
+``load_whisper_from_hf``, validated against transformers — encoder
+states numerically, greedy transcription token-for-token — mirroring
+tests/test_serving_real_model.py for the ASR family (configs[3]).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+pytest.importorskip("transformers")
+
+import jax.numpy as jnp  # noqa: E402
+
+from gofr_tpu.models import whisper  # noqa: E402
+from gofr_tpu.models.whisper_import import load_whisper_from_hf  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def real_whisper_dir(tmp_path_factory):
+    from transformers import WhisperConfig as HFWhisperConfig
+    from transformers import WhisperForConditionalGeneration
+
+    torch.manual_seed(11)
+    hf_cfg = HFWhisperConfig(
+        vocab_size=96,
+        num_mel_bins=16,
+        d_model=32,
+        encoder_layers=2,
+        decoder_layers=2,
+        encoder_attention_heads=4,
+        decoder_attention_heads=4,
+        encoder_ffn_dim=64,
+        decoder_ffn_dim=64,
+        max_source_positions=24,  # frames after the stride-2 conv
+        max_target_positions=16,
+        decoder_start_token_id=1,
+        eos_token_id=2,
+        pad_token_id=0,
+        activation_function="gelu",
+        attn_implementation="eager",
+    )
+    model = WhisperForConditionalGeneration(hf_cfg).eval()
+    path = tmp_path_factory.mktemp("real_whisper")
+    model.save_pretrained(str(path), safe_serialization=True)
+    return str(path), model, hf_cfg
+
+
+def _mel(hf_cfg, frames: int = 48, batch: int = 2):
+    rng = np.random.default_rng(3)
+    return rng.standard_normal((batch, frames, hf_cfg.num_mel_bins)).astype(np.float32)
+
+
+def test_config_and_params_import(real_whisper_dir):
+    path, _, hf_cfg = real_whisper_dir
+    cfg, params = load_whisper_from_hf(path, dtype=jnp.float32)
+    assert cfg.n_mels == hf_cfg.num_mel_bins
+    assert cfg.d_model == hf_cfg.d_model
+    assert cfg.n_audio_layers == hf_cfg.encoder_layers
+    assert cfg.sot_id == hf_cfg.decoder_start_token_id
+    assert cfg.eot_id == hf_cfg.eos_token_id
+    assert params["enc"]["wq"].shape == (2, 32, 32)
+    assert params["conv1"].shape == (3, 16, 32)
+
+
+def test_encoder_states_match_hf(real_whisper_dir):
+    path, model, hf_cfg = real_whisper_dir
+    cfg, params = load_whisper_from_hf(path, dtype=jnp.float32)
+    mel = _mel(hf_cfg)
+
+    ours = np.asarray(whisper.encode_audio(cfg, params, jnp.asarray(mel)))
+    with torch.no_grad():
+        # HF expects [B, n_mels, T]
+        theirs = model.model.encoder(
+            torch.from_numpy(mel.transpose(0, 2, 1))
+        ).last_hidden_state.numpy()
+    assert ours.shape == theirs.shape
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-3)
+
+
+def test_greedy_transcription_matches_hf_oracle(real_whisper_dir):
+    """Token-for-token greedy equality with a manual transformers decode
+    loop (no forced/suppressed tokens — raw model semantics)."""
+    path, model, hf_cfg = real_whisper_dir
+    cfg, params = load_whisper_from_hf(path, dtype=jnp.float32)
+    mel = _mel(hf_cfg)
+    max_new = 8
+
+    ours = whisper.transcribe(cfg, params, jnp.asarray(mel), max_tokens=max_new)
+
+    with torch.no_grad():
+        enc = model.model.encoder(torch.from_numpy(mel.transpose(0, 2, 1)))
+        dec_input = torch.full((mel.shape[0], 1), hf_cfg.decoder_start_token_id,
+                               dtype=torch.long)
+        for _ in range(max_new):
+            out = model(encoder_outputs=enc, decoder_input_ids=dec_input)
+            nxt = out.logits[:, -1].argmax(-1, keepdim=True)
+            dec_input = torch.cat([dec_input, nxt], dim=1)
+    oracle_rows = dec_input[:, 1:].numpy()
+
+    for row_ours, row_hf in zip(ours, oracle_rows):
+        want: list[int] = []
+        for t in row_hf:
+            if int(t) == hf_cfg.eos_token_id:
+                break
+            want.append(int(t))
+        assert row_ours == want, (row_ours, list(row_hf))
+
+
+def test_asr_pipeline_serves_real_checkpoint(real_whisper_dir):
+    """The async ASR worker path (serving/asr.py) on imported weights:
+    raw audio → log-mel frontend → transcription, deterministic."""
+    from gofr_tpu.serving.asr import ASRWorker
+
+    path, _, hf_cfg = real_whisper_dir
+    cfg, params = load_whisper_from_hf(path, dtype=jnp.float32)
+    worker = ASRWorker(cfg, params)
+    rng = np.random.default_rng(5)
+    audio = rng.standard_normal(8000).astype(np.float32)
+    job = {"id": "j1", "audio": audio.tolist(), "max_tokens": 6}
+    result = worker.transcribe_job(job)
+    assert result["id"] == "j1"
+    assert isinstance(result["token_ids"], list)
+    # deterministic: same input → same tokens
+    assert worker.transcribe_job(job)["token_ids"] == result["token_ids"]
