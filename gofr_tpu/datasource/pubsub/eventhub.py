@@ -110,9 +110,10 @@ class EventHubClient:
         self._handles = itertools.count(0)
         self._delivery_ids = itertools.count(0)
         self._links: dict[int, _Link] = {}  # local handle → link
+        self._links_by_remote: dict[int, _Link] = {}  # peer handle → link
         self._senders: dict[str, _Link] = {}  # address → sender link
         self._receivers: dict[str, list[_Link]] = {}  # topic → receiver links
-        self._incoming: dict[str, "queue.Queue[tuple[int, bytes]]"] = {}
+        self._rr_start: dict[str, int] = {}  # topic → next partition to poll
         self._next_outgoing_id = 0
         self._reader: threading.Thread | None = None
         self._closed = False
@@ -246,7 +247,7 @@ class EventHubClient:
                 if perf is None:
                     continue
                 self._dispatch(perf, payload)
-        except (AmqpError, OSError, struct.error):
+        except (AmqpError, OSError, struct.error, RuntimeError):
             pass
         finally:
             with self._lock:
@@ -257,6 +258,7 @@ class EventHubClient:
                         pass
                     self._sock = None
                     self._links.clear()
+                    self._links_by_remote.clear()
                     self._senders.clear()
                     self._receivers.clear()
                     self._connected.clear()
@@ -266,31 +268,36 @@ class EventHubClient:
     def _dispatch(self, perf: Described, payload: bytes) -> None:
         fields = perf.value if isinstance(perf.value, list) else []
         if perf.descriptor == wire.ATTACH:
-            # [name, handle, role, ...]: the peer's attach echo; role is
-            # the PEER's role (True=receiver means our sender attached)
+            # [name, handle, role, ...]: the peer's attach echo. The handle
+            # in it is the handle the PEER assigned to its end of the link
+            # (AMQP 1.0 §2.6.2) — all subsequent peer frames carry THAT
+            # handle, so index the link by it. Snapshot the dict: _attach
+            # on other threads mutates it concurrently.
             name = fields[0] if fields else ""
-            for link in self._links.values():
+            for link in list(self._links.values()):
                 if link.name == name:
                     link.remote_handle = int(fields[1])
+                    self._links_by_remote[link.remote_handle] = link
                     link.attached.set()
         elif perf.descriptor == wire.FLOW:
             # [next-in-id, in-window, next-out-id, out-window, handle,
             #  delivery-count, link-credit, ...] → sender credit grant
             if len(fields) > 6 and fields[4] is not None:
-                link = self._links.get(int(fields[4]))
+                link = self._links_by_remote.get(int(fields[4]))
                 if link is not None:
                     link.credit = int(fields[6] or 0)
                     link.attached.set()
         elif perf.descriptor == wire.TRANSFER:
             handle = int(fields[0])
             delivery_id = int(fields[1]) if len(fields) > 1 and fields[1] is not None else 0
-            link = self._links.get(handle)
+            link = self._links_by_remote.get(handle)
             if link is not None:
                 link.queue.put((delivery_id, payload))
         elif perf.descriptor == wire.DETACH:
             handle = int(fields[0]) if fields else -1
-            link = self._links.pop(handle, None)
+            link = self._links_by_remote.pop(handle, None)
             if link is not None:
+                self._links.pop(link.handle, None)
                 self._senders.pop(link.address, None)
         elif perf.descriptor == wire.CLOSE:
             raise AmqpError(f"peer closed connection: {fields}")
@@ -384,6 +391,11 @@ class EventHubClient:
             )
         deadline = self.poll_timeout
         per_link = max(deadline / max(len(links), 1), 0.02)
+        # rotate the starting partition per call: a fixed order starves
+        # partitions behind a busy one (code-review r4)
+        start = self._rr_start.get(topic, 0) % len(links)
+        self._rr_start[topic] = start + 1
+        links = links[start:] + links[:start]
         for link in links:
             try:
                 delivery_id, payload = link.queue.get(timeout=per_link)

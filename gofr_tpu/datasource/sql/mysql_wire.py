@@ -59,16 +59,6 @@ def send_packet(sock: Any, seq: int, payload: bytes) -> int:
     return (seq + 1) & 0xFF
 
 
-def recv_exact(sock: Any, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(65536)
-        if not chunk:
-            raise MySQLError(2013, "HY000", "lost connection during read")
-        buf += chunk
-    return buf
-
-
 class PacketReader:
     """Buffered packet reader over a socket."""
 
@@ -332,13 +322,19 @@ def interpolate(sql: str, args: tuple) -> str:
     out: list[str] = []
     it = iter(args)
     i = 0
-    in_sq = in_dq = in_comment = False
+    in_sq = in_dq = in_line_comment = in_block_comment = False
     while i < len(sql):
         ch = sql[i]
-        if in_comment:
+        if in_line_comment:
             out.append(ch)
             if ch == "\n":
-                in_comment = False
+                in_line_comment = False
+        elif in_block_comment:
+            out.append(ch)
+            if ch == "*" and sql[i : i + 2] == "*/":
+                out.append("/")
+                i += 1
+                in_block_comment = False
         elif in_sq:
             out.append(ch)
             if ch == "'":
@@ -354,7 +350,13 @@ def interpolate(sql: str, args: tuple) -> str:
             in_dq = True
             out.append(ch)
         elif ch == "-" and sql[i : i + 2] == "--":
-            in_comment = True
+            in_line_comment = True
+            out.append(ch)
+        elif ch == "#":  # MySQL line comment
+            in_line_comment = True
+            out.append(ch)
+        elif ch == "/" and sql[i : i + 2] == "/*":
+            in_block_comment = True
             out.append(ch)
         elif ch == "?":
             try:

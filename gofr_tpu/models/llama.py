@@ -193,27 +193,67 @@ def _mm(x: jnp.ndarray, w) -> jnp.ndarray:
 class KVCache:
     """Dense KV cache: [L, B, S_max, Hkv, Dh] per k/v. The serving layer's
     paged cache (serving/kv_cache.py) converts to/from this layout for the
-    model step functions."""
+    model step functions.
+
+    Optional int8 quantization (``create(..., kv_dtype="int8")``): k/v are
+    stored int8 with per-(layer, row, position, head) absmax scales
+    (``ks``/``vs`` [L, B, S_max, Hkv] f32) and dequantized to the compute
+    dtype at the attention read. Decode is HBM-bound and the KV read grows
+    linearly with batch x length, so halving its width is a direct
+    throughput lever AND doubles resident KV capacity (SURVEY §5.7
+    lever (a) squared); compute stays bf16 — only storage narrows."""
 
     k: jnp.ndarray
     v: jnp.ndarray
+    ks: jnp.ndarray | None = None  # int8 mode: absmax scales
+    vs: jnp.ndarray | None = None
 
     def tree_flatten(self):
-        return (self.k, self.v), None
+        if self.ks is None:
+            return (self.k, self.v), False
+        return (self.k, self.v, self.ks, self.vs), True
 
     @classmethod
-    def tree_unflatten(cls, aux, children):
+    def tree_unflatten(cls, quantized, children):
         return cls(*children)
 
     @classmethod
-    def create(cls, cfg: LlamaConfig, batch: int, max_len: int | None = None) -> "KVCache":
+    def create(
+        cls, cfg: LlamaConfig, batch: int, max_len: int | None = None,
+        kv_dtype: str | None = None,
+    ) -> "KVCache":
         S = max_len or cfg.max_seq_len
         shape = (cfg.n_layers, batch, S, cfg.n_kv_heads, cfg.head_dim)
+        if kv_dtype == "int8":
+            sshape = shape[:-1]
+            return cls(
+                jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                jnp.zeros(sshape, jnp.float32), jnp.zeros(sshape, jnp.float32),
+            )
         return cls(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+    @property
+    def quantized(self) -> bool:
+        return self.ks is not None
 
     @property
     def max_len(self) -> int:
         return self.k.shape[2]
+
+
+def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-vector (last-dim) absmax int8 quantization: [..., Dh] →
+    (int8 [..., Dh], f32 scale [...])."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(absmax / 127.0, 1e-8)
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray, dtype: Any) -> jnp.ndarray:
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
 # ---------------------------------------------------------------- layer body
@@ -284,7 +324,9 @@ def _layer_cached(
     v_all: jnp.ndarray,
     cache_len: jnp.ndarray,  # [B] length AFTER writing current tokens
     mode: str,
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    ks_all: jnp.ndarray | None = None,  # int8 mode: [L, B, S_max, Hkv] scales
+    vs_all: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray | None, jnp.ndarray | None]:
     """Layer body for the cached modes, carrying the WHOLE stacked cache.
 
     Scanning the cache as xs/ys (the obvious formulation) makes XLA slice
@@ -292,15 +334,28 @@ def _layer_cached(
     step — profiled at ~15 ms of a 25 ms decode step at B=256. Keeping
     the stacked cache in the scan *carry* and doing per-layer indexed
     in-place updates leaves it resident in HBM: per step the only cache
-    traffic is the attention read plus a one-token scatter."""
+    traffic is the attention read plus a one-token scatter.
+
+    int8 KV (ks_all/vs_all present): k/v quantize on write; the attention
+    read dequantizes to the compute dtype — halving the dominant decode
+    HBM stream. Prefill attention always uses the fresh full-width k/v."""
     B, S, _ = x.shape
+    quantized = ks_all is not None
     _, q, k, v = _qkv(cfg, x, lp, sin, cos, positions)
 
     if mode == "prefill":
         # fill layer `layer`'s slab in place; attention runs on the fresh
         # k/v directly (no cache read-back needed during prefill)
-        k_all = jax.lax.dynamic_update_slice(k_all, k[None], (layer, 0, 0, 0, 0))
-        v_all = jax.lax.dynamic_update_slice(v_all, v[None], (layer, 0, 0, 0, 0))
+        if quantized:
+            kq, kscale = quantize_kv(k)
+            vq, vscale = quantize_kv(v)
+            k_all = jax.lax.dynamic_update_slice(k_all, kq[None], (layer, 0, 0, 0, 0))
+            v_all = jax.lax.dynamic_update_slice(v_all, vq[None], (layer, 0, 0, 0, 0))
+            ks_all = jax.lax.dynamic_update_slice(ks_all, kscale[None], (layer, 0, 0, 0))
+            vs_all = jax.lax.dynamic_update_slice(vs_all, vscale[None], (layer, 0, 0, 0))
+        else:
+            k_all = jax.lax.dynamic_update_slice(k_all, k[None], (layer, 0, 0, 0, 0))
+            v_all = jax.lax.dynamic_update_slice(v_all, v[None], (layer, 0, 0, 0, 0))
         use_flash_auto = (
             cfg.attn_impl == "auto"
             and S % 128 == 0
@@ -313,13 +368,31 @@ def _layer_cached(
     else:  # decode: S == 1, one-token scatter at (layer, row, position)
         idx = cache_len - 1  # position just written
         b_idx = jnp.arange(B)
-        k_all = k_all.at[layer, b_idx, idx].set(k[:, 0])
-        v_all = v_all.at[layer, b_idx, idx].set(v[:, 0])
-        kc = jax.lax.dynamic_index_in_dim(k_all, layer, 0, keepdims=False)
-        vc = jax.lax.dynamic_index_in_dim(v_all, layer, 0, keepdims=False)
+        if quantized:
+            kq, kscale = quantize_kv(k[:, 0])
+            vq, vscale = quantize_kv(v[:, 0])
+            k_all = k_all.at[layer, b_idx, idx].set(kq)
+            v_all = v_all.at[layer, b_idx, idx].set(vq)
+            ks_all = ks_all.at[layer, b_idx, idx].set(kscale)
+            vs_all = vs_all.at[layer, b_idx, idx].set(vscale)
+            kc = dequantize_kv(
+                jax.lax.dynamic_index_in_dim(k_all, layer, 0, keepdims=False),
+                jax.lax.dynamic_index_in_dim(ks_all, layer, 0, keepdims=False),
+                cfg.dtype,
+            )
+            vc = dequantize_kv(
+                jax.lax.dynamic_index_in_dim(v_all, layer, 0, keepdims=False),
+                jax.lax.dynamic_index_in_dim(vs_all, layer, 0, keepdims=False),
+                cfg.dtype,
+            )
+        else:
+            k_all = k_all.at[layer, b_idx, idx].set(k[:, 0])
+            v_all = v_all.at[layer, b_idx, idx].set(v[:, 0])
+            kc = jax.lax.dynamic_index_in_dim(k_all, layer, 0, keepdims=False)
+            vc = jax.lax.dynamic_index_in_dim(v_all, layer, 0, keepdims=False)
         attn = decode_attention(q, kc, vc, cache_len)
 
-    return _attn_mlp_epilogue(cfg, x, lp, attn), k_all, v_all
+    return _attn_mlp_epilogue(cfg, x, lp, attn), k_all, v_all, ks_all, vs_all
 
 
 def _run_layers(
@@ -351,10 +424,27 @@ def _run_layers(
 
     # cache modes: the stacked cache rides the CARRY (in-place per-layer
     # updates), never the xs/ys path — see _layer_cached's docstring
+    if cache.quantized:
+        def body(carry, xs):
+            h, k_all, v_all, ks_all, vs_all = carry
+            lp, layer = xs
+            h, k_all, v_all, ks_all, vs_all = _layer_cached(
+                cfg, h, lp, layer, sin, cos, positions, k_all, v_all,
+                cache_len, mode, ks_all, vs_all,
+            )
+            return (h, k_all, v_all, ks_all, vs_all), None
+
+        (x, new_k, new_v, new_ks, new_vs), _ = jax.lax.scan(
+            body,
+            (x, cache.k, cache.v, cache.ks, cache.vs),
+            (params["layers"], jnp.arange(cfg.n_layers)),
+        )
+        return x, KVCache(new_k, new_v, new_ks, new_vs)
+
     def body(carry, xs):
         h, k_all, v_all = carry
         lp, layer = xs
-        h, k_all, v_all = _layer_cached(
+        h, k_all, v_all, _, _ = _layer_cached(
             cfg, h, lp, layer, sin, cos, positions, k_all, v_all, cache_len, mode
         )
         return (h, k_all, v_all), None

@@ -57,6 +57,26 @@ def insert_slot(
     return k_cache, v_cache
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def insert_slot_quantized(
+    cache: llama.KVCache,  # int8 cache (donated)
+    k_slab: jnp.ndarray,  # [L, S_bucket, Hkv, Dh] full-width prefill slab
+    v_slab: jnp.ndarray,
+    slot: jnp.ndarray,  # scalar int32
+) -> llama.KVCache:
+    """int8 twin of :func:`insert_slot`: quantize the full-width prefill
+    slabs (per-vector absmax) and scatter payload + scales into the slot
+    row of the quantized cache."""
+    kq, kscale = llama.quantize_kv(k_slab)
+    vq, vscale = llama.quantize_kv(v_slab)
+    return llama.KVCache(
+        jax.lax.dynamic_update_slice(cache.k, kq[:, None], (0, slot, 0, 0, 0)),
+        jax.lax.dynamic_update_slice(cache.v, vq[:, None], (0, slot, 0, 0, 0)),
+        jax.lax.dynamic_update_slice(cache.ks, kscale[:, None], (0, slot, 0, 0)),
+        jax.lax.dynamic_update_slice(cache.vs, vscale[:, None], (0, slot, 0, 0)),
+    )
+
+
 @partial(jax.jit, static_argnums=0, donate_argnums=(2,))
 def decode_and_sample_pipelined(
     cfg: llama.LlamaConfig,

@@ -49,6 +49,9 @@ class EngineConfig:
     kv_layout: str = "dense"
     kv_page_size: int = 16
     kv_num_pages: int | None = None  # default: slots*max_seq worth of pages
+    # "int8" stores dense KV quantized (per-vector absmax; llama.KVCache):
+    # half the decode HBM stream, double the resident slots per GB
+    kv_dtype: str = "bf16"
 
     @classmethod
     def from_config(cls, config: Any) -> "EngineConfig":
@@ -78,6 +81,7 @@ class EngineConfig:
             kv_layout=config.get_or_default("TPU_KV_LAYOUT", "dense"),
             kv_page_size=int(config.get_or_default("TPU_KV_PAGE_SIZE", "16")),
             kv_num_pages=int(num_pages) if num_pages else None,
+            kv_dtype=config.get_or_default("TPU_KV_DTYPE", "bf16"),
         )
 
 
@@ -165,6 +169,17 @@ class ServingEngine:
         self._tracer = tracer
 
         B, S = self.config.max_slots, self.config.max_seq_len
+        if self.config.kv_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"TPU_KV_DTYPE={self.config.kv_dtype!r}: must be bf16 or int8"
+            )
+        if self.config.kv_layout == "paged" and self.config.kv_dtype == "int8":
+            # silently running full-width would wreck capacity planning
+            # based on the halved footprint (code-review r4)
+            raise ValueError(
+                "TPU_KV_DTYPE=int8 is not supported with TPU_KV_LAYOUT=paged "
+                "yet; use the dense layout for quantized KV"
+            )
         if self.config.kv_layout == "paged":
             from gofr_tpu.serving.kv_cache import PagedKVCache
 
@@ -177,7 +192,10 @@ class ServingEngine:
             self.cache = None
         else:
             self.paged_cache = None
-            self.cache = llama.KVCache.create(cfg, B, max_len=S)
+            self.cache = llama.KVCache.create(
+                cfg, B, max_len=S,
+                kv_dtype="int8" if self.config.kv_dtype == "int8" else None,
+            )
         self.cache_len = np.zeros(B, np.int32)  # host copy (authoritative)
         self.last_token = np.zeros(B, np.int32)
         self.temperature = np.ones(B, np.float32)
@@ -531,6 +549,10 @@ class ServingEngine:
             )
             if self.paged_cache is not None:
                 self.paged_cache.write_prefill(slot, k_slab, v_slab)
+            elif self.cache.quantized:
+                self.cache = batch_ops.insert_slot_quantized(
+                    self.cache, k_slab, v_slab, jnp.int32(slot)
+                )
             else:
                 self.cache.k, self.cache.v = batch_ops.insert_slot(
                     self.cache.k, self.cache.v, k_slab, v_slab, jnp.int32(slot)

@@ -38,10 +38,13 @@ class _Partition:
 
 
 class _ReceiverLink:
-    __slots__ = ("handle", "topic", "group", "partition", "credit", "delivered")
+    __slots__ = ("handle", "server_handle", "topic", "group", "partition",
+                 "credit", "delivered")
 
-    def __init__(self, handle: int, topic: str, group: str, partition: int) -> None:
-        self.handle = handle
+    def __init__(self, handle: int, server_handle: int, topic: str, group: str,
+                 partition: int) -> None:
+        self.handle = handle  # the CLIENT's handle (incoming frames carry it)
+        self.server_handle = server_handle  # OUR handle (outgoing frames carry it)
         self.topic = topic
         self.group = group
         self.partition = partition
@@ -133,6 +136,10 @@ class _ConnState:
         self._wlock = threading.Lock()
         self._receivers: dict[int, _ReceiverLink] = {}
         self._sender_addresses: dict[int, str] = {}  # sender handle → target
+        # deliberately DIFFERENT numbering from any client (spec §2.6.2:
+        # each endpoint assigns its own handles; frames carry the sender's)
+        # — catches clients that route incoming frames by their own handle
+        self._server_handles = itertools.count(100)
         self._delivery_ids = itertools.count(0)
         self._stop = threading.Event()
 
@@ -246,16 +253,17 @@ class _ConnState:
             source = fields[5]
             address = source.value[0] if isinstance(source, Described) else str(source)
             topic, group, partition = _parse_partition_address(str(address))
+            server_handle = next(self._server_handles)
             with self.server._lock:
                 self.server._partitions_for(topic)
-                link = _ReceiverLink(handle, topic, group, partition)
+                link = _ReceiverLink(handle, server_handle, topic, group, partition)
                 # delivery resumes from the checkpoint, not the old cursor:
                 # unacked-but-delivered messages redeliver to this link
                 part = self.server._topics[topic][partition]
                 part.cursors[group] = part.acked.get(group, 0)
                 self._receivers[handle] = link
             echo = Described(wire.ATTACH, [
-                name, Uint(handle), False, Ubyte(0), Ubyte(0),
+                name, Uint(server_handle), False, Ubyte(0), Ubyte(0),
                 Described(wire.SOURCE, [address]),
                 Described(wire.TARGET, [None]),
             ])
@@ -268,15 +276,16 @@ class _ConnState:
                 if isinstance(target, Described) and target.value else ""
             )
             self._sender_addresses[handle] = address
+            server_handle = next(self._server_handles)
             echo = Described(wire.ATTACH, [
-                name, Uint(handle), True, Ubyte(0), Ubyte(0),
+                name, Uint(server_handle), True, Ubyte(0), Ubyte(0),
                 Described(wire.SOURCE, [None]),
                 Described(wire.TARGET, [address or None]),
             ])
             self._send(wire.encode_frame(0, echo))
             flow = Described(wire.FLOW, [
                 Uint(0), Uint(2048), Uint(0), Uint(2048),
-                Uint(handle), Uint(0), Uint(1000),
+                Uint(server_handle), Uint(0), Uint(1000),
             ])
             self._send(wire.encode_frame(0, flow))
 
@@ -336,7 +345,7 @@ class _ConnState:
                     continue
             for link, did, _offset, payload in sends:
                 transfer = Described(wire.TRANSFER, [
-                    Uint(link.handle), Uint(did),
+                    Uint(link.server_handle), Uint(did),
                     struct.pack(">I", did), Uint(0), False,
                 ])
                 try:
