@@ -247,7 +247,7 @@ class EventHubClient:
                 if perf is None:
                     continue
                 self._dispatch(perf, payload)
-        except (AmqpError, OSError, struct.error, RuntimeError):
+        except (AmqpError, OSError, struct.error):
             pass
         finally:
             with self._lock:
@@ -299,6 +299,14 @@ class EventHubClient:
             if link is not None:
                 self._links.pop(link.handle, None)
                 self._senders.pop(link.address, None)
+                # a detached receiver must leave the topic's poll set, or
+                # subscribe() burns its per-link timeout on a dead queue
+                # forever (code-review r4)
+                for topic, links in list(self._receivers.items()):
+                    if link in links:
+                        links.remove(link)
+                        if not links:
+                            del self._receivers[topic]
         elif perf.descriptor == wire.CLOSE:
             raise AmqpError(f"peer closed connection: {fields}")
 

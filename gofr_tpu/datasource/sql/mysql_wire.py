@@ -356,8 +356,11 @@ def interpolate(sql: str, args: tuple) -> str:
             in_line_comment = True
             out.append(ch)
         elif ch == "/" and sql[i : i + 2] == "/*":
+            # consume BOTH opener chars: '/*/' must not read its '*' as
+            # the start of the terminator (code-review r4)
             in_block_comment = True
-            out.append(ch)
+            out.append("/*")
+            i += 1
         elif ch == "?":
             try:
                 out.append(escape_value(next(it)))

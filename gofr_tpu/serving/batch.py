@@ -106,6 +106,45 @@ def decode_and_sample_pipelined(
     return next_token, cache, new_len, rng
 
 
+@partial(jax.jit, static_argnums=(0, 10), donate_argnums=(2,))
+def decode_and_sample_multi(
+    cfg: llama.LlamaConfig,
+    params: dict,
+    cache: llama.KVCache,  # donated
+    last_token: jnp.ndarray,  # [B] device-resident
+    cache_len: jnp.ndarray,  # [B] device-resident
+    active: jnp.ndarray,  # [B] bool
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+    rng: jax.Array,
+    steps: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, llama.KVCache, jnp.ndarray, jax.Array]:
+    """``steps`` decode iterations in ONE dispatch (lax.scan): the host
+    pays per-dispatch overhead once per chunk instead of once per token —
+    the decisive lever when dispatch latency rivals step compute (remote/
+    tunneled backends, small models). Returns (tokens [B, steps],
+    final_token [B], cache, cache_len, rng). The engine only uses chunks
+    for rows that need ≥steps more tokens; a row that emits a stop token
+    mid-chunk wastes the tail steps (bounded, host discards them)."""
+
+    def step(carry, _):
+        cache, last, clen, r = carry
+        step_len = jnp.where(active, clen + 1, 1)
+        logits, cache = llama.decode_step(cfg, params, last, cache, step_len)
+        r, key = jax.random.split(r)
+        nxt = sample_logits(
+            logits, key, temperature=temperature, top_k=top_k, top_p=top_p
+        )
+        new_len = jnp.where(active, clen + 1, clen)
+        return (cache, nxt, new_len, r), nxt
+
+    (cache, last, new_len, rng), toks = jax.lax.scan(
+        step, (cache, last_token, cache_len, rng), None, length=steps
+    )
+    return jnp.transpose(toks), last, cache, new_len, rng
+
+
 @partial(jax.jit, donate_argnums=(1,))
 def scatter_slot_state(
     last_token: jnp.ndarray,  # [B] NOT donated: it aliases the in-flight

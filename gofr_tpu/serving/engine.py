@@ -52,6 +52,10 @@ class EngineConfig:
     # "int8" stores dense KV quantized (per-vector absmax; llama.KVCache):
     # half the decode HBM stream, double the resident slots per GB
     kv_dtype: str = "bf16"
+    # decode tokens per device dispatch (dense layout): chunks amortize
+    # per-dispatch host/tunnel overhead; a row that stops mid-chunk wastes
+    # the tail steps, so keep small for stop-heavy workloads
+    multi_step: int = 1
 
     @classmethod
     def from_config(cls, config: Any) -> "EngineConfig":
@@ -82,6 +86,7 @@ class EngineConfig:
             kv_page_size=int(config.get_or_default("TPU_KV_PAGE_SIZE", "16")),
             kv_num_pages=int(num_pages) if num_pages else None,
             kv_dtype=config.get_or_default("TPU_KV_DTYPE", "bf16"),
+            multi_step=int(config.get_or_default("TPU_BATCH_MULTI_STEP", "1")),
         )
 
 
@@ -131,18 +136,22 @@ class _Request:
 
 
 class _Inflight:
-    """A dispatched-but-not-consumed decode step: the device-side sampled
-    tokens plus the (slot, request) snapshot the dispatch was built from.
-    The snapshot is what makes depth-1 pipelining safe — by consume time a
-    slot may have been retired and even re-admitted, and ``slots[slot] is
-    req`` detects that and discards the stale token."""
+    """A dispatched-but-not-consumed decode step (or multi-step chunk):
+    the device-side sampled tokens plus the (slot, request) snapshot the
+    dispatch was built from. The snapshot is what makes depth-1
+    pipelining safe — by consume time a slot may have been retired and
+    even re-admitted, and ``slots[slot] is req`` detects that and
+    discards the stale tokens. ``steps`` > 1 means ``next_token`` is
+    [B, steps] (chunked decode)."""
 
-    __slots__ = ("next_token", "rows", "dispatched_at")
+    __slots__ = ("next_token", "rows", "dispatched_at", "steps")
 
-    def __init__(self, next_token: Any, rows: list, dispatched_at: float) -> None:
+    def __init__(self, next_token: Any, rows: list, dispatched_at: float,
+                 steps: int = 1) -> None:
         self.next_token = next_token
         self.rows = rows
         self.dispatched_at = dispatched_at
+        self.steps = steps
 
 
 class ServingEngine:
@@ -697,7 +706,36 @@ class ServingEngine:
             )
             self.cache_len = np.array(pc.seq_lens)
         else:
-            (next_token, self.cache, self._cache_len_dev, self.rng) = (
+            # chunk size is ALL-or-one: the full multi_step chunk only when
+            # every dispatched row can absorb it without crossing its
+            # max_new/max_seq limits, else single steps. T is a static
+            # argnum — intermediate sizes would each compile their own
+            # executable (and did, on the clock, before this guard)
+            T = 1
+            if self.config.multi_step > 1:
+                absorb = min(
+                    min(req.max_new_tokens - (1 + req.dispatched)
+                        for _, req in rows),
+                    min(self.config.max_seq_len - 1
+                        - (len(req.prompt_ids) + 1 + req.dispatched)
+                        for _, req in rows),
+                )
+                if absorb >= self.config.multi_step:
+                    T = self.config.multi_step
+            if T > 1:
+                (tokens, last, self.cache, self._cache_len_dev, self.rng) = (
+                    batch_ops.decode_and_sample_multi(
+                        cfg, self.params, self.cache,
+                        self._last_tok_dev, self._cache_len_dev, mask_d,
+                        temp_d, topk_d, topp_d, self.rng, T,
+                    )
+                )
+                self._last_tok_dev = last
+                for slot, req in rows:
+                    self.cache_len[slot] += T
+                    req.dispatched += T
+                return _Inflight(tokens, rows, t0, steps=T)
+            next_token, self.cache, self._cache_len_dev, self.rng = (
                 batch_ops.decode_and_sample_pipelined(
                     cfg, self.params, self.cache,
                     self._last_tok_dev, self._cache_len_dev, mask_d,
@@ -725,20 +763,28 @@ class ServingEngine:
             if self.slots[slot] is not req:
                 continue  # retired (and possibly re-admitted) since dispatch
             n_active += 1
-            token_id = int(next_ids[slot])
-            self.last_token[slot] = token_id
-            self._emit_token(req, token_id)
-            if req.canceled:
-                self._retire(slot, "cancel")
-            elif token_id in req.stop_ids:
-                self._retire(slot, "stop")
-            elif len(req.tokens) >= req.max_new_tokens:
-                self._retire(slot, "length")
-            elif len(req.prompt_ids) + len(req.tokens) >= self.config.max_seq_len:
-                self._retire(slot, "length")
+            row_ids = (
+                next_ids[slot : slot + 1] if rec.steps == 1 else next_ids[slot]
+            )
+            for token_id in row_ids:
+                token_id = int(token_id)
+                self.last_token[slot] = token_id
+                self._emit_token(req, token_id)
+                if req.canceled:
+                    self._retire(slot, "cancel")
+                elif token_id in req.stop_ids:
+                    self._retire(slot, "stop")
+                elif len(req.tokens) >= req.max_new_tokens:
+                    self._retire(slot, "length")
+                elif len(req.prompt_ids) + len(req.tokens) >= self.config.max_seq_len:
+                    self._retire(slot, "length")
+                if self.slots[slot] is not req:
+                    break  # retired mid-chunk: discard the tail tokens
 
         if self._metrics and n_active:
-            self._metrics.record_histogram("app_tpot_seconds", step_time)
+            self._metrics.record_histogram(
+                "app_tpot_seconds", step_time / rec.steps
+            )
             self._metrics.set_gauge(
                 "app_batch_occupancy", n_active / self.config.max_slots
             )
