@@ -297,16 +297,19 @@ class EventHubClient:
             handle = int(fields[0]) if fields else -1
             link = self._links_by_remote.pop(handle, None)
             if link is not None:
-                self._links.pop(link.handle, None)
-                self._senders.pop(link.address, None)
                 # a detached receiver must leave the topic's poll set, or
                 # subscribe() burns its per-link timeout on a dead queue
-                # forever (code-review r4)
-                for topic, links in list(self._receivers.items()):
-                    if link in links:
-                        links.remove(link)
-                        if not links:
-                            del self._receivers[topic]
+                # forever — and the removal must hold the client lock like
+                # every other _receivers mutation, or it races subscribe()'s
+                # snapshot (code-review r4 x2)
+                with self._lock:
+                    self._links.pop(link.handle, None)
+                    self._senders.pop(link.address, None)
+                    for topic, links in list(self._receivers.items()):
+                        if link in links:
+                            links.remove(link)
+                            if not links:
+                                del self._receivers[topic]
         elif perf.descriptor == wire.CLOSE:
             raise AmqpError(f"peer closed connection: {fields}")
 
@@ -366,7 +369,9 @@ class EventHubClient:
                 links = [self._attach("receiver", a)
                          for a in self._partition_addresses(topic)]
                 self._receivers[topic] = links
-            return links
+            # COPY under the lock: the reader thread mutates the stored
+            # list on detach while subscribe() iterates its snapshot
+            return list(links)
 
     # -- pubsub contract ---------------------------------------------------
     def publish(self, topic: str, message: bytes, metadata: dict | None = None) -> None:

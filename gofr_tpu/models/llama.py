@@ -610,6 +610,70 @@ def decode_step_paged(
     return logits, k_pool, v_pool
 
 
+@partial(jax.jit, static_argnums=0, donate_argnums=(3, 4, 5, 6))
+def decode_step_paged_q(
+    cfg: LlamaConfig,
+    params: dict,
+    tokens: jnp.ndarray,  # [B]
+    k_pool: jnp.ndarray,  # [L, N_pages, Hkv, page, Dh] int8, donated
+    v_pool: jnp.ndarray,  # donated
+    ks_pool: jnp.ndarray,  # [L, N_pages, Hkv, page, 1] f32, donated
+    vs_pool: jnp.ndarray,  # donated
+    block_tables: jnp.ndarray,  # [B, M] int32
+    seq_lens: jnp.ndarray,  # [B] length INCLUDING this token's position
+    active: jnp.ndarray,  # [B] bool
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """int8 twin of :func:`decode_step_paged`: this step's K/V quantize
+    (per-vector absmax) before the page scatter, and attention reads the
+    pools through the dequantizing kernel (ops/paged_attention.py) —
+    half the paged decode HBM stream."""
+    B = tokens.shape[0]
+    page = k_pool.shape[3]
+    trash_page = k_pool.shape[1] - 1
+    H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    x = params["embedding"][tokens][:, None, :].astype(cfg.dtype)
+    pos = jnp.maximum(seq_lens - 1, 0)
+    positions = pos[:, None]
+    sin, cos = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    b_idx = jnp.arange(B)
+    pages = jnp.where(active, block_tables[b_idx, pos // page], trash_page)
+    offsets = jnp.where(active, pos % page, 0)
+
+    from gofr_tpu.ops.paged_attention import paged_decode_attention_q
+
+    def body(h, xs):
+        lp, kc, vc, ksc, vsc = xs
+        hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps)
+        q = _mm(hn, lp["wq"]).reshape(B, 1, H, Dh)
+        k = _mm(hn, lp["wk"]).reshape(B, 1, Hkv, Dh)
+        v = _mm(hn, lp["wv"]).reshape(B, 1, Hkv, Dh)
+        q = apply_rope(q, positions, sin, cos)[:, 0]
+        k = apply_rope(k, positions, sin, cos)[:, 0]  # [B, Hkv, Dh]
+        v = v[:, 0]
+
+        kq, ks = quantize_kv(k)  # int8 [B,Hkv,Dh], f32 [B,Hkv]
+        vq, vs = quantize_kv(v)
+        kc = kc.at[pages, :, offsets].set(kq)
+        vc = vc.at[pages, :, offsets].set(vq)
+        ksc = ksc.at[pages, :, offsets, 0].set(ks)
+        vsc = vsc.at[pages, :, offsets, 0].set(vs)
+
+        attn = paged_decode_attention_q(
+            q, kc, vc, ksc, vsc, block_tables, seq_lens
+        )
+        h = h + _mm(attn.reshape(B, 1, H * Dh), lp["wo"])
+        hn = rms_norm(h, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(_mm(hn, lp["w_gate"]).astype(jnp.float32)).astype(hn.dtype)
+        h = h + _mm(gate * _mm(hn, lp["w_up"]), lp["w_down"])
+        return h, (kc, vc, ksc, vsc)
+
+    x, (k_pool, v_pool, ks_pool, vs_pool) = jax.lax.scan(
+        body, x, (params["layers"], k_pool, v_pool, ks_pool, vs_pool)
+    )
+    logits = _logits(cfg, params, x)[:, 0]
+    return logits, k_pool, v_pool, ks_pool, vs_pool
+
+
 @partial(jax.jit, static_argnums=0, donate_argnums=(3, 4))
 def decode_step_greedy(
     cfg: LlamaConfig,

@@ -10,12 +10,14 @@ max_seq_len rows per slot.
 
 Two implementations with one contract:
 - ``paged_decode_attention_ref`` — pure-XLA gather fallback (CI, CPU);
-- ``paged_decode_attention`` — Pallas kernel whose grid walks
+- ``paged_decode_attention`` / ``paged_decode_attention_q`` — one Pallas
+  kernel (bf16 or int8-with-scales pools) whose grid walks
   (batch, kv_head, page) with the page axis innermost, carrying the
   online-softmax state in VMEM scratch. The page index feeds the K/V
   BlockSpec index maps from scalar-prefetched block tables, so only the
   pages a sequence actually owns are streamed from HBM; pages past the
-  sequence length are skipped with ``@pl.when``.
+  sequence length are skipped with ``@pl.when``. int8 pools stream at
+  half width and dequantize in VMEM (per-vector absmax scales).
 """
 
 from __future__ import annotations
@@ -30,6 +32,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# int8 arrays tile as (32, 128) on TPU; a smaller page would violate the
+# Mosaic block constraints for the quantized pools
+INT8_MIN_PAGE = 32
+
 
 def paged_decode_attention_ref(
     q: jnp.ndarray,  # [B, H, Dh] one query token per sequence
@@ -39,9 +45,13 @@ def paged_decode_attention_ref(
     seq_lens: jnp.ndarray,  # [B] valid token count per sequence
     *,
     scale: float | None = None,
+    k_scale: jnp.ndarray | None = None,  # int8 pools: [N, Hkv, page, 1] f32
+    v_scale: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Gather-based reference: materializes [B, M*page] K/V. Correctness
-    oracle + off-TPU fallback."""
+    oracle + off-TPU fallback. int8 pools carry per-vector absmax scales
+    and dequantize AFTER the gather — only the owned pages widen, never
+    the whole pool."""
     B, H, Dh = q.shape
     Hkv = k_pool.shape[1]
     page = k_pool.shape[2]
@@ -51,6 +61,11 @@ def paged_decode_attention_ref(
     # [B, M, Hkv, page, Dh] -> [B, M*page, Hkv, Dh]
     k = k_pool[block_tables].transpose(0, 1, 3, 2, 4).reshape(B, M * page, Hkv, Dh)
     v = v_pool[block_tables].transpose(0, 1, 3, 2, 4).reshape(B, M * page, Hkv, Dh)
+    if k_scale is not None:
+        ks = k_scale[block_tables].transpose(0, 1, 3, 2, 4).reshape(B, M * page, Hkv, 1)
+        vs = v_scale[block_tables].transpose(0, 1, 3, 2, 4).reshape(B, M * page, Hkv, 1)
+        k = k.astype(jnp.float32) * ks
+        v = v.astype(jnp.float32) * vs
     group = H // Hkv
     k = jnp.repeat(k, group, axis=2)  # [B, S, H, Dh]
     v = jnp.repeat(v, group, axis=2)
@@ -70,14 +85,18 @@ def _paged_kernel(
     q_ref,  # VMEM [1, 1, group, Dh]  ([B, Hkv, group, Dh] layout)
     k_ref,  # VMEM [1, 1, page, Dh]   (page j of this sequence, kv head g)
     v_ref,  # VMEM [1, 1, page, Dh]
-    o_ref,  # VMEM [1, 1, group, Dh]
-    m_scratch,  # VMEM [group, 128] f32
-    l_scratch,  # VMEM [group, 128] f32
-    acc_scratch,  # VMEM [group, Dh] f32
-    *,
+    *rest,  # quantized: ks_ref, vs_ref, o_ref, scratches; else o_ref, scratches
     scale: float,
     page: int,
+    quantized: bool,
 ):
+    """One kernel for both pool widths: with ``quantized`` the K/V blocks
+    arrive int8 plus per-vector scale blocks and dequantize in VMEM."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scratch, l_scratch, acc_scratch = rest
+    else:
+        o_ref, m_scratch, l_scratch, acc_scratch = rest
+
     b = pl.program_id(0)
     j = pl.program_id(2)
     nj = pl.num_programs(2)
@@ -95,6 +114,9 @@ def _paged_kernel(
         q = q_ref[0, 0, :, :].astype(jnp.float32)  # [group, Dh]
         k = k_ref[0, 0, :, :].astype(jnp.float32)  # [page, Dh]
         v = v_ref[0, 0, :, :].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[0, 0, :, :]  # [page, 1] scale broadcasts over Dh
+            v = v * vs_ref[0, 0, :, :]
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -122,33 +144,29 @@ def _paged_kernel(
         o_ref[0, 0, :, :] = (acc_scratch[:] / denom).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
-def paged_decode_attention(
-    q: jnp.ndarray,  # [B, H, Dh]
-    k_pool: jnp.ndarray,  # [N_pages, Hkv, page, Dh]
+def _paged_attention_call(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
     v_pool: jnp.ndarray,
-    block_tables: jnp.ndarray,  # [B, M] int32
-    seq_lens: jnp.ndarray,  # [B]
-    *,
-    scale: float | None = None,
-    interpret: bool | None = None,
+    block_tables: jnp.ndarray,
+    seq_lens: jnp.ndarray,
+    scale_v: float,
+    interpret: bool,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
-    """Pallas paged decode attention; contract identical to
-    :func:`paged_decode_attention_ref`. Streams only owned pages. The
-    [N, Hkv, page, Dh] pool layout keeps every BlockSpec's trailing two
-    dims equal to full array dims (page, Dh) — the Mosaic tiling rule."""
+    """Shared pallas_call plumbing for both pool widths."""
     B, H, Dh = q.shape
     Hkv, page = k_pool.shape[1], k_pool.shape[2]
     M = block_tables.shape[1]
     group = H // Hkv
-    scale_v = scale if scale is not None else 1.0 / math.sqrt(Dh)
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    quantized = k_scale is not None
 
     # [B, Hkv, group, Dh] so each program sees its kv-head's query group
     q_t = q.reshape(B, Hkv, group, Dh)
-
-    kernel = functools.partial(_paged_kernel, scale=scale_v, page=page)
+    kernel = functools.partial(
+        _paged_kernel, scale=scale_v, page=page, quantized=quantized
+    )
 
     def _kv_index(b, g, j, seq_lens, tables):
         # Clamp j to the sequence's last owned page: iterations past
@@ -159,19 +177,29 @@ def paged_decode_attention(
         last = jnp.maximum(pl.cdiv(seq_lens[b], page) - 1, 0)
         return (tables[b, jnp.minimum(j, last)], g, 0, 0)
 
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, group, Dh),
+            lambda b, g, j, seq_lens, tables: (b, g, 0, 0),
+        ),
+        # page j of sequence b: the scalar-prefetched block table drives
+        # the HBM->VMEM DMA — this is the "paged" part
+        pl.BlockSpec((1, 1, page, Dh), _kv_index),
+        pl.BlockSpec((1, 1, page, Dh), _kv_index),
+    ]
+    operands = [q_t, k_pool, v_pool]
+    kv_elem = 1 if quantized else k_pool.dtype.itemsize
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, 1, page, 1), _kv_index),
+            pl.BlockSpec((1, 1, page, 1), _kv_index),
+        ]
+        operands += [k_scale, v_scale]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # seq_lens, block_tables
         grid=(B, Hkv, M),
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1, group, Dh),
-                lambda b, g, j, seq_lens, tables: (b, g, 0, 0),
-            ),
-            # page j of sequence b: the scalar-prefetched block table drives
-            # the HBM->VMEM DMA — this is the "paged" part
-            pl.BlockSpec((1, 1, page, Dh), _kv_index),
-            pl.BlockSpec((1, 1, page, Dh), _kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, group, Dh),
             lambda b, g, j, seq_lens, tables: (b, g, 0, 0),
@@ -192,9 +220,69 @@ def paged_decode_attention(
         ),
         cost_estimate=pl.CostEstimate(
             flops=int(4 * B * H * M * page * Dh),
-            bytes_accessed=int(q.size * 2 + B * M * page * Hkv * Dh * 4),
+            bytes_accessed=int(
+                q.size * 2 + B * M * page * Hkv * (Dh * kv_elem + (4 if quantized else 0))
+            ),
             transcendentals=int(B * H * M * page),
         ),
         interpret=interpret,
-    )(seq_lens.astype(jnp.int32), block_tables.astype(jnp.int32), q_t, k_pool, v_pool)
+    )(seq_lens.astype(jnp.int32), block_tables.astype(jnp.int32), *operands)
     return out.reshape(B, H, Dh)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_decode_attention(
+    q: jnp.ndarray,  # [B, H, Dh]
+    k_pool: jnp.ndarray,  # [N_pages, Hkv, page, Dh]
+    v_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, M] int32
+    seq_lens: jnp.ndarray,  # [B]
+    *,
+    scale: float | None = None,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Pallas paged decode attention; contract identical to
+    :func:`paged_decode_attention_ref`. Streams only owned pages. The
+    [N, Hkv, page, Dh] pool layout keeps every BlockSpec's trailing two
+    dims equal to full array dims (page, Dh) — the Mosaic tiling rule."""
+    Dh = q.shape[-1]
+    scale_v = scale if scale is not None else 1.0 / math.sqrt(Dh)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _paged_attention_call(
+        q, k_pool, v_pool, block_tables, seq_lens, scale_v, interpret
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_decode_attention_q(
+    q: jnp.ndarray,  # [B, H, Dh]
+    k_pool: jnp.ndarray,  # [N_pages, Hkv, page, Dh] int8
+    v_pool: jnp.ndarray,
+    k_scale: jnp.ndarray,  # [N_pages, Hkv, page, 1] f32
+    v_scale: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, M] int32
+    seq_lens: jnp.ndarray,  # [B]
+    *,
+    scale: float | None = None,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Pallas paged decode attention over int8 pools (same kernel,
+    dequantizing in VMEM). Off-TPU, and for page sizes below the int8
+    Mosaic tile (:data:`INT8_MIN_PAGE` sublanes), falls back to the
+    gather reference — ServingEngine validates the page size up front so
+    the production path never lands in the fallback silently."""
+    Dh = q.shape[-1]
+    page = k_pool.shape[2]
+    scale_v = scale if scale is not None else 1.0 / math.sqrt(Dh)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not interpret and page < INT8_MIN_PAGE:
+        return paged_decode_attention_ref(
+            q, k_pool, v_pool, block_tables, seq_lens,
+            scale=scale_v, k_scale=k_scale, v_scale=v_scale,
+        )
+    return _paged_attention_call(
+        q, k_pool, v_pool, block_tables, seq_lens, scale_v, interpret,
+        k_scale=k_scale, v_scale=v_scale,
+    )

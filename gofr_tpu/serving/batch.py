@@ -187,6 +187,36 @@ def decode_and_sample_paged(
     return next_token, k_pool, v_pool, rng
 
 
+@partial(jax.jit, static_argnums=0, donate_argnums=(2, 3, 4, 5))
+def decode_and_sample_paged_q(
+    cfg: llama.LlamaConfig,
+    params: dict,
+    k_pool: jnp.ndarray,  # int8, donated
+    v_pool: jnp.ndarray,  # donated
+    ks_pool: jnp.ndarray,  # f32 scales, donated
+    vs_pool: jnp.ndarray,  # donated
+    block_tables: jnp.ndarray,
+    seq_lens: jnp.ndarray,
+    last_token: jnp.ndarray,
+    active: jnp.ndarray,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+    rng: jax.Array,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jax.Array]:
+    """int8 twin of :func:`decode_and_sample_paged`."""
+    step_len = jnp.where(active, jnp.maximum(seq_lens, 1), 1)
+    logits, k_pool, v_pool, ks_pool, vs_pool = llama.decode_step_paged_q(
+        cfg, params, last_token, k_pool, v_pool, ks_pool, vs_pool,
+        block_tables, step_len, active,
+    )
+    rng, sample_key = jax.random.split(rng)
+    next_token = sample_logits(
+        logits, sample_key, temperature=temperature, top_k=top_k, top_p=top_p
+    )
+    return next_token, k_pool, v_pool, ks_pool, vs_pool, rng
+
+
 def pad_bucket(length: int, buckets: tuple[int, ...]) -> int:
     """Smallest bucket ≥ length (prompt padding, limits recompiles)."""
     for b in buckets:

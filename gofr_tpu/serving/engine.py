@@ -182,21 +182,28 @@ class ServingEngine:
             raise ValueError(
                 f"TPU_KV_DTYPE={self.config.kv_dtype!r}: must be bf16 or int8"
             )
-        if self.config.kv_layout == "paged" and self.config.kv_dtype == "int8":
-            # silently running full-width would wreck capacity planning
-            # based on the halved footprint (code-review r4)
-            raise ValueError(
-                "TPU_KV_DTYPE=int8 is not supported with TPU_KV_LAYOUT=paged "
-                "yet; use the dense layout for quantized KV"
-            )
         if self.config.kv_layout == "paged":
             from gofr_tpu.serving.kv_cache import PagedKVCache
 
             page = self.config.kv_page_size
+            if self.config.kv_dtype == "int8" and page < 32:
+                import jax as _jax
+
+                if _jax.default_backend() == "tpu":
+                    # below the int8 Mosaic tile the kernel would silently
+                    # fall back to the full-gather reference, INVERTING the
+                    # bandwidth win int8 exists for (code-review r4)
+                    raise ValueError(
+                        f"TPU_KV_DTYPE=int8 with TPU_KV_LAYOUT=paged needs "
+                        f"TPU_KV_PAGE_SIZE>=32 on TPU (got {page}): smaller "
+                        "pages violate the int8 (32,128) tile and lose the "
+                        "halved-bandwidth kernel path"
+                    )
             num_pages = self.config.kv_num_pages or (B * S + page - 1) // page
             self.paged_cache = PagedKVCache(
                 cfg, num_pages=num_pages, page_size=page,
                 max_slots=B, max_seq_len=S,
+                kv_dtype="int8" if self.config.kv_dtype == "int8" else None,
             )
             self.cache = None
         else:
@@ -363,7 +370,10 @@ class ServingEngine:
         prompt_ids = (
             self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
         )
-        max_prompt = self.config.max_seq_len - 1
+        # keep the TAIL within both limits: the sequence budget AND the
+        # largest configured prefill bucket (a prompt longer than every
+        # bucket cannot be prefilled — it used to crash the slab scatter)
+        max_prompt = min(self.config.max_seq_len - 1, max(self._buckets()))
         prompt_ids = prompt_ids[-max_prompt:]
         budget = self.config.max_seq_len - len(prompt_ids)
         max_new = min(max_new_tokens or self.config.max_new_tokens_default, budget)
@@ -696,14 +706,24 @@ class ServingEngine:
         t0 = time.perf_counter()
         if self.paged_cache is not None:
             pc = self.paged_cache
-            (next_token, pc.k_pool, pc.v_pool, self.rng) = (
-                batch_ops.decode_and_sample_paged(
+            if pc.quantized:
+                (next_token, pc.k_pool, pc.v_pool, pc.ks_pool, pc.vs_pool,
+                 self.rng) = batch_ops.decode_and_sample_paged_q(
                     cfg, self.params, pc.k_pool, pc.v_pool,
+                    pc.ks_pool, pc.vs_pool,
                     pc.tables_device(), pc.seq_lens_device(),
                     self._last_tok_dev, mask_d,
                     temp_d, topk_d, topp_d, self.rng,
                 )
-            )
+            else:
+                (next_token, pc.k_pool, pc.v_pool, self.rng) = (
+                    batch_ops.decode_and_sample_paged(
+                        cfg, self.params, pc.k_pool, pc.v_pool,
+                        pc.tables_device(), pc.seq_lens_device(),
+                        self._last_tok_dev, mask_d,
+                        temp_d, topk_d, topp_d, self.rng,
+                    )
+                )
             self.cache_len = np.array(pc.seq_lens)
         else:
             # chunk size is ALL-or-one: the full multi_step chunk only when

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gofr_tpu.models.llama import quantize_kv
 from gofr_tpu.native.runtime import BlockAllocator, OutOfBlocks
 
 __all__ = ["PagedKVCache", "OutOfBlocks"]
@@ -47,6 +48,35 @@ def _write_pages(
     )
 
 
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def _write_pages_q(
+    k_pool: jnp.ndarray,  # [L, N, Hkv, page, Dh] int8, donated
+    v_pool: jnp.ndarray,
+    ks_pool: jnp.ndarray,  # [L, N, Hkv, page, 1] f32, donated
+    vs_pool: jnp.ndarray,
+    k_slab: jnp.ndarray,  # [L, S_pad, Hkv, Dh] full-width prefill slab
+    v_slab: jnp.ndarray,
+    page_ids: jnp.ndarray,  # [n_pages] int32
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """int8 twin of :func:`_write_pages`: per-vector absmax quantization
+    at the prefill scatter."""
+    L, S_pad, Hkv, Dh = k_slab.shape
+    n_pages = page_ids.shape[0]
+    page = S_pad // n_pages
+    kq, ks = quantize_kv(k_slab)  # int8 [L,S,Hkv,Dh], f32 [L,S,Hkv]
+    vq, vs = quantize_kv(v_slab)
+    k_pages = kq.reshape(L, n_pages, page, Hkv, Dh).transpose(0, 1, 3, 2, 4)
+    v_pages = vq.reshape(L, n_pages, page, Hkv, Dh).transpose(0, 1, 3, 2, 4)
+    ks_pages = ks.reshape(L, n_pages, page, Hkv, 1).transpose(0, 1, 3, 2, 4)
+    vs_pages = vs.reshape(L, n_pages, page, Hkv, 1).transpose(0, 1, 3, 2, 4)
+    return (
+        k_pool.at[:, page_ids].set(k_pages),
+        v_pool.at[:, page_ids].set(v_pages),
+        ks_pool.at[:, page_ids].set(ks_pages),
+        vs_pool.at[:, page_ids].set(vs_pages),
+    )
+
+
 class PagedKVCache:
     """Owns the device page pool + host page accounting for up to
     ``max_slots`` concurrent sequences."""
@@ -60,6 +90,7 @@ class PagedKVCache:
         max_slots: int = 8,
         max_seq_len: int = 1024,
         dtype: Any = None,
+        kv_dtype: str | None = None,
     ) -> None:
         self.cfg = cfg
         self.page_size = page_size
@@ -67,6 +98,7 @@ class PagedKVCache:
         self.max_slots = max_slots
         self.max_seq_len = max_seq_len
         self.max_pages_per_seq = (max_seq_len + page_size - 1) // page_size
+        self.quantized = kv_dtype == "int8"
         dtype = dtype or cfg.dtype
         # [L, N+1, Hkv, page, Dh]: trailing (page, Dh) are full dims in the
         # pallas BlockSpecs (ops/paged_attention.py) — Mosaic tiling rule.
@@ -74,8 +106,17 @@ class PagedKVCache:
         # appends are redirected there (llama.decode_step_paged), so the
         # scatter never has conflicting writes to a live page.
         shape = (cfg.n_layers, num_pages + 1, cfg.n_kv_heads, page_size, cfg.head_dim)
-        self.k_pool = jnp.zeros(shape, dtype)
-        self.v_pool = jnp.zeros(shape, dtype)
+        if self.quantized:
+            self.k_pool = jnp.zeros(shape, jnp.int8)
+            self.v_pool = jnp.zeros(shape, jnp.int8)
+            sshape = shape[:-1] + (1,)
+            self.ks_pool = jnp.zeros(sshape, jnp.float32)
+            self.vs_pool = jnp.zeros(sshape, jnp.float32)
+        else:
+            self.k_pool = jnp.zeros(shape, dtype)
+            self.v_pool = jnp.zeros(shape, dtype)
+            self.ks_pool = None
+            self.vs_pool = None
         self.allocator = BlockAllocator(num_pages, page_size)
         # host mirrors (authoritative): per-slot block table + length
         self.tables = np.zeros((max_slots, self.max_pages_per_seq), np.int32)
@@ -150,9 +191,15 @@ class PagedKVCache:
             owned = self.allocator.block_table(seq_id)
             self.tables[slot, : len(owned)] = owned
         page_ids = jnp.asarray(owned[:n_pages], jnp.int32)
-        self.k_pool, self.v_pool = _write_pages(
-            self.k_pool, self.v_pool, k_slab, v_slab, page_ids
-        )
+        if self.quantized:
+            (self.k_pool, self.v_pool, self.ks_pool, self.vs_pool) = _write_pages_q(
+                self.k_pool, self.v_pool, self.ks_pool, self.vs_pool,
+                k_slab, v_slab, page_ids,
+            )
+        else:
+            self.k_pool, self.v_pool = _write_pages(
+                self.k_pool, self.v_pool, k_slab, v_slab, page_ids
+            )
 
     def tables_device(self) -> jnp.ndarray:
         # .copy(): host→device transfers are async, and the engine's

@@ -215,3 +215,89 @@ class TestPagedDecodeParity:
             "active row's append should have written offset 4"
         )
         cache.close()
+
+
+# ---------------------------------------------------------------- int8 pools
+def test_paged_attention_q_matches_ref_dequant():
+    """Kernel (interpret) vs reference on int8 pools with scales."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gofr_tpu.models.llama import quantize_kv
+    from gofr_tpu.ops.paged_attention import (
+        paged_decode_attention_q,
+        paged_decode_attention_ref,
+    )
+
+    B, H, Hkv, Dh, page, N, M = 2, 4, 2, 16, 8, 6, 3
+    key = jax.random.PRNGKey(0)
+    kf = jax.random.normal(key, (N, Hkv, page, Dh), jnp.float32)
+    vf = jax.random.normal(jax.random.PRNGKey(1), (N, Hkv, page, Dh), jnp.float32)
+    kq, ks = quantize_kv(kf)
+    vq, vs = quantize_kv(vf)
+    ks = ks[..., None]
+    vs = vs[..., None]
+    q = jax.random.normal(jax.random.PRNGKey(2), (B, H, Dh), jnp.float32)
+    tables = jnp.array([[0, 2, 4], [1, 3, 5]], jnp.int32)
+    seq_lens = jnp.array([19, 8], jnp.int32)
+
+    ref = paged_decode_attention_ref(
+        q, kq, vq, tables, seq_lens, k_scale=ks, v_scale=vs
+    )
+    out = paged_decode_attention_q(
+        q, kq, vq, ks, vs, tables, seq_lens, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_paged_int8_engine_matches_prefill_and_is_deterministic():
+    """Paged int8 engine: first (prefill-path) token matches the bf16
+    paged engine; generation fully deterministic."""
+    import jax
+
+    from gofr_tpu.models import llama
+    from gofr_tpu.serving import ByteTokenizer, EngineConfig, ServingEngine
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    def mk(kv_dtype):
+        return ServingEngine(
+            cfg, params,
+            EngineConfig(max_slots=4, max_seq_len=64, prefill_buckets=(16, 32),
+                         kv_layout="paged", kv_page_size=8, kv_dtype=kv_dtype),
+            ByteTokenizer(),
+        )
+
+    ref, q = mk("bf16"), mk("int8")
+    assert q.paged_cache.quantized and not ref.paged_cache.quantized
+    ref.start(), q.start()
+    try:
+        for prompt in ("paged int8", "zz"):
+            a = ref.submit(prompt, max_new_tokens=6, temperature=0.0).result(timeout=120)
+            b = q.submit(prompt, max_new_tokens=6, temperature=0.0).result(timeout=120)
+            assert b.token_ids[0] == a.token_ids[0]
+            b2 = q.submit(prompt, max_new_tokens=6, temperature=0.0).result(timeout=120)
+            assert b2.token_ids == b.token_ids
+    finally:
+        ref.stop(), q.stop()
+
+
+def test_paged_int8_pool_memory_halves():
+    import jax.numpy as jnp
+
+    from gofr_tpu.models import llama
+    from gofr_tpu.serving.kv_cache import PagedKVCache
+
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.bfloat16)
+    full = PagedKVCache(cfg, num_pages=16, page_size=8, max_slots=4, max_seq_len=64)
+    quant = PagedKVCache(cfg, num_pages=16, page_size=8, max_slots=4,
+                         max_seq_len=64, kv_dtype="int8")
+    full_bytes = full.k_pool.nbytes + full.v_pool.nbytes
+    quant_bytes = (quant.k_pool.nbytes + quant.v_pool.nbytes
+                   + quant.ks_pool.nbytes + quant.vs_pool.nbytes)
+    ratio = (cfg.head_dim + 4) / (2 * cfg.head_dim)
+    assert quant_bytes <= ratio * full_bytes + 1
+    full.close()
+    quant.close()

@@ -323,3 +323,17 @@ def test_engine_multi_step_concurrent_mixed_lengths(engine_setup):
             assert r.completion_tokens == n or r.finish_reason == "stop"
     finally:
         engine.stop()
+
+
+def test_prompt_longer_than_largest_bucket_truncates(engine_setup):
+    """A prompt exceeding every prefill bucket keeps its tail instead of
+    crashing the prefill slab scatter (regression: shape (18,) into (16,))."""
+    cfg, params = engine_setup
+    engine = make_engine(cfg, params, prefill_buckets=(16,))
+    engine.start()
+    try:
+        r = engine.submit("x" * 40, max_new_tokens=3, temperature=0.0).result(timeout=120)
+        assert r.prompt_tokens <= 16
+        assert r.completion_tokens >= 1
+    finally:
+        engine.stop()
