@@ -450,19 +450,56 @@ def _http_generate_load(engine: Any, on_tpu: bool) -> dict:
 # --------------------------------------------------------------------------
 # phase 4: gRPC unary echo (BASELINE configs[0] — no TPU involved)
 # --------------------------------------------------------------------------
+_ECHO_CLIENT_CODE = r"""
+import asyncio, json, sys, time
+from gofr_tpu.grpcx import InferenceClient
+
+async def main(addr, duration, workers):
+    client = InferenceClient(addr)
+    payload = {"ping": 1, "payload": "x" * 64}
+    await client.echo(payload)
+    latencies = []
+    end_at = time.perf_counter() + duration
+
+    async def worker():
+        while time.perf_counter() < end_at:
+            t0 = time.perf_counter()
+            await client.echo(payload)
+            latencies.append(time.perf_counter() - t0)
+
+    t_start = time.perf_counter()
+    await asyncio.gather(*[worker() for _ in range(workers)])
+    measured = time.perf_counter() - t_start
+    await client.close()
+    latencies.sort()
+    n = len(latencies)
+    print(json.dumps({
+        "n": n, "elapsed": measured,
+        "p50": latencies[n // 2], "p95": latencies[min(n - 1, int(.95 * n))],
+        "p99": latencies[min(n - 1, int(.99 * n))],
+    }))
+
+addr, duration, workers = sys.argv[1], float(sys.argv[2]), int(sys.argv[3])
+asyncio.run(main(addr, duration, workers))
+"""
+
+
 def _grpc_unary_echo() -> dict:
     """Framework-overhead calibration through the full gRPC stack:
     recovery + observability interceptors, JSON body, asyncio server —
     the TPU-framework analogue of GoFr's handler overhead (SURVEY §6:
-    span + 2 goroutines + JSON encode + log + histogram per request)."""
+    span + 2 goroutines + JSON encode + log + histogram per request).
+    Clients run in SEPARATE PROCESSES so the measurement is the server's
+    capacity, not the shared-event-loop artifact of an in-process client."""
     import asyncio
 
     from gofr_tpu.config import MapConfig
-    from gofr_tpu.grpcx import GRPCServer, InferenceClient, InferenceService
+    from gofr_tpu.grpcx import GRPCServer, InferenceService
     from gofr_tpu.testutil import get_free_port, new_mock_container
 
     duration = float(os.environ.get("BENCH_GRPC_S", "6"))
-    concurrency = 16
+    n_procs = int(os.environ.get("BENCH_GRPC_PROCS", "4"))
+    workers_per_proc = 8
 
     async def scenario() -> dict:
         container, _ = new_mock_container()
@@ -470,31 +507,53 @@ def _grpc_unary_echo() -> dict:
         server = GRPCServer(container, port, MapConfig({}, use_env=False))
         server.register(InferenceService())
         await server.start()
-        client = InferenceClient(f"127.0.0.1:{port}")
-        latencies: list[float] = []
-        payload = {"ping": 1, "payload": "x" * 64}
         try:
-            await client.echo(payload)  # warm the channel
-            end_at = time.perf_counter() + duration
-
-            async def worker() -> None:
-                while time.perf_counter() < end_at:
-                    t0 = time.perf_counter()
-                    await client.echo(payload)
-                    latencies.append(time.perf_counter() - t0)
-
+            procs = [
+                await asyncio.create_subprocess_exec(
+                    sys.executable, "-c", _ECHO_CLIENT_CODE,
+                    f"127.0.0.1:{port}", str(duration), str(workers_per_proc),
+                    stdout=asyncio.subprocess.PIPE,
+                    stderr=asyncio.subprocess.PIPE,
+                    cwd=_REPO,
+                    env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                )
+                for _ in range(n_procs)
+            ]
             start = time.perf_counter()
-            await asyncio.gather(*[worker() for _ in range(concurrency)])
+            outs = await asyncio.gather(*[p.communicate() for p in procs])
             elapsed = time.perf_counter() - start
         finally:
-            await client.close()
             await server.shutdown(grace=0.5)
+
+        total = 0
+        rate = 0.0
+        p50s, p95s, p99s = [], [], []
+        for stdout, stderr in outs:
+            line = stdout.decode().strip().splitlines()
+            if not line:
+                raise RuntimeError(
+                    f"echo client produced no output: {stderr.decode()[-200:]}"
+                )
+            stats = json.loads(line[-1])
+            total += stats["n"]
+            # each client reports its own measurement window: the wall
+            # above includes interpreter/jax startup, which is not load
+            rate += stats["n"] / stats["elapsed"]
+            p50s.append(stats["p50"])
+            p95s.append(stats["p95"])
+            p99s.append(stats["p99"])
         return {
-            "requests": len(latencies),
+            "requests": total,
             "duration_s": round(elapsed, 2),
-            "concurrency": concurrency,
-            "req_per_s": round(len(latencies) / elapsed, 2),
-            "latency": _percentiles(latencies),
+            "client_processes": n_procs,
+            "workers_per_process": workers_per_proc,
+            "req_per_s": round(rate, 2),
+            "latency": {
+                "p50_ms": round(1e3 * sorted(p50s)[len(p50s) // 2], 2),
+                "p95_ms": round(1e3 * max(p95s), 2),
+                "p99_ms": round(1e3 * max(p99s), 2),
+                "n": total,
+            },
         }
 
     return asyncio.run(scenario())

@@ -107,6 +107,12 @@ class EventHubClient:
         self._rbuf = b""
         self._wlock = threading.Lock()
         self._lock = threading.Lock()
+        # registry lock: guards _receivers ONLY and is never held across a
+        # blocking wait — the reader thread takes it on DETACH, and taking
+        # self._lock there instead could deadlock-by-timeout (the attach
+        # path holds self._lock while waiting for echoes only the reader
+        # can deliver)
+        self._reg_lock = threading.Lock()
         self._handles = itertools.count(0)
         self._delivery_ids = itertools.count(0)
         self._links: dict[int, _Link] = {}  # local handle → link
@@ -299,12 +305,11 @@ class EventHubClient:
             if link is not None:
                 # a detached receiver must leave the topic's poll set, or
                 # subscribe() burns its per-link timeout on a dead queue
-                # forever — and the removal must hold the client lock like
-                # every other _receivers mutation, or it races subscribe()'s
-                # snapshot (code-review r4 x2)
-                with self._lock:
-                    self._links.pop(link.handle, None)
-                    self._senders.pop(link.address, None)
+                # forever. The REGISTRY lock serializes this against
+                # subscribe()'s snapshot; dict pops are GIL-atomic.
+                self._links.pop(link.handle, None)
+                self._senders.pop(link.address, None)
+                with self._reg_lock:
                     for topic, links in list(self._receivers.items()):
                         if link in links:
                             links.remove(link)
@@ -364,13 +369,16 @@ class EventHubClient:
     def _ensure_receivers(self, topic: str) -> list[_Link]:
         with self._lock:
             self._ensure_connected()
-            links = self._receivers.get(topic)
-            if not links:
-                links = [self._attach("receiver", a)
-                         for a in self._partition_addresses(topic)]
+            with self._reg_lock:
+                links = self._receivers.get(topic)
+                if links:
+                    # COPY: the reader thread mutates the stored list on
+                    # detach while subscribe() iterates its snapshot
+                    return list(links)
+            links = [self._attach("receiver", a)
+                     for a in self._partition_addresses(topic)]
+            with self._reg_lock:
                 self._receivers[topic] = links
-            # COPY under the lock: the reader thread mutates the stored
-            # list on detach while subscribe() iterates its snapshot
             return list(links)
 
     # -- pubsub contract ---------------------------------------------------

@@ -639,7 +639,12 @@ def decode_step_paged_q(
     pages = jnp.where(active, block_tables[b_idx, pos // page], trash_page)
     offsets = jnp.where(active, pos % page, 0)
 
-    from gofr_tpu.ops.paged_attention import paged_decode_attention_q
+    from gofr_tpu.ops.paged_attention import (
+        paged_decode_attention_q,
+        paged_decode_attention_ref,
+    )
+
+    use_kernel = jax.default_backend() == "tpu"
 
     def body(h, xs):
         lp, kc, vc, ksc, vsc = xs
@@ -658,9 +663,14 @@ def decode_step_paged_q(
         ksc = ksc.at[pages, :, offsets, 0].set(ks)
         vsc = vsc.at[pages, :, offsets, 0].set(vs)
 
-        attn = paged_decode_attention_q(
-            q, kc, vc, ksc, vsc, block_tables, seq_lens
-        )
+        if use_kernel:
+            attn = paged_decode_attention_q(
+                q, kc, vc, ksc, vsc, block_tables, seq_lens
+            )
+        else:  # off-TPU: XLA gather reference beats the interpreted kernel
+            attn = paged_decode_attention_ref(
+                q, kc, vc, block_tables, seq_lens, k_scale=ksc, v_scale=vsc
+            )
         h = h + _mm(attn.reshape(B, 1, H * Dh), lp["wo"])
         hn = rms_norm(h, lp["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(_mm(hn, lp["w_gate"]).astype(jnp.float32)).astype(hn.dtype)

@@ -220,8 +220,10 @@ def _paged_attention_call(
         ),
         cost_estimate=pl.CostEstimate(
             flops=int(4 * B * H * M * page * Dh),
+            # K AND V pools (+ both scale arrays when quantized)
             bytes_accessed=int(
-                q.size * 2 + B * M * page * Hkv * (Dh * kv_elem + (4 if quantized else 0))
+                q.size * 2
+                + 2 * B * M * page * Hkv * (Dh * kv_elem + (4 if quantized else 0))
             ),
             transcendentals=int(B * H * M * page),
         ),

@@ -186,7 +186,9 @@ class ServingEngine:
             from gofr_tpu.serving.kv_cache import PagedKVCache
 
             page = self.config.kv_page_size
-            if self.config.kv_dtype == "int8" and page < 32:
+            from gofr_tpu.ops.paged_attention import INT8_MIN_PAGE
+
+            if self.config.kv_dtype == "int8" and page < INT8_MIN_PAGE:
                 import jax as _jax
 
                 if _jax.default_backend() == "tpu":
@@ -195,9 +197,9 @@ class ServingEngine:
                     # bandwidth win int8 exists for (code-review r4)
                     raise ValueError(
                         f"TPU_KV_DTYPE=int8 with TPU_KV_LAYOUT=paged needs "
-                        f"TPU_KV_PAGE_SIZE>=32 on TPU (got {page}): smaller "
-                        "pages violate the int8 (32,128) tile and lose the "
-                        "halved-bandwidth kernel path"
+                        f"TPU_KV_PAGE_SIZE>={INT8_MIN_PAGE} on TPU (got "
+                        f"{page}): smaller pages violate the int8 Mosaic "
+                        "tile and lose the halved-bandwidth kernel path"
                     )
             num_pages = self.config.kv_num_pages or (B * S + page - 1) // page
             self.paged_cache = PagedKVCache(
