@@ -471,12 +471,11 @@ async def main(addr, duration, workers):
     await asyncio.gather(*[worker() for _ in range(workers)])
     measured = time.perf_counter() - t_start
     await client.close()
-    latencies.sort()
-    n = len(latencies)
+    # raw latencies (ms, 2dp) so the parent computes TRUE pooled
+    # percentiles — max-of-per-process-p95s overstates the tail
     print(json.dumps({
-        "n": n, "elapsed": measured,
-        "p50": latencies[n // 2], "p95": latencies[min(n - 1, int(.95 * n))],
-        "p99": latencies[min(n - 1, int(.99 * n))],
+        "n": len(latencies), "elapsed": measured,
+        "lat_ms": [round(v * 1e3, 2) for v in latencies],
     }))
 
 addr, duration, workers = sys.argv[1], float(sys.argv[2]), int(sys.argv[3])
@@ -527,7 +526,7 @@ def _grpc_unary_echo() -> dict:
 
         total = 0
         rate = 0.0
-        p50s, p95s, p99s = [], [], []
+        pooled: list[float] = []
         for stdout, stderr in outs:
             line = stdout.decode().strip().splitlines()
             if not line:
@@ -539,21 +538,14 @@ def _grpc_unary_echo() -> dict:
             # each client reports its own measurement window: the wall
             # above includes interpreter/jax startup, which is not load
             rate += stats["n"] / stats["elapsed"]
-            p50s.append(stats["p50"])
-            p95s.append(stats["p95"])
-            p99s.append(stats["p99"])
+            pooled.extend(stats["lat_ms"])
         return {
             "requests": total,
             "duration_s": round(elapsed, 2),
             "client_processes": n_procs,
             "workers_per_process": workers_per_proc,
             "req_per_s": round(rate, 2),
-            "latency": {
-                "p50_ms": round(1e3 * sorted(p50s)[len(p50s) // 2], 2),
-                "p95_ms": round(1e3 * max(p95s), 2),
-                "p99_ms": round(1e3 * max(p99s), 2),
-                "n": total,
-            },
+            "latency": _percentiles([v / 1e3 for v in pooled]),
         }
 
     return asyncio.run(scenario())

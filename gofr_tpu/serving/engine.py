@@ -56,6 +56,11 @@ class EngineConfig:
     # per-dispatch host/tunnel overhead; a row that stops mid-chunk wastes
     # the tail steps, so keep small for stop-heavy workloads
     multi_step: int = 1
+    # prompt-prefill (prefix) cache entries; 0 disables. A repeated prompt
+    # skips its entire prefill forward pass (serving/prefix_cache.py).
+    # The byte bound caps HBM regardless of bucket sizes.
+    prefix_cache_entries: int = 0
+    prefix_cache_bytes: int = 256 * 1024 * 1024
 
     @classmethod
     def from_config(cls, config: Any) -> "EngineConfig":
@@ -87,6 +92,13 @@ class EngineConfig:
             kv_num_pages=int(num_pages) if num_pages else None,
             kv_dtype=config.get_or_default("TPU_KV_DTYPE", "bf16"),
             multi_step=int(config.get_or_default("TPU_BATCH_MULTI_STEP", "1")),
+            prefix_cache_entries=int(
+                config.get_or_default("TPU_PREFIX_CACHE_ENTRIES", "0")
+            ),
+            prefix_cache_bytes=int(
+                config.get_or_default("TPU_PREFIX_CACHE_BYTES",
+                                      str(256 * 1024 * 1024))
+            ),
         )
 
 
@@ -168,6 +180,7 @@ class ServingEngine:
         logger: Any = None,
         tracer: Any = None,
         seed: int = 0,
+        prefix_cache: Any = None,
     ) -> None:
         self.model_cfg = cfg
         self.params = params
@@ -176,6 +189,17 @@ class ServingEngine:
         self._metrics = metrics
         self._logger = logger
         self._tracer = tracer
+        if prefix_cache is not None:
+            self._prefix_cache = prefix_cache  # any container Cache impl
+        elif self.config.prefix_cache_entries > 0:
+            from gofr_tpu.serving.prefix_cache import PrefixCache
+
+            self._prefix_cache = PrefixCache(
+                self.config.prefix_cache_entries,
+                max_bytes=self.config.prefix_cache_bytes,
+            )
+        else:
+            self._prefix_cache = None
 
         B, S = self.config.max_slots, self.config.max_seq_len
         if self.config.kv_dtype not in ("bf16", "int8"):
@@ -346,6 +370,8 @@ class ServingEngine:
         }
         if self.paged_cache is not None and self._running:
             details["kv_pages"] = self.paged_cache.stats()
+        if self._prefix_cache is not None:
+            details["prefix_cache"] = self._prefix_cache.stats()
         return {"status": "UP" if self._running else "DOWN", "details": details}
 
     # ------------------------------------------------------------- submission
@@ -563,11 +589,27 @@ class ServingEngine:
             except OutOfBlocks:
                 raise _RequeueRequest() from None
 
-        span = self._span(f"serve.prefill b{bucket}")
+        cache_key = None
+        cached = None
+        if self._prefix_cache is not None:
+            # sampling params are NOT in the key: the cached value is the
+            # pre-sampling prefill output, shared across temperatures
+            cache_key = (bucket, tuple(req.prompt_ids))
+            cached = self._prefix_cache.get(cache_key)
+
+        span = self._span(
+            f"serve.prefill b{bucket}" + (" (prefix hit)" if cached else "")
+        )
         with span:
-            last_logits, k_slab, v_slab = batch_ops.prefill_compute(
-                cfg, self.params, jnp.asarray(tokens), seq_len
-            )
+            if cached is not None:
+                last_logits, k_slab, v_slab = cached
+            else:
+                last_logits, k_slab, v_slab = batch_ops.prefill_compute(
+                    cfg, self.params, jnp.asarray(tokens), seq_len
+                )
+                if cache_key is not None:
+                    # slabs are fresh, never-donated arrays: safe to retain
+                    self._prefix_cache.put(cache_key, (last_logits, k_slab, v_slab))
             if self.paged_cache is not None:
                 self.paged_cache.write_prefill(slot, k_slab, v_slab)
             elif self.cache.quantized:

@@ -1,0 +1,111 @@
+"""Prompt-prefill (prefix) cache for the serving engine.
+
+Implements the container ``Cache`` contract (container/datasources.py:
+get/put/evict/stats — the TPU-build addition for KV-prefix reuse): the
+engine keys an entry by (prefill bucket, prompt token ids) and stores
+the prefill's outputs — last-position logits plus the K/V slabs — so a
+REPEATED prompt skips the entire prefill forward pass and admits at
+decode cost. System prompts, retried requests, and health probes are
+the common repeat offenders; sampling params are NOT part of the key
+(sampling happens after the cached logits).
+
+Device memory per entry is one prompt-bucket of KV
+(2 x L x bucket x Hkv x Dh weights-dtype; ~8 MB for an 8B model at
+bucket 64), bounded by LRU eviction over ``max_entries``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Hashable
+
+
+def _value_bytes(value: Any) -> int:
+    total = 0
+    for leaf in _tree_leaves(value):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is not None:
+            total += int(nbytes)
+    return total
+
+
+def _tree_leaves(value: Any):
+    if isinstance(value, (tuple, list)):
+        for v in value:
+            yield from _tree_leaves(v)
+    elif isinstance(value, dict):
+        for v in value.values():
+            yield from _tree_leaves(v)
+    else:
+        yield value
+
+
+class PrefixCache:
+    """Thread-safe LRU keyed by arbitrary hashables. Values are pytrees
+    of device arrays; eviction drops the reference and lets the device
+    allocator reclaim the buffers.
+
+    Eviction is bounded by BOTH entry count and cumulative bytes: entry
+    sizes vary ~64x across prefill buckets (32..2048 tokens), so an
+    entry cap alone cannot bound HBM — a workload of long repeated
+    prompts would pin gigabytes beside the serving KV cache."""
+
+    def __init__(self, max_entries: int = 32,
+                 max_bytes: int = 256 * 1024 * 1024) -> None:
+        self.max_entries = max(1, max_entries)
+        self.max_bytes = max_bytes
+        self._entries: "OrderedDict[Hashable, Any]" = OrderedDict()
+        self._sizes: dict[Hashable, int] = {}
+        self._total_bytes = 0
+        self._lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+
+    def get(self, key: Hashable) -> Any | None:
+        with self._lock:
+            value = self._entries.get(key)
+            if value is None:
+                self._misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self._hits += 1
+            return value
+
+    def put(self, key: Hashable, value: Any) -> None:
+        size = _value_bytes(value)
+        with self._lock:
+            if key in self._entries:
+                self._total_bytes -= self._sizes.get(key, 0)
+            self._entries[key] = value
+            self._sizes[key] = size
+            self._total_bytes += size
+            self._entries.move_to_end(key)
+            while self._entries and (
+                len(self._entries) > self.max_entries
+                or self._total_bytes > self.max_bytes
+            ):
+                old_key, _ = self._entries.popitem(last=False)
+                self._total_bytes -= self._sizes.pop(old_key, 0)
+
+    def evict(self, key: Hashable) -> None:
+        with self._lock:
+            if self._entries.pop(key, None) is not None:
+                self._total_bytes -= self._sizes.pop(key, 0)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._sizes.clear()
+            self._total_bytes = 0
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "max_entries": self.max_entries,
+                "bytes": self._total_bytes,
+                "max_bytes": self.max_bytes,
+                "hits": self._hits,
+                "misses": self._misses,
+            }

@@ -337,3 +337,67 @@ def test_prompt_longer_than_largest_bucket_truncates(engine_setup):
         assert r.completion_tokens >= 1
     finally:
         engine.stop()
+
+
+def test_prefix_cache_skips_repeat_prefills(engine_setup):
+    """A repeated prompt hits the prefill cache (same tokens, hit counted)
+    and different sampling params share one cached entry."""
+    cfg, params = engine_setup
+    engine = make_engine(cfg, params, prefix_cache_entries=8)
+    engine.start()
+    try:
+        a = engine.submit("cache me", max_new_tokens=5, temperature=0.0).result(timeout=120)
+        stats = engine._prefix_cache.stats()
+        assert (stats["entries"], stats["hits"], stats["misses"]) == (1, 0, 1)
+        assert 0 < stats["bytes"] <= stats["max_bytes"]
+        b = engine.submit("cache me", max_new_tokens=5, temperature=0.0).result(timeout=120)
+        assert b.token_ids == a.token_ids  # identical generation from the hit
+        assert engine._prefix_cache.stats()["hits"] == 1
+        # different sampling params reuse the same pre-sampling entry
+        engine.submit("cache me", max_new_tokens=3, temperature=0.8).result(timeout=120)
+        assert engine._prefix_cache.stats()["hits"] == 2
+        health = engine.health_check()["details"]
+        assert health["prefix_cache"]["hits"] == 2
+    finally:
+        engine.stop()
+
+
+def test_prefix_cache_lru_bound(engine_setup):
+    cfg, params = engine_setup
+    engine = make_engine(cfg, params, prefix_cache_entries=2)
+    engine.start()
+    try:
+        for p in ("p1", "p2", "p3"):
+            engine.submit(p, max_new_tokens=2, temperature=0.0).result(timeout=120)
+        stats = engine._prefix_cache.stats()
+        assert stats["entries"] == 2  # LRU evicted the oldest
+        # evicted prompt misses again; resident prompt hits
+        engine.submit("p1", max_new_tokens=2, temperature=0.0).result(timeout=120)
+        engine.submit("p3", max_new_tokens=2, temperature=0.0).result(timeout=120)
+        s = engine._prefix_cache.stats()
+        assert s["hits"] == 1 and s["misses"] == 4
+    finally:
+        engine.stop()
+
+
+def test_prefix_cache_satisfies_container_contract():
+    from gofr_tpu.container.datasources import Cache
+    from gofr_tpu.serving.prefix_cache import PrefixCache
+
+    assert isinstance(PrefixCache(), Cache)
+
+
+def test_prefix_cache_byte_bound():
+    """HBM is bounded by cumulative bytes, not just entry count (entry
+    sizes vary ~64x across prefill buckets)."""
+    import numpy as np
+
+    from gofr_tpu.serving.prefix_cache import PrefixCache
+
+    cache = PrefixCache(max_entries=100, max_bytes=10_000)
+    for i in range(5):
+        cache.put(("k", i), (np.zeros(1000, np.float32),))  # 4 KB each
+    s = cache.stats()
+    assert s["entries"] == 2 and s["bytes"] <= 10_000  # byte bound won
+    cache.evict(("k", 4))
+    assert cache.stats()["bytes"] <= 4000
