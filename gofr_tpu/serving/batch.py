@@ -217,6 +217,86 @@ def decode_and_sample_paged_q(
     return next_token, k_pool, v_pool, ks_pool, vs_pool, rng
 
 
+@partial(jax.jit, static_argnums=(0, 12), donate_argnums=(2, 3))
+def decode_and_sample_paged_multi(
+    cfg: llama.LlamaConfig,
+    params: dict,
+    k_pool: jnp.ndarray,  # donated
+    v_pool: jnp.ndarray,  # donated
+    block_tables: jnp.ndarray,  # [B, M] — already covers the whole chunk
+    seq_start: jnp.ndarray,  # [B] length INCLUDING the chunk's first token
+    last_token: jnp.ndarray,  # [B]
+    active: jnp.ndarray,  # [B] bool
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+    rng: jax.Array,
+    steps: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jax.Array]:
+    """``steps`` paged decode iterations in ONE dispatch. The page
+    accounting happened up front (PagedKVCache.try_extend_chunk), so the
+    block tables already address every position the chunk writes; step s
+    runs at length ``seq_start + s``. Returns (tokens [B, steps],
+    final_token, k_pool, v_pool, rng)."""
+
+    def step(carry, s):
+        kp, vp, last, r = carry
+        step_len = jnp.where(active, seq_start + s, 1)
+        logits, kp, vp = llama.decode_step_paged(
+            cfg, params, last, kp, vp, block_tables, step_len, active
+        )
+        r, key = jax.random.split(r)
+        nxt = sample_logits(
+            logits, key, temperature=temperature, top_k=top_k, top_p=top_p
+        )
+        return (kp, vp, nxt, r), nxt
+
+    (k_pool, v_pool, last, rng), toks = jax.lax.scan(
+        step, (k_pool, v_pool, last_token, rng), jnp.arange(steps)
+    )
+    return jnp.transpose(toks), last, k_pool, v_pool, rng
+
+
+@partial(jax.jit, static_argnums=(0, 14), donate_argnums=(2, 3, 4, 5))
+def decode_and_sample_paged_multi_q(
+    cfg: llama.LlamaConfig,
+    params: dict,
+    k_pool: jnp.ndarray,  # int8, donated
+    v_pool: jnp.ndarray,
+    ks_pool: jnp.ndarray,  # f32 scales, donated
+    vs_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    seq_start: jnp.ndarray,
+    last_token: jnp.ndarray,
+    active: jnp.ndarray,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+    rng: jax.Array,
+    steps: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray,
+           jnp.ndarray, jax.Array]:
+    """int8 twin of :func:`decode_and_sample_paged_multi`."""
+
+    def step(carry, s):
+        kp, vp, ksp, vsp, last, r = carry
+        step_len = jnp.where(active, seq_start + s, 1)
+        logits, kp, vp, ksp, vsp = llama.decode_step_paged_q(
+            cfg, params, last, kp, vp, ksp, vsp, block_tables, step_len, active
+        )
+        r, key = jax.random.split(r)
+        nxt = sample_logits(
+            logits, key, temperature=temperature, top_k=top_k, top_p=top_p
+        )
+        return (kp, vp, ksp, vsp, nxt, r), nxt
+
+    (k_pool, v_pool, ks_pool, vs_pool, last, rng), toks = jax.lax.scan(
+        step, (k_pool, v_pool, ks_pool, vs_pool, last_token, rng),
+        jnp.arange(steps),
+    )
+    return jnp.transpose(toks), last, k_pool, v_pool, ks_pool, vs_pool, rng
+
+
 def pad_bucket(length: int, buckets: tuple[int, ...]) -> int:
     """Smallest bucket ≥ length (prompt padding, limits recompiles)."""
     for b in buckets:

@@ -52,9 +52,10 @@ class EngineConfig:
     # "int8" stores dense KV quantized (per-vector absmax; llama.KVCache):
     # half the decode HBM stream, double the resident slots per GB
     kv_dtype: str = "bf16"
-    # decode tokens per device dispatch (dense layout): chunks amortize
-    # per-dispatch host/tunnel overhead; a row that stops mid-chunk wastes
-    # the tail steps, so keep small for stop-heavy workloads
+    # decode tokens per device dispatch (dense AND paged layouts): chunks
+    # amortize per-dispatch host/tunnel overhead; a row that stops
+    # mid-chunk wastes the tail steps, so keep small for stop-heavy
+    # workloads
     multi_step: int = 1
     # prompt-prefill (prefix) cache entries; 0 disables. A repeated prompt
     # skips its entire prefill forward pass (serving/prefix_cache.py).
@@ -593,8 +594,16 @@ class ServingEngine:
         cached = None
         if self._prefix_cache is not None:
             # sampling params are NOT in the key: the cached value is the
-            # pre-sampling prefill output, shared across temperatures
-            cache_key = (bucket, tuple(req.prompt_ids))
+            # pre-sampling prefill output, shared across temperatures.
+            # A STRING key keeps the injected-cache contract (the container
+            # Cache protocol declares str keys; a datasource-backed cache
+            # can serialize it directly).
+            import hashlib as _hashlib
+
+            digest = _hashlib.blake2b(
+                np.asarray(req.prompt_ids, np.int32).tobytes(), digest_size=16
+            ).hexdigest()
+            cache_key = f"prefill:{bucket}:{len(req.prompt_ids)}:{digest}"
             cached = self._prefix_cache.get(cache_key)
 
         span = self._span(
@@ -666,6 +675,16 @@ class ServingEngine:
             self._consume_decode(prev)
         return inflight is not None or prev is not None
 
+    def _chunk_absorb(self, rows: list) -> int:
+        """How many decode steps EVERY row can absorb without crossing its
+        max_new/max_seq limits (chunk feasibility)."""
+        return min(
+            min(req.max_new_tokens - (1 + req.dispatched) for _, req in rows),
+            min(self.config.max_seq_len - 1
+                - (len(req.prompt_ids) + 1 + req.dispatched)
+                for _, req in rows),
+        )
+
     def _dispatch_decode(self) -> _Inflight | None:
         cfg = self.model_cfg
         max_seq = self.config.max_seq_len
@@ -685,7 +704,16 @@ class ServingEngine:
                 continue  # final token already in flight; retires at consume
             rows.append((slot, req))
 
+        T_paged = 1
         if self.paged_cache is not None:
+            # chunked paged decode: all-or-nothing page accounting up front
+            # (a partial extend would desync the chunk's device lengths)
+            if self.config.multi_step > 1 and rows:
+                if (self._chunk_absorb(rows) >= self.config.multi_step
+                        and self.paged_cache.try_extend_chunk(
+                            [slot for slot, _ in rows], self.config.multi_step)):
+                    T_paged = self.config.multi_step
+        if self.paged_cache is not None and T_paged == 1:
             # account the new position before dispatch; a pool-exhausted row
             # retires with what it has (finish_reason "length") instead of
             # stalling the whole batch
@@ -748,6 +776,35 @@ class ServingEngine:
         mask_d = self._mask_dev
 
         t0 = time.perf_counter()
+        if self.paged_cache is not None and T_paged > 1:
+            pc = self.paged_cache
+            # first chunk token's length: seq_lens already includes all T
+            seq_start = jnp.asarray(
+                np.maximum(np.array(pc.seq_lens) - (T_paged - 1), 1)
+            )
+            if pc.quantized:
+                (tokens, last, pc.k_pool, pc.v_pool, pc.ks_pool, pc.vs_pool,
+                 self.rng) = batch_ops.decode_and_sample_paged_multi_q(
+                    cfg, self.params, pc.k_pool, pc.v_pool,
+                    pc.ks_pool, pc.vs_pool,
+                    pc.tables_device(), seq_start,
+                    self._last_tok_dev, mask_d,
+                    temp_d, topk_d, topp_d, self.rng, T_paged,
+                )
+            else:
+                (tokens, last, pc.k_pool, pc.v_pool, self.rng) = (
+                    batch_ops.decode_and_sample_paged_multi(
+                        cfg, self.params, pc.k_pool, pc.v_pool,
+                        pc.tables_device(), seq_start,
+                        self._last_tok_dev, mask_d,
+                        temp_d, topk_d, topp_d, self.rng, T_paged,
+                    )
+                )
+            self._last_tok_dev = last
+            self.cache_len = np.array(pc.seq_lens)
+            for _, req in rows:
+                req.dispatched += T_paged
+            return _Inflight(tokens, rows, t0, steps=T_paged)
         if self.paged_cache is not None:
             pc = self.paged_cache
             if pc.quantized:
@@ -776,16 +833,9 @@ class ServingEngine:
             # argnum — intermediate sizes would each compile their own
             # executable (and did, on the clock, before this guard)
             T = 1
-            if self.config.multi_step > 1:
-                absorb = min(
-                    min(req.max_new_tokens - (1 + req.dispatched)
-                        for _, req in rows),
-                    min(self.config.max_seq_len - 1
-                        - (len(req.prompt_ids) + 1 + req.dispatched)
-                        for _, req in rows),
-                )
-                if absorb >= self.config.multi_step:
-                    T = self.config.multi_step
+            if (self.config.multi_step > 1
+                    and self._chunk_absorb(rows) >= self.config.multi_step):
+                T = self.config.multi_step
             if T > 1:
                 (tokens, last, self.cache, self._cache_len_dev, self.rng) = (
                     batch_ops.decode_and_sample_multi(

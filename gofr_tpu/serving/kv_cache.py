@@ -154,6 +154,34 @@ class PagedKVCache:
             self.tables[slot, : len(table)] = table
         self.seq_lens[slot] = new_len
 
+    def try_extend_chunk(self, slots: list[int], tokens: int) -> bool:
+        """Account ``tokens`` appended positions for EVERY slot, or none:
+        chunked decode needs all-or-nothing page accounting (a partial
+        extend would desync the chunk's device-side lengths). Returns
+        False without touching state when the pool cannot cover the whole
+        chunk."""
+        needed = 0
+        for slot in slots:
+            seq_id = self._slot_seq[slot]
+            assert seq_id is not None
+            new_len = int(self.seq_lens[slot]) + tokens
+            # compare against blocks actually OWNED: the reservation may
+            # sit mid-page, in which case the remaining page capacity
+            # absorbs the chunk with zero new blocks (code-review r4)
+            owned = len(self.allocator.block_table(seq_id))
+            needed += max(0, self.pages_needed(new_len) - owned)
+        if needed > self.allocator.stats()["free_blocks"]:
+            return False
+        for slot in slots:
+            seq_id = self._slot_seq[slot]
+            new_len = int(self.seq_lens[slot]) + tokens
+            if new_len > self.allocator.seq_length(seq_id):
+                self.allocator.extend(seq_id, new_len)
+                table = self.allocator.block_table(seq_id)
+                self.tables[slot, : len(table)] = table
+            self.seq_lens[slot] = new_len
+        return True
+
     def free_slot(self, slot: int) -> None:
         seq_id = self._slot_seq[slot]
         if seq_id is None:

@@ -74,6 +74,10 @@ class PrefixCache:
 
     def put(self, key: Hashable, value: Any) -> None:
         size = _value_bytes(value)
+        if size > self.max_bytes:
+            # inserting-then-evicting would flush every useful entry to
+            # make room for one that cannot fit anyway (code-review r4)
+            return
         with self._lock:
             if key in self._entries:
                 self._total_bytes -= self._sizes.get(key, 0)

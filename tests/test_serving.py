@@ -401,3 +401,19 @@ def test_prefix_cache_byte_bound():
     assert s["entries"] == 2 and s["bytes"] <= 10_000  # byte bound won
     cache.evict(("k", 4))
     assert cache.stats()["bytes"] <= 4000
+
+
+def test_prefix_cache_rejects_oversized_entry():
+    """An entry larger than max_bytes is rejected up front — inserting it
+    would evict every useful entry and then itself."""
+    import numpy as np
+
+    from gofr_tpu.serving.prefix_cache import PrefixCache
+
+    cache = PrefixCache(max_entries=10, max_bytes=5000)
+    cache.put("hot", (np.zeros(500, np.float32),))  # 2 KB, fits
+    cache.put("huge", (np.zeros(5000, np.float32),))  # 20 KB, cannot fit
+    s = cache.stats()
+    assert s["entries"] == 1  # hot entry survived, huge rejected
+    assert cache.get("hot") is not None
+    assert cache.get("huge") is None

@@ -123,3 +123,38 @@ def test_cancellation_frees_pages(setup):
         assert stats["free_blocks"] == stats["total_blocks"]
     finally:
         engine.stop()
+
+
+def test_paged_multi_step_matches_single(setup):
+    """Chunked paged decode equals single-step greedy (bf16 and int8)."""
+    cfg, params = setup
+    for kv_dtype in ("bf16", "int8"):
+        single = make_engine(cfg, params, kv_dtype=kv_dtype, multi_step=1)
+        chunked = make_engine(cfg, params, kv_dtype=kv_dtype, multi_step=4)
+        single.start(), chunked.start()
+        try:
+            for prompt, n in (("chunk paged", 11), ("q", 6)):
+                a = single.submit(prompt, max_new_tokens=n, temperature=0.0).result(timeout=120)
+                b = chunked.submit(prompt, max_new_tokens=n, temperature=0.0).result(timeout=120)
+                assert b.token_ids == a.token_ids, (kv_dtype, prompt)
+        finally:
+            single.stop(), chunked.stop()
+
+
+def test_paged_multi_step_pool_pressure_falls_back(setup):
+    """When the pool cannot cover a whole chunk, dispatch falls back to
+    single steps (with the per-row OutOfBlocks handling) instead of
+    corrupting the chunk accounting; everyone still completes."""
+    cfg, params = setup
+    engine = make_engine(cfg, params, kv_num_pages=8, max_slots=4, multi_step=4)
+    engine.start()
+    try:
+        futs = [engine.submit("abcdefghij", max_new_tokens=6) for _ in range(5)]
+        results = [f.result(timeout=180) for f in futs]
+        for r in results:
+            assert r.finish_reason in ("stop", "length")
+            assert r.completion_tokens > 0
+        stats = engine.paged_cache.stats()
+        assert stats["free_blocks"] == stats["total_blocks"]
+    finally:
+        engine.stop()
