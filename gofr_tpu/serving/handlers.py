@@ -103,6 +103,42 @@ def _sse_response(engine: Any, prompt: str, kw: dict) -> WireResponse:
     )
 
 
+def register_generation_ws(app: Any, engine: Any, path: str = "/ws/generate") -> None:
+    """WebSocket token streaming: each inbound message is a generate
+    request; tokens push back as JSON frames, then a final summary frame.
+    The WS twin of the SSE stream (gofr websocket.go:30-49 handler loop ×
+    the gRPC server-stream decode), for clients that want bidirectional
+    framing."""
+    import json as _json
+
+    async def ws_generate(ctx: Any):
+        # same binding + validation as the HTTP route (one behavior)
+        body = ctx.bind(GenerateRequest)
+        if not body.prompt:
+            return {"error": "prompt required"}
+        if body.temperature < 0 or body.top_p <= 0 or body.top_p > 1:
+            return {"error": "invalid temperature/top_p"}
+        kw = dict(
+            max_new_tokens=body.max_tokens or None,
+            temperature=body.temperature,
+            top_k=body.top_k,
+            top_p=body.top_p,
+        )
+        n = 0
+        async for token_id, piece in engine.stream(body.prompt, **kw):
+            n += 1
+            # AWAIT each frame: fire-and-forget sends could reorder after
+            # the final summary frame, and a dead socket must surface HERE
+            # so engine.stream's finally cancels the request instead of
+            # decoding into the void (code-review r4)
+            await ctx.websocket.send_async(
+                _json.dumps({"token": token_id, "text": piece})
+            )
+        return {"done": True, "tokens": n}
+
+    app.websocket(path, ws_generate)
+
+
 def register_embedding_routes(app: Any, bert_cfg: Any, bert_params: Any, tokenizer: Any, prefix: str = "") -> None:
     """The /embed endpoint (BASELINE.json configs[1]): tokenize, batch to a
     padded bucket, run the jitted embedder."""

@@ -417,3 +417,134 @@ def test_prefix_cache_rejects_oversized_entry():
     assert s["entries"] == 1  # hot entry survived, huge rejected
     assert cache.get("hot") is not None
     assert cache.get("huge") is None
+
+
+def test_websocket_token_streaming(engine_setup):
+    """register_generation_ws: tokens push as frames over a live WS
+    connection, final frame summarizes — the WS twin of SSE streaming."""
+    import asyncio
+    import json as _json
+    import threading
+    import time as _time
+    import urllib.request
+
+    import gofr_tpu
+    from gofr_tpu.config import MapConfig
+    from gofr_tpu.serving.handlers import register_generation_ws
+    from gofr_tpu.testutil import new_server_configs
+
+    cfg, params = engine_setup
+    engine = make_engine(cfg, params)
+    ports = new_server_configs(set_env=False)
+    config = MapConfig(
+        {"HTTP_PORT": str(ports.http_port), "GRPC_PORT": str(ports.grpc_port),
+         "METRICS_PORT": str(ports.metrics_port), "APP_NAME": "ws-gen",
+         "LOG_LEVEL": "ERROR"},
+        use_env=False,
+    )
+    app = gofr_tpu.App(config)
+    register_generation_ws(app, engine)
+    engine.start()
+    thread = threading.Thread(target=app.run, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{ports.http_port}"
+    deadline = _time.time() + 15
+    while _time.time() < deadline:
+        try:
+            urllib.request.urlopen(base + "/.well-known/alive", timeout=1)
+            break
+        except OSError:
+            _time.sleep(0.05)
+
+    async def scenario():
+        import websockets
+
+        async with websockets.connect(
+            f"ws://127.0.0.1:{ports.http_port}/ws/generate"
+        ) as ws:
+            await ws.send(_json.dumps(
+                {"prompt": "ws stream", "max_tokens": 4, "temperature": 0}
+            ))
+            frames = []
+            while True:
+                frame = _json.loads(await asyncio.wait_for(ws.recv(), timeout=120))
+                frames.append(frame)
+                if frame.get("done"):
+                    break
+            assert frames[-1]["tokens"] == len(frames) - 1 >= 1
+            for f in frames[:-1]:
+                assert "token" in f and "text" in f
+            # error surface: missing prompt
+            await ws.send(_json.dumps({"max_tokens": 2}))
+            err = _json.loads(await asyncio.wait_for(ws.recv(), timeout=30))
+            assert err == {"error": "prompt required"}
+
+    try:
+        asyncio.run(scenario())
+    finally:
+        app.stop()
+        engine.stop()
+        thread.join(timeout=15)
+
+
+def test_websocket_disconnect_cancels_generation(engine_setup):
+    """A client that drops mid-stream must free the slot (the WS twin of
+    the SSE 499 path): the awaited send fails, engine.stream's finally
+    cancels the request."""
+    import asyncio
+    import json as _json
+    import threading
+    import time as _time
+    import urllib.request
+
+    import gofr_tpu
+    from gofr_tpu.config import MapConfig
+    from gofr_tpu.serving.handlers import register_generation_ws
+    from gofr_tpu.testutil import new_server_configs
+
+    cfg, params = engine_setup
+    engine = make_engine(cfg, params, max_seq_len=64)
+    ports = new_server_configs(set_env=False)
+    config = MapConfig(
+        {"HTTP_PORT": str(ports.http_port), "GRPC_PORT": str(ports.grpc_port),
+         "METRICS_PORT": str(ports.metrics_port), "APP_NAME": "ws-cancel",
+         "LOG_LEVEL": "ERROR"},
+        use_env=False,
+    )
+    app = gofr_tpu.App(config)
+    register_generation_ws(app, engine)
+    engine.start()
+    thread = threading.Thread(target=app.run, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{ports.http_port}"
+    deadline = _time.time() + 15
+    while _time.time() < deadline:
+        try:
+            urllib.request.urlopen(base + "/.well-known/alive", timeout=1)
+            break
+        except OSError:
+            _time.sleep(0.05)
+
+    async def scenario():
+        import websockets
+
+        ws = await websockets.connect(f"ws://127.0.0.1:{ports.http_port}/ws/generate")
+        await ws.send(_json.dumps({"prompt": "drop me", "max_tokens": 50,
+                                   "temperature": 0}))
+        # read one token frame so generation is demonstrably running...
+        frame = _json.loads(await asyncio.wait_for(ws.recv(), timeout=120))
+        assert "token" in frame
+        # ...then vanish without a close handshake
+        ws.transport.abort() if hasattr(ws, "transport") else await ws.close()
+
+    try:
+        asyncio.run(scenario())
+        # the slot must free well before the 50-token generation would end
+        deadline = _time.time() + 30
+        while _time.time() < deadline and any(engine.slots):
+            _time.sleep(0.05)
+        assert all(s is None for s in engine.slots), "slot pinned by dead client"
+    finally:
+        app.stop()
+        engine.stop()
+        thread.join(timeout=15)
