@@ -39,16 +39,7 @@ def register_generation_routes(app: Any, engine: Any, prefix: str = "") -> None:
 
     async def generate(ctx: Any):
         body = ctx.bind(GenerateRequest)
-        if not body.prompt:
-            raise ErrorMissingParam("prompt")
-        if body.temperature < 0 or body.top_p <= 0 or body.top_p > 1:
-            raise ErrorInvalidParam("temperature", "top_p")
-        kw = dict(
-            max_new_tokens=body.max_tokens or None,
-            temperature=body.temperature,
-            top_k=body.top_k,
-            top_p=body.top_p,
-        )
+        kw = _validated_generate_kwargs(body)
         if body.stream:
             return _sse_response(engine, body.prompt, kw)
         result = await engine.generate(body.prompt, **kw)
@@ -103,37 +94,50 @@ def _sse_response(engine: Any, prompt: str, kw: dict) -> WireResponse:
     )
 
 
+def _validated_generate_kwargs(body: GenerateRequest) -> dict:
+    """One binding/validation behavior for every generation surface
+    (HTTP, SSE, WebSocket): raises the typed param errors."""
+    if not body.prompt:
+        raise ErrorMissingParam("prompt")
+    if body.temperature < 0 or body.top_p <= 0 or body.top_p > 1:
+        raise ErrorInvalidParam("temperature", "top_p")
+    return dict(
+        max_new_tokens=body.max_tokens or None,
+        temperature=body.temperature,
+        top_k=body.top_k,
+        top_p=body.top_p,
+    )
+
+
 def register_generation_ws(app: Any, engine: Any, path: str = "/ws/generate") -> None:
     """WebSocket token streaming: each inbound message is a generate
     request; tokens push back as JSON frames, then a final summary frame.
     The WS twin of the SSE stream (gofr websocket.go:30-49 handler loop ×
     the gRPC server-stream decode), for clients that want bidirectional
-    framing."""
-    import json as _json
+    framing. Wires the engine lifecycle like register_generation_routes,
+    so registering only the WS surface still serves."""
+    app.container.serving = engine
+    app.on_start(lambda ctx: engine.start())
+    app.on_shutdown(engine.stop)
 
     async def ws_generate(ctx: Any):
-        # same binding + validation as the HTTP route (one behavior)
         body = ctx.bind(GenerateRequest)
-        if not body.prompt:
-            return {"error": "prompt required"}
-        if body.temperature < 0 or body.top_p <= 0 or body.top_p > 1:
-            return {"error": "invalid temperature/top_p"}
-        kw = dict(
-            max_new_tokens=body.max_tokens or None,
-            temperature=body.temperature,
-            top_k=body.top_k,
-            top_p=body.top_p,
-        )
+        kw = _validated_generate_kwargs(body)
         n = 0
-        async for token_id, piece in engine.stream(body.prompt, **kw):
-            n += 1
-            # AWAIT each frame: fire-and-forget sends could reorder after
-            # the final summary frame, and a dead socket must surface HERE
-            # so engine.stream's finally cancels the request instead of
-            # decoding into the void (code-review r4)
-            await ctx.websocket.send_async(
-                _json.dumps({"token": token_id, "text": piece})
-            )
+        try:
+            async for token_id, piece in engine.stream(body.prompt, **kw):
+                n += 1
+                # AWAIT each frame: fire-and-forget sends could reorder
+                # after the final summary frame, and a dead/closed socket
+                # must surface HERE so engine.stream's finally cancels the
+                # request instead of decoding into the void
+                await ctx.websocket.send_async(
+                    json.dumps({"token": token_id, "text": piece})
+                )
+        except (ConnectionError, OSError):
+            # routine client departure mid-stream, not a server panic: the
+            # stream generator's finally already canceled the request
+            return None
         return {"done": True, "tokens": n}
 
     app.websocket(path, ws_generate)

@@ -157,6 +157,11 @@ class Connection:
         self._bg_sends: set = set()  # strong refs to fire-and-forget sends
 
     async def send_async(self, data: Any) -> None:
+        if self.closed:
+            # streaming handlers rely on this: a peer CLOSE (or transport
+            # death) observed by the upgrader marks the connection closed,
+            # and the handler's next awaited send unwinds it
+            raise ConnectionError("websocket closed")
         if isinstance(data, (dict, list)):
             payload, op = json.dumps(data).encode(), OP_TEXT
         elif isinstance(data, str):
@@ -388,12 +393,29 @@ class WSUpgrader:
                 writer.write(_encode_frame(OP_PONG, payload))
                 await writer.drain()
 
+        from collections import deque
+
+        pending: "deque[tuple[int, bytes]]" = deque()
+        read_task: asyncio.Task | None = None
+
+        def _ensure_read() -> asyncio.Task:
+            nonlocal read_task
+            if read_task is None:
+                read_task = asyncio.create_task(read_message(reader, pong=_pong))
+            return read_task
+
         try:
             while not conn.closed:
-                try:
-                    opcode, payload = await read_message(reader, pong=_pong)
-                except (asyncio.IncompleteReadError, ConnectionResetError, ConnectionError):
-                    break
+                if pending:
+                    opcode, payload = pending.popleft()
+                else:
+                    try:
+                        opcode, payload = await _ensure_read()
+                    except (asyncio.IncompleteReadError, ConnectionResetError,
+                            ConnectionError):
+                        break
+                    finally:
+                        read_task = None
                 if opcode == OP_CLOSE:
                     await conn.close()
                     break
@@ -401,12 +423,52 @@ class WSUpgrader:
                     continue
                 ctx = Context(_WSRequest(request, payload), self.container)
                 ctx.websocket = conn
-                result = await execute_handler(handler, ctx)
+                # The wire stays serviced WHILE the handler runs: long
+                # streaming handlers previously starved PING replies and
+                # never saw a graceful CLOSE until generation finished —
+                # pinning engine slots on departed clients. The reader
+                # task persists across waits so no frame is ever lost
+                # mid-read.
+                handler_task = asyncio.create_task(execute_handler(handler, ctx))
+                while not handler_task.done():
+                    await asyncio.wait(
+                        {handler_task, _ensure_read()},
+                        return_when=asyncio.FIRST_COMPLETED,
+                    )
+                    if read_task is not None and read_task.done():
+                        try:
+                            op2, pl2 = read_task.result()
+                        except (asyncio.IncompleteReadError, ConnectionResetError,
+                                ConnectionError):
+                            conn.closed = True  # transport died: unwind sends
+                            break
+                        finally:
+                            read_task = None
+                        if op2 == OP_CLOSE:
+                            await conn.close()  # handler unwinds on next send
+                            break
+                        if op2 in (OP_TEXT, OP_BINARY):
+                            pending.append((op2, pl2))  # next iteration's input
+                result = await handler_task
                 if result.error is not None:
+                    # the request/reply contract must hold on errors too: a
+                    # silent drop leaves the client blocked on recv forever
                     self.container.logger.log_error(result.error)
-                elif result.data is not None:
+                    if not conn.closed:
+                        message = (
+                            str(result.error)
+                            if getattr(result.error, "status_code", 500) < 500
+                            else "some unexpected error has occurred"
+                        )
+                        try:
+                            await conn.send_async({"error": {"message": message}})
+                        except (ConnectionError, OSError):
+                            pass
+                elif result.data is not None and not conn.closed:
                     await conn.send_async(result.data)
         finally:
+            if read_task is not None:
+                read_task.cancel()
             if manager is not None:
                 manager.remove_connection(client_key)
             await conn.close()

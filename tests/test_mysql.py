@@ -259,7 +259,7 @@ def test_crud_auto_handlers_over_mysql(server):
         )
         with urllib.request.urlopen(req, timeout=30) as r:
             raw = r.read()
-            if not raw:  # 204 No Content (DELETE)
+            if not raw:  # defensive: framework bodies are JSON envelopes
                 return None
             return _json.loads(raw)["data"]
 

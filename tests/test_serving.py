@@ -419,6 +419,39 @@ def test_prefix_cache_rejects_oversized_entry():
     assert cache.get("huge") is None
 
 
+def _boot_ws_app(engine, name):
+    """Shared WS-app bootstrap: returns (app, port, thread)."""
+    import threading
+    import time as _time
+    import urllib.request
+
+    import gofr_tpu
+    from gofr_tpu.config import MapConfig
+    from gofr_tpu.serving.handlers import register_generation_ws
+    from gofr_tpu.testutil import new_server_configs
+
+    ports = new_server_configs(set_env=False)
+    config = MapConfig(
+        {"HTTP_PORT": str(ports.http_port), "GRPC_PORT": str(ports.grpc_port),
+         "METRICS_PORT": str(ports.metrics_port), "APP_NAME": name,
+         "LOG_LEVEL": "ERROR"},
+        use_env=False,
+    )
+    app = gofr_tpu.App(config)
+    register_generation_ws(app, engine)
+    thread = threading.Thread(target=app.run, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{ports.http_port}"
+    deadline = _time.time() + 15
+    while _time.time() < deadline:
+        try:
+            urllib.request.urlopen(base + "/.well-known/alive", timeout=1)
+            break
+        except OSError:
+            _time.sleep(0.05)
+    return app, ports.http_port, thread
+
+
 def test_websocket_token_streaming(engine_setup):
     """register_generation_ws: tokens push as frames over a live WS
     connection, final frame summarizes — the WS twin of SSE streaming."""
@@ -474,10 +507,11 @@ def test_websocket_token_streaming(engine_setup):
             assert frames[-1]["tokens"] == len(frames) - 1 >= 1
             for f in frames[:-1]:
                 assert "token" in f and "text" in f
-            # error surface: missing prompt
+            # error surface: missing prompt → typed error frame (the
+            # upgrader answers handler errors instead of dropping them)
             await ws.send(_json.dumps({"max_tokens": 2}))
             err = _json.loads(await asyncio.wait_for(ws.recv(), timeout=30))
-            assert err == {"error": "prompt required"}
+            assert "prompt" in err["error"]["message"]
 
     try:
         asyncio.run(scenario())
@@ -544,6 +578,40 @@ def test_websocket_disconnect_cancels_generation(engine_setup):
         while _time.time() < deadline and any(engine.slots):
             _time.sleep(0.05)
         assert all(s is None for s in engine.slots), "slot pinned by dead client"
+    finally:
+        app.stop()
+        engine.stop()
+        thread.join(timeout=15)
+
+
+def test_websocket_graceful_close_cancels_generation(engine_setup):
+    """RFC 6455 graceful CLOSE mid-stream (not just a transport abort)
+    must cancel generation: the upgrader services the wire while the
+    handler runs, so the CLOSE is seen immediately."""
+    import asyncio
+    import json as _json
+    import time as _time
+
+    cfg, params = engine_setup
+    engine = make_engine(cfg, params, max_seq_len=64)
+    app, port, thread = _boot_ws_app(engine, "ws-close")
+
+    async def scenario():
+        import websockets
+
+        ws = await websockets.connect(f"ws://127.0.0.1:{port}/ws/generate")
+        await ws.send(_json.dumps({"prompt": "close me", "max_tokens": 50,
+                                   "temperature": 0}))
+        frame = _json.loads(await asyncio.wait_for(ws.recv(), timeout=120))
+        assert "token" in frame
+        await ws.close()  # graceful close handshake
+
+    try:
+        asyncio.run(scenario())
+        deadline = _time.time() + 30
+        while _time.time() < deadline and any(engine.slots):
+            _time.sleep(0.05)
+        assert all(s is None for s in engine.slots), "slot pinned after graceful close"
     finally:
         app.stop()
         engine.stop()
