@@ -732,6 +732,139 @@ def decode_loop_greedy(
     return tokens, cache, cache_len, jnp.transpose(toks)  # [B, n_steps]
 
 
+@partial(jax.jit, static_argnums=0, donate_argnums=(3,))
+def decode_chunk(
+    cfg: LlamaConfig,
+    params: dict,
+    tokens: jnp.ndarray,  # [B, T] chunk: (last committed token, drafts...)
+    cache: KVCache,  # dense bf16 cache (donated)
+    start_len: jnp.ndarray,  # [B] committed length BEFORE the chunk
+) -> tuple[jnp.ndarray, KVCache]:
+    """Verify-forward for speculative decoding: run T tokens in ONE
+    dispatch against the cache, writing their K/V at rows
+    [start, start+T) and attending causally over prefix+chunk (per-row
+    ``q_offset``). Returns logits [B, T, V]; position i's logits predict
+    the token AFTER chunk token i. KV written past the eventually
+    accepted prefix is garbage the cache-length gating never reads —
+    rejection is just "don't advance cache_len", no rollback."""
+    if cache.quantized:
+        raise NotImplementedError("speculative decode_chunk: bf16 dense cache only")
+    B, T = tokens.shape
+    positions = start_len[:, None] + jnp.arange(T)[None, :]  # [B, T]
+    x = params["embedding"][tokens].astype(cfg.dtype)
+    sin, cos = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    b_rows = jnp.arange(B)[:, None]
+
+    def body(carry, xs):
+        h, k_all, v_all = carry
+        lp, layer = xs
+        _, q, k, v = _qkv(cfg, h, lp, sin, cos, positions)
+        k_all = k_all.at[layer, b_rows, positions].set(k)
+        v_all = v_all.at[layer, b_rows, positions].set(v)
+        kc = jax.lax.dynamic_index_in_dim(k_all, layer, 0, keepdims=False)
+        vc = jax.lax.dynamic_index_in_dim(v_all, layer, 0, keepdims=False)
+        attn = attention(
+            q, kc, vc, causal=True, q_offset=start_len, kv_len=start_len + T
+        )
+        h = _attn_mlp_epilogue(cfg, h, lp, attn)
+        return (h, k_all, v_all), None
+
+    (x, new_k, new_v), _ = jax.lax.scan(
+        body, (x, cache.k, cache.v), (params["layers"], jnp.arange(cfg.n_layers))
+    )
+    return _logits(cfg, params, x), KVCache(new_k, new_v)
+
+
+def _prompt_lookup_draft(context: list[int], ngram: int, draft_len: int) -> list[int]:
+    """Prompt-lookup drafting: find the most recent earlier occurrence of
+    the context's last ``ngram`` tokens and propose what followed it."""
+    if len(context) <= ngram:
+        return []
+    suffix = context[-ngram:]
+    # scan right-to-left, excluding the suffix occurrence itself
+    for start in range(len(context) - ngram - 1, -1, -1):
+        if context[start : start + ngram] == suffix:
+            cont = context[start + ngram : start + ngram + draft_len]
+            if cont:
+                return cont
+    return []
+
+
+def speculative_generate(
+    cfg: LlamaConfig,
+    params: dict,
+    prompt: jnp.ndarray,  # [B, S] right-padded
+    seq_lens: jnp.ndarray,
+    max_new_tokens: int,
+    *,
+    draft_len: int = 8,
+    ngram: int = 2,
+) -> tuple[jnp.ndarray, dict]:
+    """Greedy generation with prompt-lookup speculative decoding
+    (assisted generation / PLD): draft tokens by matching the last
+    n-gram earlier in the context, verify the whole draft in ONE
+    :func:`decode_chunk` dispatch, and commit the longest prefix that
+    greedy decoding would have produced — LOSSLESS: the output equals
+    plain :func:`greedy_generate` token for token, but repetitive text
+    (code, quotes, structured data) commits several tokens per forward.
+    Returns ([B, max_new_tokens] ids — exactly max_new_tokens live
+    tokens per row, like greedy_generate; EOS handling is the caller's
+    concern — and stats {"forwards", "tokens"}). The chunk width is
+    static, so exactly one extra executable compiles."""
+    import numpy as np
+
+    B, S = prompt.shape
+    T = draft_len + 1  # chunk = committed last token + up to draft_len drafts
+    cache = KVCache.create(cfg, B, max_len=S + max_new_tokens + T + 1)
+    logits, cache = prefill(cfg, params, prompt, cache, seq_lens)
+    last = jnp.argmax(logits, axis=-1)
+
+    prompt_np = np.asarray(prompt)
+    lens_np = np.asarray(seq_lens)
+    context = [list(prompt_np[b, : lens_np[b]]) for b in range(B)]
+    out: list[list[int]] = [[] for _ in range(B)]
+    last_np = np.asarray(last)
+    for b in range(B):
+        out[b].append(int(last_np[b]))
+        context[b].append(int(last_np[b]))
+
+    cache_len = lens_np.copy()  # committed length (last token NOT yet in cache)
+    forwards = 1  # prefill
+    while min(len(o) for o in out) < max_new_tokens:
+        chunk = np.zeros((B, T), np.int32)
+        k_row = np.zeros(B, np.int32)
+        for b in range(B):
+            chunk[b, 0] = context[b][-1]
+            draft = _prompt_lookup_draft(context[b], ngram, draft_len)
+            k_row[b] = len(draft)
+            for i, d in enumerate(draft):
+                chunk[b, 1 + i] = d
+        logits, cache = decode_chunk(
+            cfg, params, jnp.asarray(chunk), cache, jnp.asarray(cache_len)
+        )
+        forwards += 1
+        greedy = np.asarray(jnp.argmax(logits, axis=-1))  # [B, T]
+        for b in range(B):
+            if len(out[b]) >= max_new_tokens:
+                cache_len[b] += 1  # keep the row's committed token in cache
+                continue
+            a = 0
+            while a < k_row[b] and greedy[b, a] == chunk[b, 1 + a]:
+                a += 1
+            new_tokens = [int(t) for t in chunk[b, 1 : 1 + a]] + [int(greedy[b, a])]
+            room = max_new_tokens - len(out[b])
+            new_tokens = new_tokens[:room]
+            out[b].extend(new_tokens)
+            context[b].extend(new_tokens)
+            # chunk wrote KV for (last + a accepted drafts); the bonus
+            # token commits NEXT round as that chunk's position 0
+            cache_len[b] += a + 1 if len(new_tokens) == a + 1 else len(new_tokens)
+
+    total = sum(len(o) for o in out)
+    result = np.asarray([o[:max_new_tokens] for o in out], np.int64)
+    return jnp.asarray(result), {"forwards": forwards, "tokens": total}
+
+
 def greedy_generate(
     cfg: LlamaConfig,
     params: dict,

@@ -59,10 +59,18 @@ def attention(
 
     mask = None
     if causal:
-        q_pos = jnp.arange(Sq)[:, None] + q_offset  # [Sq, 1]
-        k_pos = jnp.arange(Sk)[None, :]
-        mask = k_pos <= q_pos  # [Sq, Sk]
-        mask = mask[None, None, None, :, :]
+        off = jnp.asarray(q_offset)
+        if off.ndim == 0:
+            q_pos = jnp.arange(Sq)[:, None] + off  # [Sq, 1]
+            k_pos = jnp.arange(Sk)[None, :]
+            mask = (k_pos <= q_pos)[None, None, None, :, :]
+        else:
+            # per-ROW offsets (chunk verify over a shared cache): row b's
+            # query i sits at absolute position off[b] + i
+            q_pos = off[:, None] + jnp.arange(Sq)[None, :]  # [B, Sq]
+            mask = (
+                jnp.arange(Sk)[None, None, :] <= q_pos[:, :, None]
+            )[:, None, None, :, :]  # [B, 1, 1, Sq, Sk]
     if kv_len is not None:
         valid = jnp.arange(Sk)[None, :] < kv_len[:, None]  # [B, Sk]
         valid = valid[:, None, None, None, :]

@@ -431,6 +431,12 @@ class WSUpgrader:
                 # mid-read.
                 handler_task = asyncio.create_task(execute_handler(handler, ctx))
                 while not handler_task.done():
+                    if len(pending) >= 32:
+                        # backpressure: stop draining the socket so TCP
+                        # flow control stalls an abusive pipeliner instead
+                        # of buffering unbounded frames server-side
+                        await handler_task
+                        break
                     await asyncio.wait(
                         {handler_task, _ensure_read()},
                         return_when=asyncio.FIRST_COMPLETED,

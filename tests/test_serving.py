@@ -468,32 +468,13 @@ def test_websocket_token_streaming(engine_setup):
 
     cfg, params = engine_setup
     engine = make_engine(cfg, params)
-    ports = new_server_configs(set_env=False)
-    config = MapConfig(
-        {"HTTP_PORT": str(ports.http_port), "GRPC_PORT": str(ports.grpc_port),
-         "METRICS_PORT": str(ports.metrics_port), "APP_NAME": "ws-gen",
-         "LOG_LEVEL": "ERROR"},
-        use_env=False,
-    )
-    app = gofr_tpu.App(config)
-    register_generation_ws(app, engine)
-    engine.start()
-    thread = threading.Thread(target=app.run, daemon=True)
-    thread.start()
-    base = f"http://127.0.0.1:{ports.http_port}"
-    deadline = _time.time() + 15
-    while _time.time() < deadline:
-        try:
-            urllib.request.urlopen(base + "/.well-known/alive", timeout=1)
-            break
-        except OSError:
-            _time.sleep(0.05)
+    app, port, thread = _boot_ws_app(engine, "ws-gen")
 
     async def scenario():
         import websockets
 
         async with websockets.connect(
-            f"ws://127.0.0.1:{ports.http_port}/ws/generate"
+            f"ws://127.0.0.1:{port}/ws/generate"
         ) as ws:
             await ws.send(_json.dumps(
                 {"prompt": "ws stream", "max_tokens": 4, "temperature": 0}
@@ -538,31 +519,12 @@ def test_websocket_disconnect_cancels_generation(engine_setup):
 
     cfg, params = engine_setup
     engine = make_engine(cfg, params, max_seq_len=64)
-    ports = new_server_configs(set_env=False)
-    config = MapConfig(
-        {"HTTP_PORT": str(ports.http_port), "GRPC_PORT": str(ports.grpc_port),
-         "METRICS_PORT": str(ports.metrics_port), "APP_NAME": "ws-cancel",
-         "LOG_LEVEL": "ERROR"},
-        use_env=False,
-    )
-    app = gofr_tpu.App(config)
-    register_generation_ws(app, engine)
-    engine.start()
-    thread = threading.Thread(target=app.run, daemon=True)
-    thread.start()
-    base = f"http://127.0.0.1:{ports.http_port}"
-    deadline = _time.time() + 15
-    while _time.time() < deadline:
-        try:
-            urllib.request.urlopen(base + "/.well-known/alive", timeout=1)
-            break
-        except OSError:
-            _time.sleep(0.05)
+    app, port, thread = _boot_ws_app(engine, "ws-cancel")
 
     async def scenario():
         import websockets
 
-        ws = await websockets.connect(f"ws://127.0.0.1:{ports.http_port}/ws/generate")
+        ws = await websockets.connect(f"ws://127.0.0.1:{port}/ws/generate")
         await ws.send(_json.dumps({"prompt": "drop me", "max_tokens": 50,
                                    "temperature": 0}))
         # read one token frame so generation is demonstrably running...
