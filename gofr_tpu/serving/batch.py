@@ -145,11 +145,16 @@ def decode_and_sample_multi(
     return jnp.transpose(toks), last, cache, new_len, rng
 
 
-@partial(jax.jit, donate_argnums=(1,))
+@jax.jit
 def scatter_slot_state(
     last_token: jnp.ndarray,  # [B] NOT donated: it aliases the in-flight
     # step's next_token, which the host still has to read at consume time
-    cache_len: jnp.ndarray,  # [B] donated
+    cache_len: jnp.ndarray,  # [B] NOT donated either: at 4·B bytes donation
+    # saves nothing, and it was the engine's only donated int32[B] buffer —
+    # the exact shape of the round-4 on-TPU crash ("Array has been deleted
+    # with shape=int32[32]", BENCH_LOCAL.jsonl). Over an unreliable remote
+    # backend a dispatch that fails after donation commits leaves the host
+    # handle deleted; per-step scalar state is never worth that class of bug.
     slots: jnp.ndarray,  # [K] int32
     tokens: jnp.ndarray,  # [K] int32
     lens: jnp.ndarray,  # [K] int32

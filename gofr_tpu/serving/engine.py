@@ -235,10 +235,7 @@ class ServingEngine:
             self.cache = None
         else:
             self.paged_cache = None
-            self.cache = llama.KVCache.create(
-                cfg, B, max_len=S,
-                kv_dtype="int8" if self.config.kv_dtype == "int8" else None,
-            )
+            self.cache = self._make_dense_cache()
         self.cache_len = np.zeros(B, np.int32)  # host copy (authoritative)
         self.last_token = np.zeros(B, np.int32)
         self.temperature = np.ones(B, np.float32)
@@ -560,6 +557,15 @@ class ServingEngine:
                     req.future.set_exception(exc)
                 if self._logger:
                     self._logger.error(f"prefill failed for request {rid}: {exc}")
+                # pure host-side rejections (queue/page-budget limits) never
+                # touched the device — don't pay a blocking probe for them
+                if not isinstance(exc, ErrorTooManyRequests) and self._kv_unhealthy():
+                    # the failing call donated the SHARED cache (insert_slot*/
+                    # write_prefill) and died after donation committed: every
+                    # active slot's KV is gone, not just this request's —
+                    # isolated cleanup would leave the engine raising
+                    # "Array has been deleted" on every future step
+                    self._fail_all(exc, kv_unhealthy=True)
         self._observe_queue()
         return bool(pairs or canceled_ids)
 
@@ -953,7 +959,56 @@ class ServingEngine:
         if not req.future.done():
             req.future.set_result(result)
 
-    def _fail_all(self, exc: Exception) -> None:
+    def _kv_unhealthy(self) -> bool:
+        """True when the persistent KV storage cannot serve another step:
+        donated-and-deleted buffers (a dispatch that failed AFTER its
+        donation committed), or error-state outputs (an async dispatch that
+        failed after its output was already rebound — ``is_deleted()`` is
+        False on those, so a one-element sync probe is the only reliable
+        detector). Either way every subsequent step would raise forever.
+        CPU runs delete donated buffers too (jax 0.9), so tests exercise
+        the donation half for real."""
+        arr = None
+        if self.cache is not None:
+            arr = self.cache.k
+        elif self.paged_cache is not None:
+            arr = self.paged_cache.k_pool
+        if arr is None:
+            return False
+        try:
+            if arr.is_deleted():
+                return True
+            float(arr[(0,) * arr.ndim])  # sync probe: poisoned arrays raise
+            return False
+        except Exception:
+            return True
+
+    def _make_dense_cache(self) -> llama.KVCache:
+        """The one dense slot-cache constructor, shared by __init__ and
+        donation-failure recovery so the rebuilt cache can never drift
+        from the one the engine started with."""
+        return llama.KVCache.create(
+            self.model_cfg, self.config.max_slots,
+            max_len=self.config.max_seq_len,
+            kv_dtype="int8" if self.config.kv_dtype == "int8" else None,
+        )
+
+    def _rebuild_kv(self) -> None:
+        """Reallocate the persistent KV storage after donated buffers were
+        lost mid-dispatch. Every slot's residency is gone, so this only
+        runs on the _fail_all path where all active requests already
+        failed; fresh zeroed storage restores a servable engine."""
+        if self.cache is not None:
+            self.cache = self._make_dense_cache()
+        elif self.paged_cache is not None:
+            self.paged_cache.reset_pools()
+        if self._logger:
+            self._logger.warn(
+                "KV storage rebuilt after a failed dispatch deleted the "
+                "donated device buffers"
+            )
+
+    def _fail_all(self, exc: Exception, kv_unhealthy: bool | None = None) -> None:
         # pipeline state is unrecoverable mid-step: drop the in-flight
         # record and force re-upload of device-resident state
         self._inflight = None
@@ -964,6 +1019,32 @@ class ServingEngine:
         self._mask_dev = None
         self._mask_host = None
         self._last_consume_t = None
+        if kv_unhealthy is None:
+            kv_unhealthy = self._kv_unhealthy()  # callers pass a fresh verdict
+        if kv_unhealthy:
+            try:
+                self._rebuild_kv()
+            except Exception as rebuild_exc:
+                # backend still down: keep the loop thread alive — the next
+                # failure re-enters _fail_all and retries the rebuild
+                if self._logger:
+                    self._logger.error(f"KV rebuild failed: {rebuild_exc}")
+            if self._prefix_cache is not None:
+                # a DEVICE-level failure may have poisoned cached prefill
+                # slabs the same way (host-only exceptions can't, so the
+                # cache survives those); a cold prefix cache only costs
+                # recompute, a dead one fails every hit forever. Injected
+                # caches follow the container Cache protocol, which has no
+                # clear() — drop an unclearable cache rather than keep
+                # serving poisoned entries out of it.
+                clear = getattr(self._prefix_cache, "clear", None)
+                try:
+                    if clear is not None:
+                        clear()
+                    else:
+                        self._prefix_cache = None
+                except Exception:
+                    self._prefix_cache = None
         for slot, req in enumerate(self.slots):
             if req is not None:
                 self.slots[slot] = None

@@ -100,28 +100,47 @@ class PagedKVCache:
         self.max_pages_per_seq = (max_seq_len + page_size - 1) // page_size
         self.quantized = kv_dtype == "int8"
         dtype = dtype or cfg.dtype
-        # [L, N+1, Hkv, page, Dh]: trailing (page, Dh) are full dims in the
-        # pallas BlockSpecs (ops/paged_attention.py) — Mosaic tiling rule.
-        # The extra LAST page is the trash page: inactive rows' decode
-        # appends are redirected there (llama.decode_step_paged), so the
-        # scatter never has conflicting writes to a live page.
-        shape = (cfg.n_layers, num_pages + 1, cfg.n_kv_heads, page_size, cfg.head_dim)
-        if self.quantized:
-            self.k_pool = jnp.zeros(shape, jnp.int8)
-            self.v_pool = jnp.zeros(shape, jnp.int8)
-            sshape = shape[:-1] + (1,)
-            self.ks_pool = jnp.zeros(sshape, jnp.float32)
-            self.vs_pool = jnp.zeros(sshape, jnp.float32)
-        else:
-            self.k_pool = jnp.zeros(shape, dtype)
-            self.v_pool = jnp.zeros(shape, dtype)
-            self.ks_pool = None
-            self.vs_pool = None
+        self._pool_dtype = dtype
+        self.reset_pools()
         self.allocator = BlockAllocator(num_pages, page_size)
         # host mirrors (authoritative): per-slot block table + length
         self.tables = np.zeros((max_slots, self.max_pages_per_seq), np.int32)
         self.seq_lens = np.zeros(max_slots, np.int32)
         self._slot_seq: list[int | None] = [None] * max_slots
+
+    def reset_pools(self) -> None:
+        """(Re)allocate the device page pools. Called at init and by engine
+        recovery when a dispatch that failed after donation committed left
+        the pools deleted (serving/engine.py:_rebuild_kv) — resident pages
+        are unrecoverable either way; fresh zeros restore a servable pool.
+
+        [L, N+1, Hkv, page, Dh]: trailing (page, Dh) are full dims in the
+        pallas BlockSpecs (ops/paged_attention.py) — Mosaic tiling rule.
+        The extra LAST page is the trash page: inactive rows' decode
+        appends are redirected there (llama.decode_step_paged), so the
+        scatter never has conflicting writes to a live page."""
+        cfg = self.cfg
+        shape = (
+            cfg.n_layers, self.num_pages + 1, cfg.n_kv_heads,
+            self.page_size, cfg.head_dim,
+        )
+        # build every array BEFORE assigning any: a mid-rebuild failure
+        # (backend still down during recovery) must not leave a half-fresh
+        # pool set that the engine's health probe — it samples k_pool —
+        # would report healthy while v_pool is still deleted
+        if self.quantized:
+            sshape = shape[:-1] + (1,)
+            pools = (
+                jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                jnp.zeros(sshape, jnp.float32), jnp.zeros(sshape, jnp.float32),
+            )
+        else:
+            pools = (
+                jnp.zeros(shape, self._pool_dtype),
+                jnp.zeros(shape, self._pool_dtype),
+                None, None,
+            )
+        self.k_pool, self.v_pool, self.ks_pool, self.vs_pool = pools
 
     # ------------------------------------------------------------- accounting
     def alloc_slot(

@@ -1,0 +1,15 @@
+#!/bin/bash
+LOG=/root/repo/TUNNEL_WATCH.log
+prev=unknown
+while true; do
+  if timeout 90 python -c "import jax; assert jax.devices()[0].platform=='tpu'" >/dev/null 2>&1; then
+    cur=up
+  else
+    cur=down
+  fi
+  if [ "$cur" != "$prev" ]; then
+    echo "$(date -u +%FT%TZ) tunnel=$cur" >> "$LOG"
+    prev=$cur
+  fi
+  sleep 300
+done
