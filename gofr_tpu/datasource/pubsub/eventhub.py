@@ -64,7 +64,7 @@ def parse_connection_string(cs: str) -> dict[str, str]:
 
 class _Link:
     __slots__ = ("name", "handle", "role", "address", "attached", "credit",
-                 "remote_handle", "queue")
+                 "credit_cv", "sent", "remote_handle", "queue")
 
     def __init__(self, name: str, handle: int, role: str, address: str) -> None:
         self.name = name
@@ -72,7 +72,9 @@ class _Link:
         self.role = role  # "sender" | "receiver"
         self.address = address
         self.attached = threading.Event()
-        self.credit = 0
+        self.credit = 0  # sender: broker FLOW grants; guarded by credit_cv
+        self.credit_cv = threading.Condition()
+        self.sent = 0  # sender: local delivery-count (transfers issued)
         self.remote_handle: int | None = None
         self.queue: "queue.Queue[tuple[int, bytes]]" = queue.Queue()
 
@@ -99,6 +101,12 @@ class EventHubClient:
         self.sas_key_name = parsed.get("SharedAccessKeyName", "")
         self.sas_key = parsed.get("SharedAccessKey", "")
         self.consumer_group = consumer_group or "$Default"
+        if partitions < 1:
+            # a clear config error beats the ZeroDivisionError subscribe()'s
+            # partition rotation would hit on an empty address list
+            raise ValueError(
+                f"EVENTHUB_PARTITIONS must be >= 1 (got {partitions})"
+            )
         self.partitions = partitions
         self.poll_timeout = poll_timeout
         self.connect_timeout = connect_timeout
@@ -291,7 +299,14 @@ class EventHubClient:
             if len(fields) > 6 and fields[4] is not None:
                 link = self._links_by_remote.get(int(fields[4]))
                 if link is not None:
-                    link.credit = int(fields[6] or 0)
+                    with link.credit_cv:
+                        # §2.6.7: available credit = broker's snapshot of
+                        # delivery-count + link-credit, minus transfers WE
+                        # issued since that snapshot — setting the raw
+                        # link-credit would re-grant in-flight transfers
+                        base = int(fields[5] or 0) + int(fields[6] or 0)
+                        link.credit = base - link.sent
+                        link.credit_cv.notify_all()
                     link.attached.set()
         elif perf.descriptor == wire.TRANSFER:
             handle = int(fields[0])
@@ -386,6 +401,21 @@ class EventHubClient:
         if isinstance(message, str):
             message = message.encode()
         link = self._sender(topic)
+        # AMQP 1.0 flow control (§2.6.7): a sender may only transfer while
+        # it holds link credit granted by the broker's FLOW. Sending
+        # without credit is a protocol violation a real broker answers by
+        # dropping or detaching — and success metrics would still have
+        # incremented (ADVICE r4 medium). Wait for a grant, consume one.
+        with link.credit_cv:
+            if not link.credit_cv.wait_for(
+                lambda: link.credit > 0, timeout=self.connect_timeout
+            ):
+                raise AmqpError(
+                    f"publish to {topic}: no link credit granted within "
+                    f"{self.connect_timeout}s (broker flow control)"
+                )
+            link.credit -= 1
+            link.sent += 1
         delivery_id = next(self._delivery_ids)
         body = wire.encode_message(message, metadata)
         transfer = Described(wire.TRANSFER, [

@@ -121,6 +121,9 @@ class PooledSQLBase:
                 dialect=self.dialect,
             )
             self._pool.set_observers(self._logger, self._metrics)
+            # the original pool got its keepalive in connect(); a silently
+            # recreated one must honor the same reconnect promise
+            self._pool.start_ping_loop()
         return self._pool
 
     # -- dialect hooks -----------------------------------------------------

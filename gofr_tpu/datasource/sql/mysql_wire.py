@@ -168,8 +168,13 @@ def parse_handshake_v10(payload: bytes) -> dict[str, Any]:
     pos += 1 + 10  # length byte + reserved
     capabilities = cap_low | (cap_high << 16)
     if capabilities & CLIENT_SECURE_CONNECTION:
+        # part 2 is 12 scramble bytes + a single NUL terminator; take
+        # exactly 12 rather than rstrip-ing ALL trailing NULs — a scramble
+        # legitimately ending in 0x00 must not be truncated (it would
+        # corrupt the 20-byte nonce and fail mysql_native_password auth)
         extra = max(13, auth_len - 8)
-        nonce += payload[pos : pos + extra].rstrip(b"\x00")
+        part2 = payload[pos : pos + extra]
+        nonce += part2[:12] if len(part2) >= 13 else part2.rstrip(b"\x00")
         pos += extra
     plugin = b""
     if capabilities & CLIENT_PLUGIN_AUTH:
@@ -337,11 +342,20 @@ def interpolate(sql: str, args: tuple) -> str:
                 in_block_comment = False
         elif in_sq:
             out.append(ch)
-            if ch == "'":
+            if ch == "\\" and i + 1 < len(sql):
+                # MySQL interprets backslash escapes in string literals by
+                # default (no NO_BACKSLASH_ESCAPES): 'O\'Brien' must not
+                # flip the quote state (go-sql-driver interpolateParams)
+                out.append(sql[i + 1])
+                i += 1
+            elif ch == "'":
                 in_sq = False
         elif in_dq:
             out.append(ch)
-            if ch == '"':
+            if ch == "\\" and i + 1 < len(sql):
+                out.append(sql[i + 1])
+                i += 1
+            elif ch == '"':
                 in_dq = False
         elif ch == "'":
             in_sq = True

@@ -136,6 +136,11 @@ class _ConnState:
         self._wlock = threading.Lock()
         self._receivers: dict[int, _ReceiverLink] = {}
         self._sender_addresses: dict[int, str] = {}  # sender handle → target
+        # sender handle → (our echo handle, transfers since last grant):
+        # brokers replenish link credit as transfers consume it (AMQP
+        # §2.6.7); without this a client enforcing credit stalls at the
+        # initial grant
+        self._sender_grants: dict[int, tuple[int, int]] = {}
         # deliberately DIFFERENT numbering from any client (spec §2.6.2:
         # each endpoint assigns its own handles; frames carry the sender's)
         # — catches clients that route incoming frames by their own handle
@@ -277,6 +282,7 @@ class _ConnState:
             )
             self._sender_addresses[handle] = address
             server_handle = next(self._server_handles)
+            self._sender_grants[handle] = (server_handle, 0)
             echo = Described(wire.ATTACH, [
                 name, Uint(server_handle), True, Ubyte(0), Ubyte(0),
                 Described(wire.SOURCE, [None]),
@@ -306,6 +312,19 @@ class _ConnState:
                 idx = next(self.server._rr) % len(parts)
             parts[idx].messages.append(payload)
             self.server._cond.notify_all()
+        grant = self._sender_grants.get(handle)
+        if grant is not None:
+            server_handle, received = grant[0], grant[1] + 1
+            if received % 500 == 0:  # top the window back up before it drains
+                # delivery-count (field 5) carries OUR receive count so the
+                # client's §2.6.7 arithmetic (count + credit - sent) lands
+                # on a fresh window of 1000
+                flow = Described(wire.FLOW, [
+                    Uint(0), Uint(2048), Uint(0), Uint(2048),
+                    Uint(server_handle), Uint(received), Uint(1000),
+                ])
+                self._send(wire.encode_frame(0, flow))
+            self._sender_grants[handle] = (server_handle, received)
 
     def _disposition(self, fields: list) -> None:
         first = int(fields[1])

@@ -247,3 +247,29 @@ def test_backend_switch_builds_eventhub(server):
         msg.commit()
     finally:
         client.close()
+
+
+def test_partitions_must_be_positive():
+    """EVENTHUB_PARTITIONS=0 is a config error, not a ZeroDivisionError
+    at subscribe time (ADVICE r4)."""
+    with pytest.raises(ValueError, match="PARTITIONS"):
+        EventHubClient(host="x", port=1, partitions=0)
+
+
+def test_publish_respects_link_credit(server):
+    """Senders only transfer while holding broker-granted link credit
+    (AMQP 1.0 §2.6.7, ADVICE r4 medium): credit is consumed per publish
+    and the broker's replenishing FLOW keeps a long run going."""
+    client = make_client(server)
+    try:
+        link = client._sender("hub")
+        with link.credit_cv:  # the grant FLOW trails the attach echo
+            assert link.credit_cv.wait_for(lambda: link.credit > 0, timeout=5)
+        before = link.credit
+        client.publish("hub", b"payload-0")
+        assert link.credit == before - 1
+        for i in range(1, 40):
+            client.publish("hub", b"payload")
+        assert link.credit == before - 40
+    finally:
+        client.close()

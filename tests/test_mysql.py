@@ -5,6 +5,7 @@ keepalive reconnect loop after a server-side kill. Reference model:
 sql.go:92-174,212-252 (mysql via go-sql-driver + pool gauges + retry).
 """
 
+import struct
 import threading
 import time
 
@@ -277,3 +278,40 @@ def test_crud_auto_handlers_over_mysql(server):
     finally:
         app.stop()
         thread.join(timeout=15)
+
+
+def test_interpolation_backslash_escapes():
+    """MySQL interprets backslash escapes inside string literals by
+    default (ADVICE r4): a literal like 'O\\'Brien' must not desync the
+    quote scanner, so later ? placeholders still substitute."""
+    sql = interpolate("SELECT 'O\\'Brien', ?", (5,))
+    assert sql == "SELECT 'O\\'Brien', 5"
+    # backslash escaping inside double quotes too
+    sql = interpolate('SELECT "a\\"b?", ?', (1,))
+    assert sql == 'SELECT "a\\"b?", 1'
+    # the escaped quote keeps the string open across what would otherwise
+    # close it: the ? stays a literal character inside the string
+    assert "1" not in interpolate("SELECT 'x\\', ?", (1,))
+
+
+def test_handshake_scramble_keeps_trailing_nul():
+    """A server scramble whose part-2 legitimately ends in 0x00 must not
+    be truncated (ADVICE r4): exactly 12 bytes are taken, corrupting
+    neither the 20-byte nonce nor auth."""
+    from gofr_tpu.datasource.sql.mysql_wire import parse_handshake_v10
+
+    part1 = bytes(range(1, 9))
+    part2 = bytes(range(9, 20)) + b"\x00"  # 12 bytes ending in NUL
+    payload = (
+        b"\x0a" + b"8.0.0\x00" + struct.pack("<I", 99)
+        + part1 + b"\x00"
+        + struct.pack("<H", 0xFFFF)  # cap low (secure connection bit set)
+        + b"\x21" + struct.pack("<H", 0x0002)
+        + struct.pack("<H", 0x0008 | 0x0000)  # cap high: PLUGIN_AUTH bit
+        + bytes([21]) + b"\x00" * 10
+        + part2 + b"\x00"
+        + b"mysql_native_password\x00"
+    )
+    hs = parse_handshake_v10(payload)
+    assert hs["nonce"] == part1 + part2[:12]
+    assert len(hs["nonce"]) == 20
