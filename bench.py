@@ -372,8 +372,13 @@ def _engine_sustained(cfg: Any, params: Any, on_tpu: bool) -> tuple[dict, Any]:
             admission_per_step=8 if on_tpu else 4,
             max_queue=2 * concurrency + 8,
             # chunked decode amortizes per-dispatch overhead — decisive
-            # over the tunneled backend where dispatch RTT rivals compute
-            multi_step=int(os.environ.get("BENCH_MULTI_STEP", "4")),
+            # over the tunneled backend where dispatch RTT rivals compute.
+            # BENCH_SPEC_TOKENS>0 switches to speculative chunking instead
+            # (prompt-lookup drafts; the bench's repeated padding phrase is
+            # exactly the repetition-heavy workload it accelerates).
+            multi_step=(1 if int(os.environ.get("BENCH_SPEC_TOKENS", "0"))
+                        else int(os.environ.get("BENCH_MULTI_STEP", "4"))),
+            spec_tokens=int(os.environ.get("BENCH_SPEC_TOKENS", "0")),
             # mirror the headline's KV policy (int8 on TPU by default)
             kv_dtype=os.environ.get(
                 "BENCH_KV_DTYPE", "int8" if on_tpu else "bf16"

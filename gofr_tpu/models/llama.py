@@ -747,13 +747,48 @@ def decode_chunk(
     the token AFTER chunk token i. KV written past the eventually
     accepted prefix is garbage the cache-length gating never reads —
     rejection is just "don't advance cache_len", no rollback."""
-    if cache.quantized:
-        raise NotImplementedError("speculative decode_chunk: bf16 dense cache only")
     B, T = tokens.shape
     positions = start_len[:, None] + jnp.arange(T)[None, :]  # [B, T]
-    x = params["embedding"][tokens].astype(cfg.dtype)
+    # chunk tails may be draft padding (-1): embed/scatter them safely —
+    # .at[].set drops out-of-bounds rows, the embedding gather clamps
+    safe_tokens = jnp.maximum(tokens, 0)
+    x = params["embedding"][safe_tokens].astype(cfg.dtype)
     sin, cos = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
     b_rows = jnp.arange(B)[:, None]
+
+    if cache.quantized:  # int8 storage (round-5: restriction lifted so the
+        # engine's speculative path covers the headline int8-KV config)
+        def body_q(carry, xs):
+            h, k_all, v_all, ks_all, vs_all = carry
+            lp, layer = xs
+            _, q, k, v = _qkv(cfg, h, lp, sin, cos, positions)
+            kq, kscale = quantize_kv(k)
+            vq, vscale = quantize_kv(v)
+            k_all = k_all.at[layer, b_rows, positions].set(kq)
+            v_all = v_all.at[layer, b_rows, positions].set(vq)
+            ks_all = ks_all.at[layer, b_rows, positions].set(kscale)
+            vs_all = vs_all.at[layer, b_rows, positions].set(vscale)
+            kc = dequantize_kv(
+                jax.lax.dynamic_index_in_dim(k_all, layer, 0, keepdims=False),
+                jax.lax.dynamic_index_in_dim(ks_all, layer, 0, keepdims=False),
+                cfg.dtype,
+            )
+            vc = dequantize_kv(
+                jax.lax.dynamic_index_in_dim(v_all, layer, 0, keepdims=False),
+                jax.lax.dynamic_index_in_dim(vs_all, layer, 0, keepdims=False),
+                cfg.dtype,
+            )
+            attn = attention(
+                q, kc, vc, causal=True, q_offset=start_len, kv_len=start_len + T
+            )
+            h = _attn_mlp_epilogue(cfg, h, lp, attn)
+            return (h, k_all, v_all, ks_all, vs_all), None
+
+        (x, new_k, new_v, new_ks, new_vs), _ = jax.lax.scan(
+            body_q, (x, cache.k, cache.v, cache.ks, cache.vs),
+            (params["layers"], jnp.arange(cfg.n_layers)),
+        )
+        return _logits(cfg, params, x), KVCache(new_k, new_v, new_ks, new_vs)
 
     def body(carry, xs):
         h, k_all, v_all = carry
@@ -773,6 +808,133 @@ def decode_chunk(
         body, (x, cache.k, cache.v), (params["layers"], jnp.arange(cfg.n_layers))
     )
     return _logits(cfg, params, x), KVCache(new_k, new_v)
+
+
+def _paged_chunk_targets(
+    k_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, M]
+    positions: jnp.ndarray,  # [B, T] absolute write positions
+    active: jnp.ndarray,  # [B]
+    kv_capacity: jnp.ndarray,  # [B] tokens covered by OWNED pages
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(page, offset) targets for a chunk write. Positions beyond a row's
+    owned capacity — or on inactive rows — go to the trash page: table
+    entries past the owned prefix read 0, and page 0 is LIVE, so an
+    unmasked overflow write would corrupt another sequence's KV."""
+    page = k_pool.shape[3]
+    trash = k_pool.shape[1] - 1
+    M = block_tables.shape[1]
+    valid = active[:, None] & (positions < kv_capacity[:, None])
+    slot_idx = jnp.minimum(positions // page, M - 1)
+    pages = jnp.where(
+        valid, jnp.take_along_axis(block_tables, slot_idx, axis=1), trash
+    )
+    offsets = jnp.where(valid, positions % page, 0)
+    return pages, offsets
+
+
+def _paged_gather(
+    pool: jnp.ndarray,  # [N+1, Hkv, page, Dh] one layer's pool
+    block_tables: jnp.ndarray,  # [B, M]
+    scale: jnp.ndarray | None = None,  # [N+1, Hkv, page, 1]
+    dtype: Any = None,
+) -> jnp.ndarray:
+    """Gather a row's pages into contiguous [B, M*page, Hkv, Dh] for the
+    chunk-verify attention (XLA-gather reference path: verify chunks are
+    a small, latency-tolerant fraction of decode traffic)."""
+    g = pool[block_tables]  # [B, M, Hkv, page, Dh]
+    if scale is not None:
+        s = scale[block_tables]  # [B, M, Hkv, page, 1]
+        g = (g.astype(jnp.float32) * s).astype(dtype)
+    B, M, Hkv, page, Dh = g.shape
+    return g.transpose(0, 1, 3, 2, 4).reshape(B, M * page, Hkv, Dh)
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=(3, 4))
+def decode_chunk_paged(
+    cfg: LlamaConfig,
+    params: dict,
+    tokens: jnp.ndarray,  # [B, T] chunk: (last committed token, drafts...)
+    k_pool: jnp.ndarray,  # [L, N+1, Hkv, page, Dh] donated
+    v_pool: jnp.ndarray,  # donated
+    block_tables: jnp.ndarray,  # [B, M]
+    start_len: jnp.ndarray,  # [B] committed length BEFORE the chunk
+    active: jnp.ndarray,  # [B]
+    kv_capacity: jnp.ndarray,  # [B] tokens covered by owned pages
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Paged twin of :func:`decode_chunk`: verify T tokens in one dispatch
+    against the page pool, writing chunk K/V through the block tables
+    (overflow → trash page) and attending over gathered pages with per-row
+    ``q_offset``. Returns (logits [B, T, V], k_pool, v_pool)."""
+    B, T = tokens.shape
+    positions = start_len[:, None] + jnp.arange(T)[None, :]
+    pages, offsets = _paged_chunk_targets(
+        k_pool, block_tables, positions, active, kv_capacity
+    )
+    x = params["embedding"][jnp.maximum(tokens, 0)].astype(cfg.dtype)
+    sin, cos = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+
+    def body(h, xs):
+        lp, kc, vc = xs
+        _, q, k, v = _qkv(cfg, h, lp, sin, cos, positions)
+        kc = kc.at[pages, :, offsets].set(k)
+        vc = vc.at[pages, :, offsets].set(v)
+        kg = _paged_gather(kc, block_tables)
+        vg = _paged_gather(vc, block_tables)
+        attn = attention(
+            q, kg, vg, causal=True, q_offset=start_len, kv_len=start_len + T
+        )
+        h = _attn_mlp_epilogue(cfg, h, lp, attn)
+        return h, (kc, vc)
+
+    x, (k_pool, v_pool) = jax.lax.scan(body, x, (params["layers"], k_pool, v_pool))
+    return _logits(cfg, params, x), k_pool, v_pool
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=(3, 4, 5, 6))
+def decode_chunk_paged_q(
+    cfg: LlamaConfig,
+    params: dict,
+    tokens: jnp.ndarray,  # [B, T]
+    k_pool: jnp.ndarray,  # int8, donated
+    v_pool: jnp.ndarray,
+    ks_pool: jnp.ndarray,  # f32 scales, donated
+    vs_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    start_len: jnp.ndarray,
+    active: jnp.ndarray,
+    kv_capacity: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """int8 twin of :func:`decode_chunk_paged`."""
+    B, T = tokens.shape
+    positions = start_len[:, None] + jnp.arange(T)[None, :]
+    pages, offsets = _paged_chunk_targets(
+        k_pool, block_tables, positions, active, kv_capacity
+    )
+    x = params["embedding"][jnp.maximum(tokens, 0)].astype(cfg.dtype)
+    sin, cos = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+
+    def body(h, xs):
+        lp, kc, vc, ksc, vsc = xs
+        _, q, k, v = _qkv(cfg, h, lp, sin, cos, positions)
+        kq, ks = quantize_kv(k)  # int8 [B,T,Hkv,Dh], f32 [B,T,Hkv]
+        vq, vs = quantize_kv(v)
+        kc = kc.at[pages, :, offsets].set(kq)
+        vc = vc.at[pages, :, offsets].set(vq)
+        ksc = ksc.at[pages, :, offsets, 0].set(ks)
+        vsc = vsc.at[pages, :, offsets, 0].set(vs)
+        kg = _paged_gather(kc, block_tables, scale=ksc, dtype=cfg.dtype)
+        vg = _paged_gather(vc, block_tables, scale=vsc, dtype=cfg.dtype)
+        attn = attention(
+            q, kg, vg, causal=True, q_offset=start_len, kv_len=start_len + T
+        )
+        h = _attn_mlp_epilogue(cfg, h, lp, attn)
+        return h, (kc, vc, ksc, vsc)
+
+    x, (k_pool, v_pool, ks_pool, vs_pool) = jax.lax.scan(
+        body, x, (params["layers"], k_pool, v_pool, ks_pool, vs_pool)
+    )
+    return _logits(cfg, params, x), k_pool, v_pool, ks_pool, vs_pool
 
 
 def _prompt_lookup_draft(context: list[int], ngram: int, draft_len: int) -> list[int]:

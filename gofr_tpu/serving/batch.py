@@ -302,6 +302,132 @@ def decode_and_sample_paged_multi_q(
     return jnp.transpose(toks), last, k_pool, v_pool, ks_pool, vs_pool, rng
 
 
+# ----------------------------------------------------- speculative decoding
+def _accept_and_bonus(
+    chunk: jnp.ndarray,  # [B, T] (pos 0 = last committed; 1.. = drafts, -1 pad)
+    logits: jnp.ndarray,  # [B, T, V] from a chunk verify forward
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+    rng: jax.Array,
+) -> tuple[jnp.ndarray, jnp.ndarray, jax.Array]:
+    """Greedy draft acceptance + per-row bonus sampling, fused device-side.
+
+    Position i's logits predict the token after chunk token i, so draft
+    chunk[:, i+1] is accepted iff argmax(logits[:, i]) equals it AND every
+    earlier draft was accepted (cumulative product). -1 padding never
+    matches, so per-row draft counts need no separate length input. The
+    bonus token samples from logits at the first rejected position with
+    the row's own sampling params — rows the engine didn't draft for
+    (temperature > 0) therefore take exactly a normal sampled step.
+    Returns (tokens [B, T] — accepted drafts then bonus, -1 beyond —
+    n_accept [B], rng)."""
+    B, T = chunk.shape
+    greedy = jnp.argmax(logits, axis=-1)  # [B, T]
+    drafts = chunk[:, 1:]  # [B, T-1]
+    match = (greedy[:, :-1] == drafts) & (drafts >= 0)
+    n_accept = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+    bonus_logits = jnp.take_along_axis(
+        logits, n_accept[:, None, None], axis=1
+    )[:, 0]  # [B, V]
+    rng, key = jax.random.split(rng)
+    bonus = sample_logits(
+        bonus_logits, key, temperature=temperature, top_k=top_k, top_p=top_p
+    )
+    idx = jnp.arange(T)[None, :]
+    drafts_pad = jnp.concatenate(
+        [drafts, jnp.zeros((B, 1), drafts.dtype)], axis=1
+    )
+    out = jnp.where(
+        idx < n_accept[:, None], drafts_pad,
+        jnp.where(idx == n_accept[:, None], bonus[:, None], -1),
+    )
+    return out, n_accept, rng
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=(2,))
+def verify_and_sample(
+    cfg: llama.LlamaConfig,
+    params: dict,
+    cache: llama.KVCache,  # donated (bf16 or int8 dense)
+    chunk: jnp.ndarray,  # [B, T]
+    start_len: jnp.ndarray,  # [B] committed length before the chunk
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+    rng: jax.Array,
+) -> tuple[jnp.ndarray, jnp.ndarray, llama.KVCache, jax.Array]:
+    """Speculative engine step, dense cache: chunk-verify forward + draft
+    acceptance + bonus sampling in ONE dispatch. Returns
+    (tokens [B, T], n_accept [B], cache, rng)."""
+    logits, cache = llama.decode_chunk.__wrapped__(
+        cfg, params, chunk, cache, start_len
+    )
+    out, n_accept, rng = _accept_and_bonus(
+        chunk, logits, temperature, top_k, top_p, rng
+    )
+    return out, n_accept, cache, rng
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=(2, 3))
+def verify_and_sample_paged(
+    cfg: llama.LlamaConfig,
+    params: dict,
+    k_pool: jnp.ndarray,  # donated
+    v_pool: jnp.ndarray,  # donated
+    block_tables: jnp.ndarray,
+    chunk: jnp.ndarray,
+    start_len: jnp.ndarray,
+    active: jnp.ndarray,
+    kv_capacity: jnp.ndarray,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+    rng: jax.Array,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jax.Array]:
+    """Paged twin of :func:`verify_and_sample`."""
+    logits, k_pool, v_pool = llama.decode_chunk_paged.__wrapped__(
+        cfg, params, chunk, k_pool, v_pool, block_tables, start_len,
+        active, kv_capacity,
+    )
+    out, n_accept, rng = _accept_and_bonus(
+        chunk, logits, temperature, top_k, top_p, rng
+    )
+    return out, n_accept, k_pool, v_pool, rng
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=(2, 3, 4, 5))
+def verify_and_sample_paged_q(
+    cfg: llama.LlamaConfig,
+    params: dict,
+    k_pool: jnp.ndarray,  # int8, donated
+    v_pool: jnp.ndarray,
+    ks_pool: jnp.ndarray,  # f32 scales, donated
+    vs_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    chunk: jnp.ndarray,
+    start_len: jnp.ndarray,
+    active: jnp.ndarray,
+    kv_capacity: jnp.ndarray,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+    rng: jax.Array,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray,
+           jnp.ndarray, jax.Array]:
+    """int8-paged twin of :func:`verify_and_sample`."""
+    logits, k_pool, v_pool, ks_pool, vs_pool = (
+        llama.decode_chunk_paged_q.__wrapped__(
+            cfg, params, chunk, k_pool, v_pool, ks_pool, vs_pool,
+            block_tables, start_len, active, kv_capacity,
+        )
+    )
+    out, n_accept, rng = _accept_and_bonus(
+        chunk, logits, temperature, top_k, top_p, rng
+    )
+    return out, n_accept, k_pool, v_pool, ks_pool, vs_pool, rng
+
+
 def pad_bucket(length: int, buckets: tuple[int, ...]) -> int:
     """Smallest bucket ≥ length (prompt padding, limits recompiles)."""
     for b in buckets:

@@ -62,6 +62,13 @@ class EngineConfig:
     # The byte bound caps HBM regardless of bucket sizes.
     prefix_cache_entries: int = 0
     prefix_cache_bytes: int = 256 * 1024 * 1024
+    # speculative decoding (prompt-lookup drafting): K draft tokens are
+    # verified per dispatch; greedy rows commit the accepted prefix + a
+    # bonus token (LOSSLESS vs plain greedy), sampled rows take normal
+    # single-token steps through the same chunk executable. 0 disables.
+    # Mutually exclusive with multi_step > 1 (both are chunking policies).
+    spec_tokens: int = 0
+    spec_ngram: int = 3
 
     @classmethod
     def from_config(cls, config: Any) -> "EngineConfig":
@@ -100,6 +107,8 @@ class EngineConfig:
                 config.get_or_default("TPU_PREFIX_CACHE_BYTES",
                                       str(256 * 1024 * 1024))
             ),
+            spec_tokens=int(config.get_or_default("TPU_SPEC_TOKENS", "0")),
+            spec_ngram=int(config.get_or_default("TPU_SPEC_NGRAM", "3")),
         )
 
 
@@ -207,6 +216,13 @@ class ServingEngine:
             raise ValueError(
                 f"TPU_KV_DTYPE={self.config.kv_dtype!r}: must be bf16 or int8"
             )
+        if self.config.spec_tokens < 0:
+            raise ValueError("TPU_SPEC_TOKENS must be >= 0")
+        if self.config.spec_tokens > 0 and self.config.multi_step > 1:
+            raise ValueError(
+                "TPU_SPEC_TOKENS and TPU_BATCH_MULTI_STEP>1 are both "
+                "chunking policies; enable one"
+            )
         if self.config.kv_layout == "paged":
             from gofr_tpu.serving.kv_cache import PagedKVCache
 
@@ -264,6 +280,9 @@ class ServingEngine:
             self.config.max_slots, self.config.max_queue,
             self.config.prefill_token_budget,
         )
+        # speculative-decode counters (observable uplift: emitted /
+        # dispatches > 1 means drafts are being accepted)
+        self.spec_stats = {"dispatches": 0, "accepted": 0, "emitted": 0}
         self._by_id: dict[int, _Request] = {}  # queued + active, by request id
         self._count_lock = threading.Lock()
         self._next_id = 0
@@ -675,11 +694,187 @@ class ServingEngine:
         The dispatch feeds on step N's device-side tokens directly, so the
         device never waits for host bookkeeping; the host's np.asarray of
         step N's tokens overlaps step N+1's compute."""
+        if self.config.spec_tokens > 0:
+            return self._spec_step()
         inflight = self._dispatch_decode()
         prev, self._inflight = self._inflight, inflight
         if prev is not None:
             self._consume_decode(prev)
         return inflight is not None or prev is not None
+
+    def _spec_step(self) -> bool:
+        """Speculative decode step (VERDICT r4 item #3): host drafts up to
+        K tokens per greedy row by prompt lookup over (prompt + output),
+        one fused dispatch verifies the whole chunk across all slots and
+        samples the bonus token, and the host commits each row's accepted
+        prefix. LOSSLESS for greedy rows (acceptance is exact argmax
+        equality); sampled rows ride the same executable as plain steps.
+        Unpipelined by design — drafting needs the newest consumed tokens,
+        and the chunk already amortizes dispatch latency the way
+        multi_step does, multiplied by accepted drafts. Works on all four
+        cache layouts (dense/paged x bf16/int8); ref
+        models/llama.py:speculative_generate for the library-level twin."""
+        cfg = self.model_cfg
+        K = self.config.spec_tokens
+        T = K + 1
+        max_seq = self.config.max_seq_len
+        self._pending_tok.clear()  # host state is authoritative in spec mode
+
+        rows: list[tuple[int, _Request]] = []
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            if req.canceled:
+                self._retire(slot, "cancel")
+                continue
+            if (len(req.tokens) >= req.max_new_tokens
+                    or len(req.prompt_ids) + len(req.tokens) >= max_seq):
+                continue  # retires at the next consume's limit checks
+            rows.append((slot, req))
+        if not rows:
+            return False
+
+        B = self.config.max_slots
+        chunk = np.full((B, T), -1, np.int32)
+        for slot, req in rows:
+            chunk[slot, 0] = self.last_token[slot]
+            room = min(
+                req.max_new_tokens - len(req.tokens),
+                max_seq - 1 - (len(req.prompt_ids) + len(req.tokens)),
+            )
+            if req.temperature == 0 and room > 1 and K > 0:
+                draft = llama._prompt_lookup_draft(
+                    req.prompt_ids + req.tokens, self.config.spec_ngram,
+                    min(K, room - 1),
+                )
+                chunk[slot, 1 : 1 + len(draft)] = draft
+
+        pc = self.paged_cache
+        if pc is not None:
+            slot_ids = [s for s, _ in rows]
+            if not pc.try_reserve_chunk(slot_ids, T):
+                # pool pressure: fall back to single-position coverage per
+                # row (chunk tails spill to the trash page; zero drafts
+                # still verify position 0 = a plain decode step). A row
+                # that can't even cover one more token retires with what
+                # it has, like the non-spec path.
+                kept = []
+                for slot, req in rows:
+                    if pc.try_reserve_chunk([slot], 1):
+                        chunk[slot, 1:] = -1
+                        kept.append((slot, req))
+                    else:
+                        if self._logger:
+                            self._logger.warn(
+                                f"KV pool exhausted; retiring request "
+                                f"{req.id} early"
+                            )
+                        self._retire(slot, "length")
+                rows = kept
+                if not rows:
+                    return True
+
+        mask = np.zeros(B, bool)
+        for slot, _ in rows:
+            mask[slot] = True
+        # counted AFTER the reservation fallback may have cleared drafts
+        drafted_total = int((chunk[mask, 1:] >= 0).sum())
+        if self._samp_dev is None:
+            self._samp_dev = (
+                jnp.asarray(self.temperature.copy()),
+                jnp.asarray(self.top_k.copy()),
+                jnp.asarray(self.top_p.copy()),
+            )
+        temp_d, topk_d, topp_d = self._samp_dev
+        if self._mask_host is None or not np.array_equal(mask, self._mask_host):
+            self._mask_dev = jnp.asarray(mask)
+            self._mask_host = mask
+        chunk_d = jnp.asarray(chunk)
+        start_d = jnp.asarray(np.maximum(self.cache_len, 1))
+
+        t0 = time.perf_counter()
+        if pc is not None:
+            cap = np.zeros(B, np.int32)
+            for slot, _ in rows:
+                cap[slot] = pc.owned_capacity(slot)
+            cap_d = jnp.asarray(cap)
+            if pc.quantized:
+                (out, n_acc, pc.k_pool, pc.v_pool, pc.ks_pool, pc.vs_pool,
+                 self.rng) = batch_ops.verify_and_sample_paged_q(
+                    cfg, self.params, pc.k_pool, pc.v_pool,
+                    pc.ks_pool, pc.vs_pool, pc.tables_device(), chunk_d,
+                    start_d, self._mask_dev, cap_d,
+                    temp_d, topk_d, topp_d, self.rng,
+                )
+            else:
+                (out, n_acc, pc.k_pool, pc.v_pool, self.rng) = (
+                    batch_ops.verify_and_sample_paged(
+                        cfg, self.params, pc.k_pool, pc.v_pool,
+                        pc.tables_device(), chunk_d, start_d,
+                        self._mask_dev, cap_d,
+                        temp_d, topk_d, topp_d, self.rng,
+                    )
+                )
+        else:
+            out, n_acc, self.cache, self.rng = batch_ops.verify_and_sample(
+                cfg, self.params, self.cache, chunk_d, start_d,
+                temp_d, topk_d, topp_d, self.rng,
+            )
+
+        out_np = np.asarray(out)  # the step's only sync point
+        na_np = np.asarray(n_acc)
+        step_time = time.perf_counter() - t0
+
+        n_active = 0
+        accepted_total = 0
+        emitted_total = 0
+        for slot, req in rows:
+            n_active += 1
+            accepted_total += int(na_np[slot])
+            committed = 0
+            for i in range(int(na_np[slot]) + 1):
+                token_id = int(out_np[slot, i])
+                self.last_token[slot] = token_id
+                committed += 1
+                self._emit_token(req, token_id)
+                if req.canceled:
+                    self._retire(slot, "cancel")
+                elif token_id in req.stop_ids:
+                    self._retire(slot, "stop")
+                elif len(req.tokens) >= req.max_new_tokens:
+                    self._retire(slot, "length")
+                elif len(req.prompt_ids) + len(req.tokens) >= max_seq:
+                    self._retire(slot, "length")
+                if self.slots[slot] is not req:
+                    break  # retired mid-chunk: discard the tail
+            emitted_total += committed
+            # chunk position 0 (the previously emitted token) plus the
+            # accepted drafts are now resident KV; the bonus token commits
+            # as the NEXT chunk's position 0 — so residency advances by the
+            # emitted count even when the row retired mid-chunk (harmless:
+            # the slot was freed)
+            if self.slots[slot] is req:
+                self.cache_len[slot] += committed
+                if pc is not None:
+                    pc.advance_slot(slot, committed)
+
+        self.spec_stats["dispatches"] += 1
+        self.spec_stats["accepted"] += accepted_total
+        self.spec_stats["emitted"] += emitted_total
+        if self._metrics and n_active:
+            self._metrics.record_histogram(
+                "app_tpot_seconds", step_time / max(emitted_total / n_active, 1)
+            )
+            self._metrics.set_gauge(
+                "app_batch_occupancy", n_active / self.config.max_slots
+            )
+            if drafted_total:
+                # rate over tokens actually DRAFTED — sampled rows and
+                # draft-less lookups must not dilute the tuning signal
+                self._metrics.set_gauge(
+                    "app_spec_accept_rate", accepted_total / drafted_total
+                )
+        return True
 
     def _chunk_absorb(self, rows: list) -> int:
         """How many decode steps EVERY row can absorb without crossing its

@@ -179,27 +179,59 @@ class PagedKVCache:
         extend would desync the chunk's device-side lengths). Returns
         False without touching state when the pool cannot cover the whole
         chunk."""
+        if not self.try_reserve_chunk(slots, tokens):
+            return False
+        for slot in slots:
+            self.seq_lens[slot] = int(self.seq_lens[slot]) + tokens
+        return True
+
+    def try_reserve_chunk(self, slots: list[int], tokens: int) -> bool:
+        """Reserve page COVERAGE for up to ``tokens`` further positions on
+        every slot, or none — WITHOUT advancing seq_lens (speculative
+        verify writes up to ``tokens`` positions but commits only the
+        accepted prefix; lengths advance later via :meth:`advance_slot`,
+        while the chunked decode path layers its seq_lens advance on top
+        in :meth:`try_extend_chunk`). Per-slot targets clamp to
+        max_seq_len: a row one token short of the limit reserves exactly
+        its last page rather than overflowing the block-table width —
+        chunk positions past the clamp divert to the trash page via the
+        kv_capacity write guard. Returns False untouched when the pool
+        can't cover all slots."""
+        targets = []
         needed = 0
         for slot in slots:
             seq_id = self._slot_seq[slot]
             assert seq_id is not None
-            new_len = int(self.seq_lens[slot]) + tokens
+            target = min(int(self.seq_lens[slot]) + tokens, self.max_seq_len)
+            targets.append((slot, seq_id, target))
             # compare against blocks actually OWNED: the reservation may
             # sit mid-page, in which case the remaining page capacity
             # absorbs the chunk with zero new blocks (code-review r4)
             owned = len(self.allocator.block_table(seq_id))
-            needed += max(0, self.pages_needed(new_len) - owned)
+            needed += max(0, self.pages_needed(target) - owned)
         if needed > self.allocator.stats()["free_blocks"]:
             return False
-        for slot in slots:
-            seq_id = self._slot_seq[slot]
-            new_len = int(self.seq_lens[slot]) + tokens
-            if new_len > self.allocator.seq_length(seq_id):
-                self.allocator.extend(seq_id, new_len)
+        for slot, seq_id, target in targets:
+            if target > self.allocator.seq_length(seq_id):
+                self.allocator.extend(seq_id, target)
                 table = self.allocator.block_table(seq_id)
                 self.tables[slot, : len(table)] = table
-            self.seq_lens[slot] = new_len
         return True
+
+    def advance_slot(self, slot: int, n_tokens: int) -> None:
+        """Commit ``n_tokens`` accepted positions (speculative decode).
+        The caller reserved coverage up front (try_reserve_chunk), so this
+        never allocates."""
+        self.seq_lens[slot] = int(self.seq_lens[slot]) + n_tokens
+
+    def owned_capacity(self, slot: int) -> int:
+        """Tokens covered by the slot's OWNED pages — the write guard for
+        chunk verifies (positions past this must spill to the trash page,
+        never through the zero-filled table tail into live page 0)."""
+        seq_id = self._slot_seq[slot]
+        if seq_id is None:
+            return 0
+        return len(self.allocator.block_table(seq_id)) * self.page_size
 
     def free_slot(self, slot: int) -> None:
         seq_id = self._slot_seq[slot]
