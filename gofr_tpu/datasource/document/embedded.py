@@ -24,10 +24,18 @@ import uuid
 from typing import Any
 
 
+# Extended-JSON VALUE shapes (the wire bridge stores ObjectId/datetime/
+# binary this way — testutil/mongo_server.py): they look like operator
+# dicts but compare by equality.
+_EXT_JSON_VALUES = ({"$oid"}, {"$date"}, {"$binary"})
+
+
 def _matches(doc: dict, filter: dict) -> bool:
     for key, cond in filter.items():
         value = doc.get(key)
-        if isinstance(cond, dict) and any(k.startswith("$") for k in cond):
+        if (isinstance(cond, dict)
+                and any(k.startswith("$") for k in cond)
+                and set(cond) not in _EXT_JSON_VALUES):
             for op, operand in cond.items():
                 if op == "$gt":
                     if not (value is not None and value > operand):
@@ -352,5 +360,13 @@ class Session:
         return getattr(self._store, name)
 
 
-def new_document_store(config: Any) -> EmbeddedDocumentStore:
+def new_document_store(config: Any):
+    """Backend selection (reference: Mongo is an external driver picked by
+    config — container/datasources.go:232-300): MONGO_URI or MONGO_HOST
+    selects the wire driver (document/mongo.py, real OP_MSG protocol);
+    otherwise the embedded zero-service engine."""
+    if config.get("MONGO_URI") or config.get("MONGO_HOST"):
+        from gofr_tpu.datasource.document.mongo import MongoClient
+
+        return MongoClient.from_config(config)
     return EmbeddedDocumentStore.from_config(config)

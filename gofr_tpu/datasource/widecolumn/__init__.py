@@ -165,5 +165,13 @@ def _rpartition_ci(stmt: str, sep: str) -> tuple[str, str, str]:
     return stmt[:idx], sep, stmt[idx + len(sep):]
 
 
-def new_widecolumn_store(config: Any) -> EmbeddedWideColumnStore:
+def new_widecolumn_store(config: Any):
+    """Backend selection (reference: Cassandra is an external driver
+    picked by config — container/datasources.go:42-194): CASSANDRA_HOST
+    selects the wire driver (widecolumn/cassandra.py, real CQL binary
+    protocol); otherwise the embedded zero-service engine."""
+    if config.get("CASSANDRA_HOST"):
+        from gofr_tpu.datasource.widecolumn.cassandra import CassandraClient
+
+        return CassandraClient.from_config(config)
     return EmbeddedWideColumnStore.from_config(config)

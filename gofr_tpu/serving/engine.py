@@ -833,18 +833,8 @@ class ServingEngine:
             accepted_total += int(na_np[slot])
             committed = 0
             for i in range(int(na_np[slot]) + 1):
-                token_id = int(out_np[slot, i])
-                self.last_token[slot] = token_id
                 committed += 1
-                self._emit_token(req, token_id)
-                if req.canceled:
-                    self._retire(slot, "cancel")
-                elif token_id in req.stop_ids:
-                    self._retire(slot, "stop")
-                elif len(req.tokens) >= req.max_new_tokens:
-                    self._retire(slot, "length")
-                elif len(req.prompt_ids) + len(req.tokens) >= max_seq:
-                    self._retire(slot, "length")
+                self._commit_token(slot, req, int(out_np[slot, i]))
                 if self.slots[slot] is not req:
                     break  # retired mid-chunk: discard the tail
             emitted_total += committed
@@ -1082,17 +1072,7 @@ class ServingEngine:
                 next_ids[slot : slot + 1] if rec.steps == 1 else next_ids[slot]
             )
             for token_id in row_ids:
-                token_id = int(token_id)
-                self.last_token[slot] = token_id
-                self._emit_token(req, token_id)
-                if req.canceled:
-                    self._retire(slot, "cancel")
-                elif token_id in req.stop_ids:
-                    self._retire(slot, "stop")
-                elif len(req.tokens) >= req.max_new_tokens:
-                    self._retire(slot, "length")
-                elif len(req.prompt_ids) + len(req.tokens) >= self.config.max_seq_len:
-                    self._retire(slot, "length")
+                self._commit_token(slot, req, int(token_id))
                 if self.slots[slot] is not req:
                     break  # retired mid-chunk: discard the tail tokens
 
@@ -1109,6 +1089,21 @@ class ServingEngine:
             )
 
     # -- bookkeeping -----------------------------------------------------------
+    def _commit_token(self, slot: int, req: _Request, token_id: int) -> None:
+        """Deliver one decoded token and run the retire chain — the ONE
+        place stop/limit semantics live for both the pipelined consume
+        and the speculative commit paths."""
+        self.last_token[slot] = token_id
+        self._emit_token(req, token_id)
+        if req.canceled:
+            self._retire(slot, "cancel")
+        elif token_id in req.stop_ids:
+            self._retire(slot, "stop")
+        elif len(req.tokens) >= req.max_new_tokens:
+            self._retire(slot, "length")
+        elif len(req.prompt_ids) + len(req.tokens) >= self.config.max_seq_len:
+            self._retire(slot, "length")
+
     def _emit_token(self, req: _Request, token_id: int) -> None:
         req.tokens.append(token_id)
         if req.stream_cb is not None and token_id not in req.stop_ids:
