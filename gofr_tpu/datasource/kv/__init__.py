@@ -5,6 +5,7 @@ maps to FileKVStore (embedded, persistent); dynamodb/nats-kv map to the same
 KVStore contract (container/datasources.go:366-378) as pluggable drivers.
 """
 
+from gofr_tpu.datasource.kv.dynamodb import DynamoDBKVStore
 from gofr_tpu.datasource.kv.store import FileKVStore, InMemoryKVStore
 
-__all__ = ["InMemoryKVStore", "FileKVStore"]
+__all__ = ["InMemoryKVStore", "FileKVStore", "DynamoDBKVStore"]

@@ -26,6 +26,7 @@ from gofr_tpu.tracing.export import (
     BatchSpanProcessor,
     ConsoleExporter,
     InMemoryExporter,
+    OTLPHTTPExporter,
     ZipkinJSONExporter,
     build_exporter,
 )
@@ -40,6 +41,7 @@ __all__ = [
     "BatchSpanProcessor",
     "ConsoleExporter",
     "InMemoryExporter",
+    "OTLPHTTPExporter",
     "ZipkinJSONExporter",
     "build_exporter",
 ]

@@ -90,6 +90,103 @@ class ZipkinJSONExporter:
         pass
 
 
+_OTLP_KIND = {
+    "internal": 1, "server": 2, "client": 3, "producer": 4, "consumer": 5,
+}
+_OTLP_STATUS = {"UNSET": 0, "OK": 1, "ERROR": 2}
+
+
+class OTLPHTTPExporter:
+    """OTLP over HTTP with JSON encoding (the opentelemetry-proto JSON
+    mapping): POST resourceSpans to a collector's ``/v1/traces``. This is
+    the exporter an operator actually points at a 2026 stack — Jaeger,
+    Tempo, vendor collectors all ingest OTLP/HTTP. Parity target:
+    otel.go:104-119 (otlp/jaeger both build an OTLP exporter;
+    TRACER_AUTH_KEY rides the Authorization header)."""
+
+    def __init__(
+        self,
+        url: str,
+        service_name: str = "gofr-app",
+        timeout: float = 5.0,
+        auth_header: str = "",
+        logger: Any = None,
+    ) -> None:
+        self.url = url
+        self.service_name = service_name
+        self.timeout = timeout
+        self.auth_header = auth_header
+        self._logger = logger
+
+    def _span_json(self, s: Span) -> dict:
+        out = {
+            "traceId": s.trace_id,
+            "spanId": s.span_id,
+            "name": s.name,
+            "kind": _OTLP_KIND.get(s.kind, 1),
+            # nanos serialize as STRINGS in the OTLP JSON mapping (int64)
+            "startTimeUnixNano": str(s.start_ns),
+            "endTimeUnixNano": str(s.end_ns or s.start_ns),
+            "attributes": [
+                {"key": str(k), "value": {"stringValue": str(v)}}
+                for k, v in s.attributes.items()
+            ],
+            "events": [
+                {
+                    "timeUnixNano": str(ts),
+                    "name": name,
+                    "attributes": [
+                        {"key": str(k), "value": {"stringValue": str(v)}}
+                        for k, v in (attrs or {}).items()
+                    ],
+                }
+                for ts, name, attrs in s.events
+            ],
+            "status": {"code": _OTLP_STATUS.get(s.status_code, 0)},
+        }
+        if s.parent_id:
+            out["parentSpanId"] = s.parent_id
+        if s.status_desc:
+            out["status"]["message"] = s.status_desc
+        return out
+
+    def export(self, spans: list[Span]) -> None:
+        payload = {
+            "resourceSpans": [
+                {
+                    "resource": {
+                        "attributes": [
+                            {
+                                "key": "service.name",
+                                "value": {"stringValue": self.service_name},
+                            }
+                        ]
+                    },
+                    "scopeSpans": [
+                        {
+                            "scope": {"name": "gofr_tpu.tracing"},
+                            "spans": [self._span_json(s) for s in spans],
+                        }
+                    ],
+                }
+            ]
+        }
+        headers = {"Content-Type": "application/json"}
+        if self.auth_header:
+            headers["Authorization"] = self.auth_header
+        try:
+            req = urllib.request.Request(
+                self.url, data=json.dumps(payload).encode(), headers=headers
+            )
+            urllib.request.urlopen(req, timeout=self.timeout).close()
+        except Exception as exc:
+            if self._logger is not None:
+                self._logger.debug(f"otlp span export failed: {exc}")
+
+    def shutdown(self) -> None:
+        pass
+
+
 class BatchSpanProcessor:
     """Buffers finished spans and exports in batches from a daemon thread
     (otel.go batch span processor semantics)."""
@@ -156,10 +253,19 @@ class SimpleSpanProcessor:
 
 
 def build_exporter(config: Any, logger: Any = None) -> Any | None:
-    """Exporter selection by TRACE_EXPORTER (otel.go:81-144): 'gofr'/'zipkin'
-    → zipkin JSON POST, 'console' → console, 'otlp'/'jaeger' → zipkin JSON to
-    TRACER_URL (native OTLP wire protocol is out of scope; the collector URL
-    shape is preserved), anything else → None (tracing disabled)."""
+    """Exporter selection by TRACE_EXPORTER (otel.go:81-144):
+
+    - ``otlp`` / ``jaeger`` → OTLP/HTTP JSON to TRACER_URL or
+      ``http://TRACER_HOST:TRACER_PORT/v1/traces`` (otel.go:104-119 —
+      jaeger ingests OTLP natively);
+    - ``zipkin`` → zipkin-v2 JSON to TRACER_URL or
+      ``http://TRACER_HOST:TRACER_PORT/api/v2/spans`` (otel.go:121-135);
+    - ``gofr`` → zipkin-shape JSON to the hosted collector
+      (exporter.go:23-125);
+    - ``console`` → dev stdout; anything else → None (disabled).
+
+    TRACER_AUTH_KEY becomes the Authorization header, as in the
+    reference."""
     name = (config.get("TRACE_EXPORTER") or "").lower()
     if not name:
         return None
@@ -167,11 +273,31 @@ def build_exporter(config: Any, logger: Any = None) -> Any | None:
     if name == "console":
         return ConsoleExporter(logger)
     url = config.get("TRACER_URL")
-    if name in ("gofr",):
+    host = config.get("TRACER_HOST")
+    port = config.get_or_default("TRACER_PORT", "9411")
+    auth = config.get_or_default("TRACER_AUTH_KEY", "")
+    if name in ("otlp", "jaeger"):
+        if not url and host:
+            url = f"http://{host}:{port}/v1/traces"
+        if url:
+            return OTLPHTTPExporter(url, service, auth_header=auth,
+                                    logger=logger)
+    if name == "gofr":
         url = url or "https://tracer-api.gofr.dev/api/spans"
         return ZipkinJSONExporter(url, service, logger=logger)
-    if name in ("zipkin", "otlp", "jaeger") and url:
-        return ZipkinJSONExporter(url, service, logger=logger)
+    if name == "zipkin":
+        if not url and host:
+            url = f"http://{host}:{port}/api/v2/spans"
+        if url:
+            return ZipkinJSONExporter(url, service, logger=logger)
     if logger is not None:
-        logger.error(f"unsupported TRACE_EXPORTER: {name}")
+        if name in ("otlp", "jaeger", "zipkin"):
+            # a known exporter with no endpoint is a CONFIG gap — blaming
+            # the exporter name would send the operator down the wrong path
+            logger.error(
+                f"TRACE_EXPORTER={name} needs TRACER_URL or TRACER_HOST; "
+                "tracing disabled"
+            )
+        else:
+            logger.error(f"unsupported TRACE_EXPORTER: {name}")
     return None

@@ -1,0 +1,85 @@
+"""DynamoDB KV driver against the SigV4-verifying test server.
+
+Reference parity: pkg/gofr/datasource/kv-store/dynamodb (Get/Set/Delete,
+dynamo.go:138-224). The server REJECTS bad signatures, so the SigV4 path
+is proven, not assumed.
+"""
+
+import pytest
+
+from gofr_tpu.datasource.kv import DynamoDBKVStore
+from gofr_tpu.datasource.kv.store import KVError
+from gofr_tpu.testutil.dynamodb_server import MiniDynamoDBServer
+
+
+@pytest.fixture()
+def server():
+    s = MiniDynamoDBServer().start()
+    yield s
+    s.close()
+
+
+@pytest.fixture()
+def kv(server):
+    store = DynamoDBKVStore(
+        table="kv", endpoint=server.endpoint, region=server.region,
+        access_key=server.access_key, secret_key=server.secret_key,
+    )
+    store.connect()
+    return store
+
+
+def test_set_get_delete_roundtrip(kv):
+    kv.set("alpha", "1")
+    kv.set("beta", "two")
+    assert kv.get("alpha") == "1"
+    assert kv.get("beta") == "two"
+    kv.set("alpha", "updated")
+    assert kv.get("alpha") == "updated"
+    kv.delete("alpha")
+    with pytest.raises(KVError):
+        kv.get("alpha")
+    kv.delete("alpha")  # idempotent
+
+
+def test_missing_key_raises(kv):
+    with pytest.raises(KVError):
+        kv.get("never-set")
+
+
+def test_bad_signature_rejected(server):
+    bad = DynamoDBKVStore(
+        table="kv", endpoint=server.endpoint, region=server.region,
+        access_key=server.access_key, secret_key="WRONG",
+    )
+    with pytest.raises(KVError, match="403"):
+        bad.set("x", "y")
+
+
+def test_missing_table_is_error(kv):
+    kv.table = "nope"
+    with pytest.raises(KVError, match="ResourceNotFound"):
+        kv.set("x", "y")
+
+
+def test_health_up_down(server, kv):
+    h = kv.health_check()
+    assert h["status"] == "UP"
+    assert h["details"]["table_status"] == "ACTIVE"
+    server.close()
+    assert kv.health_check()["status"] == "DOWN"
+
+
+def test_kv_contract_shared_with_memory_store(kv):
+    """The wire driver honors the same contract as the in-repo stores
+    (container datasources KVStore shape): str in, str out, KVError on
+    miss."""
+    from gofr_tpu.datasource.kv import InMemoryKVStore
+
+    mem = InMemoryKVStore()
+    for store in (mem, kv):
+        store.set("k", "v")
+        assert store.get("k") == "v"
+        store.delete("k")
+        with pytest.raises(KVError):
+            store.get("k")

@@ -90,3 +90,85 @@ def test_ratio_sampler_deterministic():
     assert span.sampled is False
     tracer2 = Tracer("test", sample_ratio=1.0)
     assert tracer2.start_span("s", activate=False).sampled is True
+
+
+def test_otlp_http_exporter_posts_to_collector():
+    """OTLP/HTTP JSON export against an in-process collector (VERDICT r4
+    item #7; parity otel.go:104-119): resourceSpans shape, string nanos,
+    kind/status enums, Authorization header from TRACER_AUTH_KEY."""
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from gofr_tpu.tracing import OTLPHTTPExporter, new_tracer
+    from gofr_tpu.tracing.export import SimpleSpanProcessor
+
+    received = {}
+
+    class Collector(BaseHTTPRequestHandler):
+        def do_POST(self):
+            received["path"] = self.path
+            received["auth"] = self.headers.get("Authorization")
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            received["payload"] = json.loads(body)
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = HTTPServer(("127.0.0.1", 0), Collector)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_port}/v1/traces"
+        exporter = OTLPHTTPExporter(url, "svc-x", auth_header="Bearer tok")
+        tracer = new_tracer("svc-x", processor=SimpleSpanProcessor(exporter))
+        with tracer.start_span("parent", kind="server") as parent:
+            parent.set_attribute("http.route", "/x")
+            parent.add_event("hit")
+            with tracer.start_span("child", kind="client"):
+                pass
+
+        assert received["path"] == "/v1/traces"
+        assert received["auth"] == "Bearer tok"
+        rs = received["payload"]["resourceSpans"][0]
+        svc = rs["resource"]["attributes"][0]
+        assert svc == {"key": "service.name", "value": {"stringValue": "svc-x"}}
+        spans = rs["scopeSpans"][0]["spans"]
+        # SimpleSpanProcessor posts per span; last POST carries the parent
+        span = spans[0]
+        assert span["name"] == "parent"
+        assert span["kind"] == 2  # SPAN_KIND_SERVER
+        assert span["startTimeUnixNano"].isdigit()  # int64-as-string mapping
+        assert {"key": "http.route", "value": {"stringValue": "/x"}} in span["attributes"]
+        assert span["events"][0]["name"] == "hit"
+    finally:
+        httpd.shutdown()
+
+
+def test_trace_exporter_selection_parity():
+    """TRACE_EXPORTER selection matches otel.go:81-144."""
+    from gofr_tpu.tracing import OTLPHTTPExporter, ZipkinJSONExporter, build_exporter
+    from gofr_tpu.tracing.export import ConsoleExporter
+
+    class Cfg(dict):
+        def get(self, k, d=None):  # noqa: A003
+            return dict.get(self, k, d)
+
+        def get_or_default(self, k, d):
+            return dict.get(self, k, d) or d
+
+    otlp = build_exporter(Cfg(TRACE_EXPORTER="otlp", TRACER_HOST="c",
+                              TRACER_PORT="4318", TRACER_AUTH_KEY="k"))
+    assert isinstance(otlp, OTLPHTTPExporter)
+    assert otlp.url == "http://c:4318/v1/traces"
+    assert otlp.auth_header == "k"
+    jaeger = build_exporter(Cfg(TRACE_EXPORTER="jaeger", TRACER_URL="http://j/v1/traces"))
+    assert isinstance(jaeger, OTLPHTTPExporter)
+    zipkin = build_exporter(Cfg(TRACE_EXPORTER="zipkin", TRACER_HOST="z"))
+    assert isinstance(zipkin, ZipkinJSONExporter)
+    assert zipkin.url == "http://z:9411/api/v2/spans"
+    assert isinstance(build_exporter(Cfg(TRACE_EXPORTER="gofr")), ZipkinJSONExporter)
+    assert isinstance(build_exporter(Cfg(TRACE_EXPORTER="console")), ConsoleExporter)
+    assert build_exporter(Cfg(TRACE_EXPORTER="bogus")) is None
+    assert build_exporter(Cfg()) is None
