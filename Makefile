@@ -44,3 +44,16 @@ protos:
 	protoc -I. --descriptor_set_out=pubsub_v1.binpb pubsub_v1.proto
 	python -m gofr_tpu.grpcx.codegen gofr_tpu/distributed/coordination.proto \
 	  -o gofr_tpu/distributed/
+
+# thread-sanitizer tier (SURVEY §5.2, VERDICT r4 item 9): the allocator/
+# scheduler concurrency stress runs against a -fsanitize=thread build of
+# gofr_runtime.cc — any data race in the C++ layer becomes a hard failure.
+TSAN_RT := $(shell g++ -print-file-name=libtsan.so 2>/dev/null)
+
+.PHONY: native-tsan
+native-tsan:
+	GOFR_NATIVE_EXTRA_CXXFLAGS="-fsanitize=thread -g -O1" \
+	LD_PRELOAD=$(TSAN_RT) \
+	TSAN_OPTIONS="halt_on_error=1 suppressions=native/tsan.supp" \
+	JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/test_native_concurrency.py tests/test_native_runtime.py -q -x

@@ -51,10 +51,13 @@ class ZipkinJSONExporter:
     """POSTs zipkin-v2 JSON batches, the wire shape of the reference's custom
     "gofr" exporter (exporter.go:49-125)."""
 
-    def __init__(self, url: str, service_name: str = "gofr-app", timeout: float = 5.0, logger: Any = None) -> None:
+    def __init__(self, url: str, service_name: str = "gofr-app",
+                 timeout: float = 5.0, auth_header: str = "",
+                 logger: Any = None) -> None:
         self.url = url
         self.service_name = service_name
         self.timeout = timeout
+        self.auth_header = auth_header
         self._logger = logger
 
     def export(self, spans: list[Span]) -> None:
@@ -75,11 +78,14 @@ class ZipkinJSONExporter:
             }
             for s in spans
         ]
+        headers = {"Content-Type": "application/json"}
+        if self.auth_header:
+            headers["Authorization"] = self.auth_header
         try:
             req = urllib.request.Request(
                 self.url,
                 data=json.dumps(payload).encode("utf-8"),
-                headers={"Content-Type": "application/json"},
+                headers=headers,
             )
             urllib.request.urlopen(req, timeout=self.timeout).close()
         except Exception as exc:
@@ -274,22 +280,27 @@ def build_exporter(config: Any, logger: Any = None) -> Any | None:
         return ConsoleExporter(logger)
     url = config.get("TRACER_URL")
     host = config.get("TRACER_HOST")
-    port = config.get_or_default("TRACER_PORT", "9411")
     auth = config.get_or_default("TRACER_AUTH_KEY", "")
     if name in ("otlp", "jaeger"):
         if not url and host:
+            # 4318 is the OTLP/HTTP port every standard collector
+            # (jaeger, tempo, otel-collector) listens on; 9411 is zipkin's
+            port = config.get_or_default("TRACER_PORT", "4318")
             url = f"http://{host}:{port}/v1/traces"
         if url:
             return OTLPHTTPExporter(url, service, auth_header=auth,
                                     logger=logger)
     if name == "gofr":
         url = url or "https://tracer-api.gofr.dev/api/spans"
-        return ZipkinJSONExporter(url, service, logger=logger)
+        return ZipkinJSONExporter(url, service, auth_header=auth,
+                                  logger=logger)
     if name == "zipkin":
         if not url and host:
+            port = config.get_or_default("TRACER_PORT", "9411")
             url = f"http://{host}:{port}/api/v2/spans"
         if url:
-            return ZipkinJSONExporter(url, service, logger=logger)
+            return ZipkinJSONExporter(url, service, auth_header=auth,
+                                      logger=logger)
     if logger is not None:
         if name in ("otlp", "jaeger", "zipkin"):
             # a known exporter with no endpoint is a CONFIG gap — blaming

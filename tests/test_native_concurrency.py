@@ -1,0 +1,136 @@
+"""Concurrency stress for the native allocator/scheduler (SURVEY §5.2).
+
+These tests hammer the C++ BlockAllocator and Scheduler from many
+threads at once. Under the plain build they are a functional race smoke;
+under ``make native-tsan`` the same tests run against a
+``-fsanitize=thread`` build, which turns any data race in
+native/runtime/gofr_runtime.cc into a hard failure — the TSan tier the
+r4 verdict called out as missing for a 469-LoC concurrent scheduler.
+"""
+
+import threading
+
+from gofr_tpu.native.runtime import (
+    BlockAllocator,
+    OutOfBlocks,
+    QueueFull,
+    Scheduler,
+)
+
+
+def test_block_allocator_concurrent_stress():
+    ba = BlockAllocator(512, 16)
+    errs: list = []
+    barrier = threading.Barrier(8)
+
+    def worker(wid: int) -> None:
+        try:
+            barrier.wait()
+            for i in range(300):
+                sid = wid * 10_000 + i
+                try:
+                    ba.alloc(sid, 1 + (i % 64))
+                except OutOfBlocks:
+                    continue
+                try:
+                    ba.extend(sid, 1 + (i % 64) + 24)
+                except OutOfBlocks:
+                    pass
+                assert ba.block_table(sid)
+                ba.stats()
+                if i % 7 == 0:
+                    try:
+                        ba.fork(sid, sid + 5_000, shared_tokens=1)
+                        ba.free(sid + 5_000)
+                    except OutOfBlocks:
+                        pass
+                ba.free(sid)
+        except Exception as exc:  # noqa: BLE001 - collected for the assert
+            errs.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errs, errs[:3]
+    # every block returned: refcount accounting survived the stampede
+    assert ba.stats()["free_blocks"] == 512
+    ba.close()
+
+
+def test_scheduler_concurrent_submit_admit_release():
+    sc = Scheduler(8, 1024, 1 << 30)
+    errs: list = []
+    admitted: list[tuple[int, int]] = []
+    done = threading.Event()
+    n_submitters, per_thread = 6, 400
+
+    def submitter(wid: int) -> None:
+        try:
+            for i in range(per_thread):
+                rid = wid * 100_000 + i
+                try:
+                    sc.submit(rid, prompt_len=16, max_new_tokens=8,
+                              priority=i % 3)
+                except QueueFull:
+                    pass
+                if i % 11 == 10:
+                    try:
+                        sc.cancel(rid)
+                    except KeyError:
+                        pass  # raced with admission — the engine's no-op case
+        except Exception as exc:  # noqa: BLE001
+            errs.append(exc)
+
+    def admitter() -> None:
+        try:
+            idle = 0
+            while idle < 200:
+                pairs, _canceled = sc.admit(4)
+                if pairs:
+                    idle = 0
+                    admitted.extend(pairs)
+                    for _rid, slot in pairs:
+                        assert 0 <= slot < 8
+                        sc.release(slot)
+                elif done.is_set():
+                    idle += 1
+        except Exception as exc:  # noqa: BLE001
+            errs.append(exc)
+
+    threads = [threading.Thread(target=submitter, args=(w,))
+               for w in range(n_submitters)]
+    adm = threading.Thread(target=admitter)
+    adm.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    done.set()
+    adm.join(timeout=120)
+    assert not errs, errs[:3]
+    stats = sc.stats()
+    assert stats["queue_depth"] == 0
+    # nothing admitted twice
+    rids = [rid for rid, _ in admitted]
+    assert len(rids) == len(set(rids))
+    sc.close()
+
+
+def test_sanitizer_tier_really_runs_native():
+    """`make native-tsan` must never go green on the Python fallback: when
+    a sanitizer build is requested and fails to load, that's a broken
+    tier, not a pass (code-review r5)."""
+    import os
+
+    if not os.environ.get("GOFR_NATIVE_EXTRA_CXXFLAGS"):
+        return  # plain runs may use either backend
+    ba = BlockAllocator(4, 4)
+    sc = Scheduler(2, 8, 1 << 20)
+    try:
+        assert ba.backend == "native", "sanitizer build fell back to Python"
+        assert sc.backend == "native", "sanitizer build fell back to Python"
+    finally:
+        ba.close()
+        sc.close()
