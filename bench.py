@@ -600,22 +600,49 @@ def _bert_embed_http(on_tpu: bool) -> dict:
     concurrency = 16
     text = "the quick brown fox jumps over the lazy dog " * 2
 
-    with _bench_app(
-        "bench-embed",
-        lambda app: register_embedding_routes(app, cfg, params, tokenizer),
-    ) as base:
-        _post_json(base + "/embed", {"texts": [text]})  # warm the jit off the clock
+    # BENCH_NATIVE_PJRT=1 serves /embed through the native PJRT runtime
+    # (serving/native_embed.py) — stub plugin off-TPU, libtpu when the
+    # environment provides it via TPU_PJRT_PLUGIN
+    native_embedder = None
+    if os.environ.get("BENCH_NATIVE_PJRT") == "1":
+        from gofr_tpu.serving.native_embed import NativePjrtEmbedder
 
-        def issue(wid: int, i: int) -> float:
-            return _post_json(base + "/embed", {"texts": [text]})
+        # on a TPU host: the binding's own resolution order
+        # ($TPU_PJRT_PLUGIN, then libtpu) so the number is REAL hardware,
+        # never the stub mislabeled as it. Off-TPU libtpu would fail init
+        # (no device), so the CPU tier pins the stub explicitly.
+        if on_tpu:
+            plugin_path = None
+        else:
+            from gofr_tpu.native import build_stub_plugin
 
-        latencies, elapsed, err = _closed_loop(duration, concurrency, issue)
+            plugin_path = build_stub_plugin()
+        native_embedder = NativePjrtEmbedder(cfg, params,
+                                             plugin_path=plugin_path)
+
+    try:
+        with _bench_app(
+            "bench-embed",
+            lambda app: register_embedding_routes(
+                app, cfg, params, tokenizer, native_embedder=native_embedder
+            ),
+        ) as base:
+            _post_json(base + "/embed", {"texts": [text]})  # warm off the clock
+
+            def issue(wid: int, i: int) -> float:
+                return _post_json(base + "/embed", {"texts": [text]})
+
+            latencies, elapsed, err = _closed_loop(duration, concurrency, issue)
+    finally:
+        if native_embedder is not None:
+            native_embedder.close()
 
     return {
         "requests": len(latencies),
         "duration_s": round(elapsed, 2),
         "concurrency": concurrency,
         "model": "bert-base" if on_tpu else "bert-tiny",
+        "engine": "native-pjrt" if native_embedder is not None else "jax",
         "req_per_s": round(len(latencies) / elapsed, 2),
         "latency": _percentiles(latencies),
         **err,

@@ -143,14 +143,32 @@ def register_generation_ws(app: Any, engine: Any, path: str = "/ws/generate") ->
     app.websocket(path, ws_generate)
 
 
-def register_embedding_routes(app: Any, bert_cfg: Any, bert_params: Any, tokenizer: Any, prefix: str = "") -> None:
+def register_embedding_routes(app: Any, bert_cfg: Any, bert_params: Any,
+                              tokenizer: Any, prefix: str = "",
+                              native_embedder: Any = None) -> None:
     """The /embed endpoint (BASELINE.json configs[1]): tokenize, batch to a
-    padded bucket, run the jitted embedder."""
+    padded bucket, run the jitted embedder. When ``native_embedder`` is
+    given (or TPU_NATIVE_PJRT=1 builds one), requests execute through the
+    native PJRT runtime instead — no JAX in the serving loop
+    (serving/native_embed.py); the response's ``engine`` field reports
+    which path served."""
     import jax.numpy as jnp
     import numpy as np
 
     from gofr_tpu.models import bert as bert_model
     from gofr_tpu.serving.tokenizer import pad_batch
+
+    if native_embedder is None:
+        from gofr_tpu.serving.native_embed import maybe_native_embedder
+
+        native_embedder = maybe_native_embedder(
+            bert_cfg, bert_params, getattr(app.container, "config", None),
+            logger=getattr(app.container, "logger", None),
+        )
+        if native_embedder is not None and hasattr(app, "on_shutdown"):
+            # the PJRT client + executable are native resources; mirror
+            # register_generation_routes' engine.stop hook
+            app.on_shutdown(native_embedder.close)
 
     async def embed(ctx: Any):
         body = ctx.bind(dict) or {}
@@ -159,20 +177,30 @@ def register_embedding_routes(app: Any, bert_cfg: Any, bert_params: Any, tokeniz
             texts = [texts]
         if not texts:
             raise ErrorMissingParam("input")
-        arr, lens = pad_batch(tokenizer, texts, bert_cfg.max_seq_len)
         loop = asyncio.get_running_loop()
-        emb = await loop.run_in_executor(
-            None,
-            lambda: np.asarray(
-                bert_model.embed(
-                    bert_cfg, bert_params, jnp.asarray(arr), jnp.asarray(lens, jnp.int32)
-                )
-            ),
-        )
+        if native_embedder is not None:
+            emb, n_tokens = await loop.run_in_executor(
+                None, lambda: native_embedder.embed_texts(tokenizer, texts)
+            )
+            engine = "native-pjrt"
+        else:
+            arr, lens = pad_batch(tokenizer, texts, bert_cfg.max_seq_len)
+            emb = await loop.run_in_executor(
+                None,
+                lambda: np.asarray(
+                    bert_model.embed(
+                        bert_cfg, bert_params, jnp.asarray(arr),
+                        jnp.asarray(lens, jnp.int32),
+                    )
+                ),
+            )
+            n_tokens = int(sum(lens))
+            engine = "jax"
         return {
             "embeddings": emb.tolist(),
             "dim": int(emb.shape[1]),
-            "usage": {"prompt_tokens": int(sum(lens))},
+            "engine": engine,
+            "usage": {"prompt_tokens": n_tokens},
         }
 
     app.post(prefix + "/embed", embed)

@@ -1,0 +1,113 @@
+"""/embed through the native PJRT runtime (VERDICT r4 item #5).
+
+The stub plugin's execute is the deterministic ``y = 2x``, so these
+tests prove the full native path — StableHLO lowering, C-API compile,
+buffer upload, execute, buffer download — carries real data end to end
+without hardware; under libtpu the same MLIR produces real embeddings.
+"""
+
+import jax
+import pytest
+
+from gofr_tpu.models import bert
+from gofr_tpu.native import build_stub_plugin
+from gofr_tpu.serving import ByteTokenizer
+
+CFG = bert.BertConfig.tiny()
+PARAMS = bert.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _stub() -> str:
+    path = build_stub_plugin()
+    if path is None:
+        pytest.skip("stub plugin unbuildable (no PJRT headers)")
+    return path
+
+
+def test_native_embedder_executes_through_pjrt():
+    from gofr_tpu.serving.native_embed import NativePjrtEmbedder
+
+    emb = NativePjrtEmbedder(CFG, PARAMS, plugin_path=_stub(), seq_len=8)
+    try:
+        assert emb.platform == "gofr_stub"
+        out = emb.embed_tokens([3, 5, 7])
+        # stub executes y = 2x over the input buffer: the request's padded
+        # token row went through the native compile+execute pipeline
+        assert out[:3] == [6.0, 10.0, 14.0]
+        assert out[3:] == [-2.0] * 5  # the -1 padding, doubled
+    finally:
+        emb.close()
+
+
+def test_embed_route_serves_native(run_async):
+    """The flagged path through the real handler: response reports
+    engine=native-pjrt and carries the native executable's output."""
+    from gofr_tpu.serving.handlers import register_embedding_routes
+    from gofr_tpu.serving.native_embed import NativePjrtEmbedder
+    from gofr_tpu.testutil import new_mock_container
+
+    emb = NativePjrtEmbedder(CFG, PARAMS, plugin_path=_stub(), seq_len=8)
+
+    class FakeApp:
+        def __init__(self):
+            self.container, _ = new_mock_container()
+            self.routes = {}
+
+        def post(self, path, handler):
+            self.routes[path] = handler
+
+    app = FakeApp()
+    tokenizer = ByteTokenizer(CFG.vocab_size)
+    register_embedding_routes(app, CFG, PARAMS, tokenizer,
+                              native_embedder=emb)
+
+    class Ctx:
+        def bind(self, _t):
+            return {"input": "ab"}
+
+    try:
+        result = run_async(app.routes["/embed"](Ctx()))
+        assert result["engine"] == "native-pjrt"
+        ids = tokenizer.encode("ab")
+        assert result["embeddings"][0][: len(ids)] == [2.0 * t for t in ids]
+    finally:
+        emb.close()
+
+
+def test_flag_off_serves_jax(run_async):
+    from gofr_tpu.serving.handlers import register_embedding_routes
+    from gofr_tpu.testutil import new_mock_container
+
+    class FakeApp:
+        def __init__(self):
+            self.container, _ = new_mock_container()
+            self.routes = {}
+
+        def post(self, path, handler):
+            self.routes[path] = handler
+
+    app = FakeApp()
+    register_embedding_routes(app, CFG, PARAMS, ByteTokenizer(CFG.vocab_size))
+
+    class Ctx:
+        def bind(self, _t):
+            return {"input": "hello"}
+
+    result = run_async(app.routes["/embed"](Ctx()))
+    assert result["engine"] == "jax"
+    assert result["dim"] == CFG.d_model
+
+
+def test_maybe_native_falls_back_gracefully():
+    """A bad plugin path must degrade to the JAX path, not crash
+    serving."""
+    from gofr_tpu.config import MapConfig
+    from gofr_tpu.serving.native_embed import maybe_native_embedder
+
+    cfg = MapConfig(
+        {"TPU_NATIVE_PJRT": "1", "TPU_PJRT_PLUGIN": "/nonexistent.so"},
+        use_env=False,
+    )
+    assert maybe_native_embedder(CFG, PARAMS, cfg) is None
+    off = MapConfig({}, use_env=False)
+    assert maybe_native_embedder(CFG, PARAMS, off) is None
