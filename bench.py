@@ -607,12 +607,20 @@ def _bert_embed_http(on_tpu: bool) -> dict:
     if os.environ.get("BENCH_NATIVE_PJRT") == "1":
         from gofr_tpu.serving.native_embed import NativePjrtEmbedder
 
-        # on a TPU host: the binding's own resolution order
-        # ($TPU_PJRT_PLUGIN, then libtpu) so the number is REAL hardware,
-        # never the stub mislabeled as it. Off-TPU libtpu would fail init
-        # (no device), so the CPU tier pins the stub explicitly.
+        # on a TPU host: resolve a REAL plugin only ($TPU_PJRT_PLUGIN,
+        # then libtpu) and fail loudly when absent — the stub's y=2x
+        # execute must never masquerade as hardware numbers. Off-TPU
+        # libtpu would fail init (no device), so the CPU tier pins the
+        # stub explicitly.
         if on_tpu:
-            plugin_path = None
+            from gofr_tpu.native.pjrt import probe_plugin_path
+
+            plugin_path = probe_plugin_path()
+            if plugin_path is None:
+                raise RuntimeError(
+                    "BENCH_NATIVE_PJRT=1 on TPU but no real PJRT plugin "
+                    "found (set TPU_PJRT_PLUGIN or install libtpu)"
+                )
         else:
             from gofr_tpu.native import build_stub_plugin
 

@@ -1,0 +1,19 @@
+"""gRPC service registration (reference: examples/grpc). The Inference
+service ships Echo/Generate/Embed; GRPC_PORT selects the listener."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import gofr_tpu
+from gofr_tpu.grpcx import InferenceService
+
+
+def build_app(config=None) -> gofr_tpu.App:
+    app = gofr_tpu.App(config)
+    app.register_grpc_service(InferenceService())
+    app.get("/", lambda ctx: {"grpc": "enabled"})
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -1,0 +1,28 @@
+"""Minimal HTTP server (reference: examples/http-server)."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import gofr_tpu
+from gofr_tpu.http.errors import ErrorEntityNotFound
+
+GREETS = {"en": "hello", "fr": "bonjour"}
+
+
+def build_app(config=None) -> gofr_tpu.App:
+    app = gofr_tpu.App(config)
+
+    def greet(ctx):
+        lang = ctx.path_param("lang")
+        if lang not in GREETS:
+            raise ErrorEntityNotFound("lang", lang)
+        name = ctx.param("name") or "world"
+        return {"greeting": f"{GREETS[lang]} {name}"}
+
+    app.get("/greet/{lang}", greet)
+    app.post("/echo", lambda ctx: ctx.bind(dict))
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -1,0 +1,31 @@
+"""CLI app with subcommands and terminal output (reference:
+examples/sample-cmd). Run: python main.py hello --name ada"""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import gofr_tpu
+
+
+def build_app(config=None) -> gofr_tpu.App:
+    app = gofr_tpu.App(config, is_cmd=True)
+
+    from gofr_tpu.cli.terminal import Output
+
+    out = Output()
+
+    def hello(ctx):
+        name = ctx.param("name") or "world"
+        return out.colorize(f"hello {name}!", "green", bold=True)
+
+    def add(ctx):
+        a, b = int(ctx.param("a") or 0), int(ctx.param("b") or 0)
+        return f"{a} + {b} = {a + b}"
+
+    app.sub_command("hello", hello, description="greet someone")
+    app.sub_command("add", add, description="add two numbers")
+    return app
+
+
+if __name__ == "__main__":
+    sys.exit(build_app().run())

@@ -1,0 +1,25 @@
+"""Crontab-scheduled handlers (reference: examples/using-cron-jobs).
+The 5-field schedule supports ranges/steps/lists; jobs run traced."""
+
+import sys, os, time
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import gofr_tpu
+
+TICKS = []
+
+
+def build_app(config=None) -> gofr_tpu.App:
+    app = gofr_tpu.App(config)
+
+    def tick(ctx):
+        TICKS.append(time.time())
+        ctx.logger.info(f"cron tick #{len(TICKS)}")
+
+    app.add_cron_job("* * * * *", "heartbeat", tick)
+    app.get("/ticks", lambda ctx: {"count": len(TICKS)})
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -1,0 +1,17 @@
+"""Basic-auth middleware (reference: examples/using-http-auth-middleware)."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import gofr_tpu
+
+
+def build_app(config=None) -> gofr_tpu.App:
+    app = gofr_tpu.App(config)
+    app.enable_basic_auth({"admin": "secret"})
+    app.get("/protected", lambda ctx: {"user": "admin", "ok": True})
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

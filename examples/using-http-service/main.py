@@ -1,0 +1,31 @@
+"""Inter-service HTTP client with circuit breaker + health checks
+(reference: examples/using-http-service)."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import gofr_tpu
+from gofr_tpu.service import CircuitBreakerConfig, RetryConfig
+
+UPSTREAM = os.environ.get("UPSTREAM_URL", "http://localhost:9000")
+
+
+def build_app(config=None) -> gofr_tpu.App:
+    app = gofr_tpu.App(config)
+    app.add_http_service(
+        "catalog", UPSTREAM,
+        CircuitBreakerConfig(threshold=3, interval=5.0),
+        RetryConfig(max_retries=2),
+    )
+
+    async def proxy(ctx):
+        svc = ctx.get_http_service("catalog")
+        resp = await svc.get("items")
+        return {"upstream_status": resp.status, "body": resp.json()}
+
+    app.get("/catalog", proxy)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

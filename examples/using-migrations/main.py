@@ -1,0 +1,26 @@
+"""Versioned migrations at startup (reference: examples/using-migrations).
+Applied once, tracked in gofr_migrations, transactional per version."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import gofr_tpu
+from gofr_tpu.migration import Migrate
+
+
+def build_app(config=None) -> gofr_tpu.App:
+    app = gofr_tpu.App(config)
+    app.migrate({
+        1: Migrate(up=lambda ds: ds.sql.exec(
+            "CREATE TABLE IF NOT EXISTS users (id INTEGER PRIMARY KEY, name TEXT)"
+        )),
+        2: Migrate(up=lambda ds: ds.sql.exec(
+            "INSERT INTO users (id, name) VALUES (1, 'ada')"
+        )),
+    })
+    app.get("/users", lambda ctx: {"users": ctx.sql.query("SELECT * FROM users")})
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

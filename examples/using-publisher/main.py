@@ -1,0 +1,24 @@
+"""Pub/sub producer endpoint (reference: examples/using-publisher).
+PUBSUB_BACKEND selects kafka/google/mqtt/eventhub/nats; default memory."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import json
+import gofr_tpu
+
+
+def build_app(config=None) -> gofr_tpu.App:
+    app = gofr_tpu.App(config)
+
+    def publish(ctx):
+        body = ctx.bind(dict)
+        ctx.get_publisher().publish("orders", json.dumps(body).encode())
+        return {"published": True}
+
+    app.post("/publish", publish)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -1,0 +1,29 @@
+"""CRUD auto-handlers from an entity class (reference:
+examples/using-add-rest-handlers). GET/POST/PUT/DELETE /book are derived
+from the dataclass; storage is the configured SQL dialect."""
+
+import dataclasses
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import gofr_tpu
+
+
+@dataclasses.dataclass
+class Book:
+    id: int = 0
+    title: str = ""
+    year: int = 0
+
+
+def build_app(config=None) -> gofr_tpu.App:
+    app = gofr_tpu.App(config)
+    app.container.sql.exec(
+        "CREATE TABLE IF NOT EXISTS book (id INTEGER PRIMARY KEY, title TEXT, year INTEGER)"
+    )
+    app.add_rest_handlers(Book)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -1,0 +1,26 @@
+"""Pub/sub consumer loop (reference: examples/using-subscriber): the
+handler receives each message as a request; commit on success."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import gofr_tpu
+
+SEEN = []
+
+
+def build_app(config=None) -> gofr_tpu.App:
+    app = gofr_tpu.App(config)
+
+    def on_order(ctx):
+        order = ctx.bind(dict)
+        SEEN.append(order)
+        ctx.logger.info(f"order received: {order}")
+
+    app.subscribe("orders", on_order)
+    app.get("/orders/seen", lambda ctx: {"count": len(SEEN)})
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -1,0 +1,21 @@
+"""WebSocket upgrade + echo (reference: examples/using-web-socket)."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import gofr_tpu
+
+
+def build_app(config=None) -> gofr_tpu.App:
+    app = gofr_tpu.App(config)
+
+    def ws_echo(ctx):
+        # invoked per message; the return value is written back to the peer
+        return {"echo": ctx.bind(dict)}
+
+    app.websocket("/ws", ws_echo)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -70,7 +70,22 @@ class Output:
 
     def clear_line(self) -> None:
         self._csi("2K")
-        self.stream.write("\r")
+        if self.is_terminal:  # piped output must not collect stray \r
+            self.stream.write("\r")
+
+    def clear_line_left(self) -> None:
+        self._csi("1K")
+
+    def clear_line_right(self) -> None:
+        self._csi("0K")
+
+    def clear_lines(self, n: int) -> None:
+        """Clear the current line and the ``n`` lines above it
+        (output.go ClearLines: the spinner/progress repaint primitive)."""
+        self.clear_line()
+        for _ in range(max(n, 0)):
+            self.cursor_up(1)
+            self.clear_line()
 
     def cursor_up(self, n: int = 1) -> None:
         self._csi(f"{n}A")
@@ -78,11 +93,85 @@ class Output:
     def cursor_down(self, n: int = 1) -> None:
         self._csi(f"{n}B")
 
+    def cursor_forward(self, n: int = 1) -> None:
+        self._csi(f"{n}C")
+
+    def cursor_back(self, n: int = 1) -> None:
+        self._csi(f"{n}D")
+
+    def cursor_next_line(self, n: int = 1) -> None:
+        self._csi(f"{n}E")
+
+    def cursor_prev_line(self, n: int = 1) -> None:
+        self._csi(f"{n}F")
+
+    def move_cursor(self, row: int, column: int) -> None:
+        self._csi(f"{row};{column}H")
+
+    def save_cursor_position(self) -> None:
+        self._csi("s")
+
+    def restore_cursor_position(self) -> None:
+        self._csi("u")
+
     def hide_cursor(self) -> None:
         self._csi("?25l")
 
     def show_cursor(self) -> None:
         self._csi("?25h")
+
+    # -- screen ops (output.go screen methods) ---------------------------------
+    def alt_screen(self) -> None:
+        self._csi("?1049h")
+
+    def exit_alt_screen(self) -> None:
+        self._csi("?1049l")
+
+    def save_screen(self) -> None:
+        self._csi("?47h")
+
+    def restore_screen(self) -> None:
+        self._csi("?47l")
+
+    def change_scrolling_region(self, top: int, bottom: int) -> None:
+        self._csi(f"{top};{bottom}r")
+
+    def insert_lines(self, n: int = 1) -> None:
+        self._csi(f"{n}L")
+
+    def delete_lines(self, n: int = 1) -> None:
+        self._csi(f"{n}M")
+
+    def set_color(self, color_code: int) -> None:
+        """Raw SGR color by numeric code (output.go SetColor)."""
+        self._csi(f"{int(color_code)}m")
+
+    def reset_color(self) -> None:
+        self._csi("39;49m")
+
+    def reset(self) -> None:
+        if self.is_terminal:
+            self.stream.write(RESET)
+            self.stream.flush()
+
+    def set_window_title(self, title: str) -> None:
+        if self.is_terminal:
+            self.stream.write(f"\x1b]2;{title}\x07")
+            self.stream.flush()
+
+    def get_size(self) -> tuple[int, int]:
+        """(columns, rows) of the ATTACHED terminal (this Output's
+        stream, not whatever stdout happens to be); (0, 0) off-tty
+        (output.go getSize)."""
+        import os
+
+        if not self.is_terminal:
+            return (0, 0)
+        try:
+            size = os.get_terminal_size(self.stream.fileno())
+            return (size.columns, size.lines)
+        except (OSError, ValueError, AttributeError):
+            return (80, 24)
 
 
 class Spinner:

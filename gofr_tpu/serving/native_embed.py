@@ -56,10 +56,17 @@ class NativePjrtEmbedder:
         lowered = jax.jit(embed_one).lower(
             jax.ShapeDtypeStruct((self.seq_len,), jnp.float32)
         )
-        mlir = str(lowered.compiler_ir(dialect="stablehlo"))
+        module = lowered.compiler_ir(dialect="stablehlo")
+        try:
+            # MLIR bytecode keeps the weight constants binary (4 B/f32);
+            # the textual form hex-prints every tensor — multi-GB strings
+            # at bert-base scale
+            mlir_bytes = module.operation.get_asm(binary=True)
+        except Exception:
+            mlir_bytes = str(module).encode()
         self.plugin = PjrtPlugin.load(plugin_path)
         self.client = self.plugin.create_client()
-        self.executable = self.client.compile(mlir.encode(), "mlir")
+        self.executable = self.client.compile(mlir_bytes, "mlir")
         self.platform = self.client.platform_name
 
     def embed_tokens(self, token_ids: list[int]) -> list[float]:

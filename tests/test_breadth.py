@@ -214,3 +214,76 @@ def test_cron_parser():
     s_range = Schedule("0 9-17/2 * * 1-5")
     assert s_range.sets["hour"] == {9, 11, 13, 15, 17}
     assert s_range.sets["dow"] == {1, 2, 3, 4, 5}
+
+
+def test_terminal_output_full_surface():
+    """The full Output interface (reference output.go:12-45, 30+ ops):
+    every op emits its ANSI sequence on a tty and degrades to a no-op
+    off-tty."""
+    import io
+
+    from gofr_tpu.cli.terminal import Output
+
+    class Tty(io.StringIO):
+        def isatty(self):
+            return True
+
+    buf = Tty()
+    out = Output(buf)
+    ops = [
+        (lambda: out.clear_screen(), "\x1b[2J"),
+        (lambda: out.clear_line_left(), "\x1b[1K"),
+        (lambda: out.clear_line_right(), "\x1b[0K"),
+        (lambda: out.clear_lines(2), "\x1b[2K"),
+        (lambda: out.cursor_up(3), "\x1b[3A"),
+        (lambda: out.cursor_down(2), "\x1b[2B"),
+        (lambda: out.cursor_forward(4), "\x1b[4C"),
+        (lambda: out.cursor_back(5), "\x1b[5D"),
+        (lambda: out.cursor_next_line(1), "\x1b[1E"),
+        (lambda: out.cursor_prev_line(1), "\x1b[1F"),
+        (lambda: out.move_cursor(3, 7), "\x1b[3;7H"),
+        (lambda: out.save_cursor_position(), "\x1b[s"),
+        (lambda: out.restore_cursor_position(), "\x1b[u"),
+        (lambda: out.hide_cursor(), "\x1b[?25l"),
+        (lambda: out.show_cursor(), "\x1b[?25h"),
+        (lambda: out.alt_screen(), "\x1b[?1049h"),
+        (lambda: out.exit_alt_screen(), "\x1b[?1049l"),
+        (lambda: out.save_screen(), "\x1b[?47h"),
+        (lambda: out.restore_screen(), "\x1b[?47l"),
+        (lambda: out.change_scrolling_region(1, 20), "\x1b[1;20r"),
+        (lambda: out.insert_lines(2), "\x1b[2L"),
+        (lambda: out.delete_lines(2), "\x1b[2M"),
+        (lambda: out.set_color(35), "\x1b[35m"),
+        (lambda: out.reset_color(), "\x1b[39;49m"),
+        (lambda: out.reset(), "\x1b[0m"),
+        (lambda: out.set_window_title("t"), "\x1b]2;t\x07"),
+    ]
+    for op, want in ops:
+        buf.truncate(0)
+        buf.seek(0)
+        op()
+        assert want in buf.getvalue(), want
+    cols, rows = out.get_size()
+    assert cols > 0 and rows > 0
+
+    # off-tty: control sequences are suppressed, printing still works
+    plain = io.StringIO()
+    quiet = Output(plain)
+    quiet.alt_screen()
+    quiet.set_window_title("x")
+    quiet.println("visible")
+    assert plain.getvalue() == "visible\n"
+
+
+def test_sql_dialect_aliases_cockroach_supabase():
+    """Dialect dispatch parity with sql.go:212-237: supabase and
+    cockroachdb ride the postgres wire dialect."""
+    from gofr_tpu.config import MapConfig
+    from gofr_tpu.datasource.sql.postgres import PostgresDB
+    from gofr_tpu.datasource.sql.sqlite import new_sql
+
+    for dialect in ("supabase", "cockroachdb", "postgres"):
+        db = new_sql(MapConfig({"DB_DIALECT": dialect}, use_env=False))
+        assert isinstance(db, PostgresDB), dialect
+    with pytest.raises(ValueError, match="DB_DIALECT"):
+        new_sql(MapConfig({"DB_DIALECT": "oracle-net"}, use_env=False))
