@@ -5,10 +5,22 @@
 PY ?= python
 ASAN_RT := $(shell g++ -print-file-name=libasan.so 2>/dev/null)
 
-.PHONY: check import-check test bench-smoke native native-asan
+.PHONY: check import-check lint lock-order test bench-smoke native native-asan
 
-check: import-check test native-asan bench-smoke
+check: import-check lint test native-asan bench-smoke
 	@echo "CHECK OK"
+
+# gofrlint (docs/static-analysis.md): framework-invariant AST lints over
+# the whole package + the extern-C vs ctypes FFI signature cross-check.
+# Exits non-zero on any unsuppressed finding.
+lint:
+	$(PY) -m gofr_tpu.analysis gofr_tpu/
+
+# lock-order tier: run the concurrency tests with every Python lock
+# instrumented; any cyclic acquisition order (potential deadlock) fails.
+lock-order:
+	GOFR_LOCK_ORDER=1 JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/test_native_concurrency.py tests/test_engine_recovery.py -q -x
 
 import-check:
 	$(PY) -c "import compileall,sys; sys.exit(0 if compileall.compile_dir('gofr_tpu', quiet=2) else 1)"
@@ -46,14 +58,18 @@ protos:
 	  -o gofr_tpu/distributed/
 
 # thread-sanitizer tier (SURVEY §5.2, VERDICT r4 item 9): the allocator/
-# scheduler concurrency stress runs against a -fsanitize=thread build of
-# gofr_runtime.cc — any data race in the C++ layer becomes a hard failure.
+# scheduler concurrency stress AND the PJRT binding (pjrt_dl.cc +
+# stub_plugin.cc, rebuilt with -fsanitize=thread through the loader's
+# GOFR_NATIVE_EXTRA_CXXFLAGS hook) run against TSan builds — any data race
+# in the C++ layer becomes a hard failure. GOFR_PJRT_INCLUDE_DIRS skips
+# the tensorflow import (same reason as native-asan).
 TSAN_RT := $(shell g++ -print-file-name=libtsan.so 2>/dev/null)
 
 .PHONY: native-tsan
 native-tsan:
 	GOFR_NATIVE_EXTRA_CXXFLAGS="-fsanitize=thread -g -O1" \
+	GOFR_PJRT_INCLUDE_DIRS="$$($(PY) -c 'from gofr_tpu.native import pjrt_include_dirs; print(":".join(pjrt_include_dirs()))')" \
 	LD_PRELOAD=$(TSAN_RT) \
 	TSAN_OPTIONS="halt_on_error=1 suppressions=native/tsan.supp" \
 	JAX_PLATFORMS=cpu \
-	$(PY) -m pytest tests/test_native_concurrency.py tests/test_native_runtime.py -q -x
+	$(PY) -m pytest tests/test_native_concurrency.py tests/test_native_runtime.py tests/test_native_pjrt.py -q -x

@@ -1,0 +1,38 @@
+"""gofrlint — framework-invariant static analysis for gofr-tpu.
+
+The north-star serving numbers die by a thousand cuts: a stray
+``time.sleep`` in handler dispatch, a host-device sync in the decode hot
+loop, a ctypes binding that drifts from the ``extern "C"`` surface of the
+native layer, or an unordered lock pair in the batching scheduler. The
+C++ TUs already run under ASan/UBSan/TSan (``make native-asan`` /
+``native-tsan``); this package is the equivalent enforcement tier for the
+~170 Python files and the Python↔C boundary:
+
+- :mod:`gofr_tpu.analysis.rules` — AST lints: no blocking calls in
+  HTTP/gRPC dispatch or the engine decode loop, no host-device syncs in
+  the serving hot path outside annotated sync points, registered and
+  bounded-cardinality metrics, status-checked ctypes calls.
+- :mod:`gofr_tpu.analysis.ffi` — cross-checks every ``extern "C"``
+  symbol in ``native/`` against the ctypes ``argtypes``/``restype``
+  declarations (drift here is a memory-corruption bug ASan only catches
+  at runtime).
+- :mod:`gofr_tpu.analysis.lockorder` — a runtime shim that records
+  Python-side lock-acquisition ordering during the concurrency tests and
+  fails on cycles (``make lock-order``), complementing the C++-only TSan
+  tier.
+
+Run ``python -m gofr_tpu.analysis`` (or ``make lint``); it exits non-zero
+on any unsuppressed finding. Suppress with
+``# gofrlint: disable=<rule> -- <reason>`` — the reason is mandatory.
+"""
+
+from __future__ import annotations
+
+from gofr_tpu.analysis.core import (
+    Finding,
+    SourceFile,
+    parse_suppressions,
+    run_rules,
+)
+
+__all__ = ["Finding", "SourceFile", "parse_suppressions", "run_rules"]

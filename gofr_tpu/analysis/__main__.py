@@ -1,0 +1,98 @@
+"""``python -m gofr_tpu.analysis`` — run gofrlint over the tree.
+
+Exit status 0 when clean, 1 on any unsuppressed finding, 2 on usage
+error. ``make lint`` wires this into the ``make check`` gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from gofr_tpu.analysis.core import run_rules
+from gofr_tpu.analysis.ffi import check_ffi
+from gofr_tpu.analysis.rules import default_rules
+
+
+def _default_repo_root() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m gofr_tpu.analysis",
+        description="gofrlint: framework-invariant static analysis + "
+        "FFI signature cross-checker",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories to lint (default: the gofr_tpu package)",
+    )
+    parser.add_argument(
+        "--repo-root", default=None,
+        help="repository root holding native/ (default: inferred)",
+    )
+    parser.add_argument(
+        "--no-ffi", action="store_true",
+        help="skip the extern-C vs ctypes signature cross-check",
+    )
+    parser.add_argument(
+        "--ffi-only", action="store_true", help="run only the FFI cross-check"
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        from gofr_tpu.analysis import rules as rules_mod
+
+        print("blocking-call        blocking primitives in dispatch/decode zones")
+        print("host-sync            host-device syncs in the decode hot path")
+        print("metric-unregistered  metric name used but never registered")
+        print("metric-dynamic-name  computed metric name at a call site")
+        print("metric-label-cardinality  unbounded metric label key/value")
+        print("ctypes-unchecked     native status code discarded")
+        print("ffi-mismatch/ffi-unbound/ffi-stale  extern-C vs ctypes drift")
+        print("bad-suppression      gofrlint suppression without a reason")
+        print()
+        print("dispatch zones:", ", ".join(sorted(rules_mod.DISPATCH_ZONES)))
+        print("backoff zones: ", ", ".join(sorted(rules_mod.BACKOFF_ZONES)))
+        return 0
+
+    repo_root = args.repo_root or _default_repo_root()
+    findings = []
+    if not args.ffi_only:
+        paths = args.paths or [os.path.join(repo_root, "gofr_tpu")]
+        for p in paths:
+            if not os.path.exists(p):
+                print(f"error: no such path: {p}", file=sys.stderr)
+                return 2
+        findings.extend(run_rules(paths, default_rules()))
+    if not args.no_ffi:
+        if os.path.isdir(os.path.join(repo_root, "native")):
+            findings.extend(check_ffi(repo_root))
+        else:
+            print(
+                f"note: {repo_root}/native not found; FFI cross-check skipped",
+                file=sys.stderr,
+            )
+
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(
+            f"\ngofrlint: {len(findings)} finding(s). Fix, or justify with "
+            "'# gofrlint: disable=<rule> -- <reason>' "
+            "(docs/static-analysis.md).",
+            file=sys.stderr,
+        )
+        return 1
+    print("gofrlint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,180 @@
+"""gofrlint core: findings, suppression comments, the rule runner.
+
+Suppression grammar (fix-or-justify — a reason is mandatory):
+
+    x = risky()  # gofrlint: disable=blocking-call -- probe thread, bounded
+
+A standalone suppression comment (nothing but the comment on its line)
+applies to the next source line instead, so multi-line statements can be
+annotated above their first line. ``disable=a,b`` suppresses several
+rules at once. A suppression with no ``-- reason`` (or an empty reason)
+is itself reported as a ``bad-suppression`` finding and suppresses
+nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*gofrlint:\s*disable=(?P<rules>[\w\-,]+)(?:\s*--\s*(?P<reason>.*))?\s*$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def parse_suppressions(
+    source: str, path: str
+) -> tuple[dict[int, set[str]], list[Finding]]:
+    """Return ``{line: {rules}}`` plus findings for malformed suppressions."""
+    suppressed: dict[int, set[str]] = {}
+    bad: list[Finding] = []
+    src_lines = source.splitlines()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        comments = [
+            (t.start[0], t.start[1], t.string)
+            for t in tokens
+            if t.type == tokenize.COMMENT
+        ]
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return {}, []
+    for line, col, text in comments:
+        m = _SUPPRESS_RE.search(text)
+        if m is None:
+            if "gofrlint:" in text and "disable" in text:
+                bad.append(
+                    Finding(
+                        "bad-suppression", path, line,
+                        "unparseable gofrlint suppression comment",
+                    )
+                )
+            continue
+        reason = (m.group("reason") or "").strip()
+        if not reason:
+            bad.append(
+                Finding(
+                    "bad-suppression", path, line,
+                    "suppression without a reason: use "
+                    "'# gofrlint: disable=<rule> -- <why this is safe>'",
+                )
+            )
+            continue
+        rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+        suppressed.setdefault(line, set()).update(rules)
+        if not src_lines[line - 1][:col].strip():
+            # comment alone on its line: cover the next CODE line (skip
+            # continuation comment lines and blanks)
+            target = line + 1
+            while target <= len(src_lines) and (
+                not src_lines[target - 1].strip()
+                or src_lines[target - 1].lstrip().startswith("#")
+            ):
+                target += 1
+            suppressed.setdefault(target, set()).update(rules)
+    return suppressed, bad
+
+
+class SourceFile:
+    """A parsed Python file handed to every rule."""
+
+    def __init__(self, path: str, rel_path: str, source: str) -> None:
+        self.path = path
+        self.rel_path = rel_path  # slash-normalized, relative to the walk root
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.suppressions, self.bad_suppressions = parse_suppressions(source, rel_path)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        return rule in self.suppressions.get(line, ())
+
+
+def _package_rel(path: str, fallback: str) -> str:
+    """rel_path anchored at the innermost ``gofr_tpu`` package component,
+    so zone tables keyed like ``gofr_tpu/serving/engine.py`` match no
+    matter whether the CLI got the package root, a subdirectory, or a
+    single file. Paths outside any ``gofr_tpu`` tree keep ``fallback``."""
+    parts = os.path.abspath(path).replace(os.sep, "/").split("/")
+    for i in range(len(parts) - 1, -1, -1):
+        if parts[i] == "gofr_tpu":
+            return "/".join(parts[i:])
+    return fallback
+
+
+def iter_python_files(paths: list[str]) -> list[tuple[str, str]]:
+    """Expand files/directories into (abs_path, rel_path) pairs."""
+    out: list[tuple[str, str]] = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            out.append((p, _package_rel(p, os.path.basename(p))))
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in ("__pycache__", "_build"))
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    full = os.path.join(root, f)
+                    rel = os.path.relpath(full, os.path.dirname(p))
+                    out.append((full, _package_rel(full, rel.replace(os.sep, "/"))))
+    return out
+
+
+class Rule:
+    """A lint rule. ``visit_file`` yields per-file findings;
+    ``finalize`` yields whole-project findings (cross-file state)."""
+
+    name = ""
+
+    def visit_file(self, sf: SourceFile) -> list[Finding]:
+        return []
+
+    def finalize(self) -> list[Finding]:
+        return []
+
+
+def run_rules(paths: list[str], rules: list[Rule]) -> list[Finding]:
+    """Run rules over every Python file under ``paths``, honoring
+    suppressions. Findings from ``finalize`` are matched against the
+    suppression table of the file they landed in. Cross-file rules only
+    finalize when at least one *directory* was walked — on a file subset
+    they would see uses without their (elsewhere) registrations."""
+    full_tree = any(os.path.isdir(p) for p in paths)
+    findings: list[Finding] = []
+    tables: dict[str, dict[int, set[str]]] = {}
+    for full, rel in iter_python_files(paths):
+        with open(full, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            sf = SourceFile(full, rel, source)
+        except SyntaxError as exc:
+            findings.append(Finding("syntax-error", rel, exc.lineno or 0, str(exc.msg)))
+            continue
+        tables[rel] = sf.suppressions
+        findings.extend(sf.bad_suppressions)
+        for rule in rules:
+            for finding in rule.visit_file(sf):
+                if not sf.is_suppressed(finding.rule, finding.line):
+                    findings.append(finding)
+    if full_tree:
+        for rule in rules:
+            for finding in rule.finalize():
+                if finding.rule not in tables.get(finding.path, {}).get(
+                    finding.line, ()
+                ):
+                    findings.append(finding)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

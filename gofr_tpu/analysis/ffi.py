@@ -1,0 +1,240 @@
+"""FFI signature cross-checker: ``extern "C"`` (native/) vs ctypes.
+
+A drift between a C symbol's signature and the ``argtypes``/``restype``
+declared in :mod:`gofr_tpu.native` is a memory-corruption bug the
+sanitizer tier only catches at runtime, on the code path that happens to
+execute. This check catches it at lint time, for every exported symbol:
+
+- every ``GOFR_API`` symbol in the three native TUs must have a ctypes
+  declaration with matching argument and return types;
+- every declared binding must still exist in C (no stale bindings);
+- ``GetPjrtApi`` (the stub plugin's only export) is consumed via
+  ``dlsym`` inside ``pjrt_dl.cc``, not ctypes, and is exempted.
+
+Both sides are normalized to canonical tokens (``i32``, ``i64``,
+``p_i32``, ``p_i64``, ``p_f32``, ``cstr``, ``ptr``) so the comparison is
+exact, not textual.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from gofr_tpu.analysis.core import Finding
+
+# C translation unit -> the declaring function in gofr_tpu/native/__init__.py
+C_UNITS: dict[str, str | None] = {
+    "native/runtime/gofr_runtime.cc": "_declare_runtime",
+    "native/pjrt/pjrt_dl.cc": "_declare_pjrt",
+    "native/pjrt/stub_plugin.cc": None,  # exports consumed via dlsym
+}
+
+DLSYM_ONLY = {"GetPjrtApi"}  # resolved by pjrt_dl.cc's dlsym, not ctypes
+
+_EXPORT_RE = re.compile(
+    r'(?:GOFR_API|extern\s+"C"\s+__attribute__\(\(visibility\("default"\)\)\))'
+    r"\s+(?P<ret>(?:const\s+)?\w+\s*\*?)\s*(?P<name>\w+)\s*\((?P<args>[^)]*)\)",
+    re.DOTALL,
+)
+
+_CTYPE_SCALARS = {
+    "int32_t": "i32",
+    "int64_t": "i64",
+    "float": "f32",
+    "void": "void",
+}
+_CTYPE_POINTERS = {
+    "char": "cstr",
+    "int32_t": "p_i32",
+    "int64_t": "p_i64",
+    "float": "p_f32",
+    "void": "ptr",
+}
+
+_PY_ATTR = {
+    "c_int32": "i32",
+    "c_int64": "i64",
+    "c_float": "f32",
+    "c_char_p": "cstr",
+    "c_void_p": "ptr",
+}
+
+
+def _canon_c_type(text: str) -> str:
+    t = text.replace("const", " ").strip()
+    is_ptr = t.endswith("*")
+    base = t.rstrip("*").strip()
+    if is_ptr:
+        return _CTYPE_POINTERS.get(base, "ptr")  # struct pointers -> opaque
+    return _CTYPE_SCALARS.get(base, f"?{base}")
+
+
+def _split_c_args(args: str) -> list[str]:
+    args = re.sub(r"\s+", " ", args).strip()
+    if not args or args == "void":
+        return []
+    out = []
+    for piece in args.split(","):
+        piece = piece.strip()
+        # drop the parameter name: the type is everything up to the last
+        # identifier ("const char* path" / "int64_t* out4")
+        m = re.match(r"^(?P<type>.*?[\w*])\s+\w+$", piece)
+        out.append(_canon_c_type(m.group("type") if m else piece))
+    return out
+
+
+def parse_c_exports(path: str) -> dict[str, tuple[str, list[str], int]]:
+    """``{symbol: (restype, [argtypes], line)}`` for one C file."""
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    # strip line comments so commented-out exports don't register
+    stripped = re.sub(r"//[^\n]*", "", source)
+    exports: dict[str, tuple[str, list[str], int]] = {}
+    for m in _EXPORT_RE.finditer(stripped):
+        line = stripped.count("\n", 0, m.start()) + 1
+        exports[m.group("name")] = (
+            _canon_c_type(m.group("ret")),
+            _split_c_args(m.group("args")),
+            line,
+        )
+    return exports
+
+
+def _canon_py_expr(node: ast.expr, aliases: dict[str, str]) -> str:
+    if isinstance(node, ast.Name):
+        return aliases.get(node.id, f"?{node.id}")
+    if isinstance(node, ast.Attribute):  # ctypes.c_int32
+        return _PY_ATTR.get(node.attr, f"?{node.attr}")
+    if isinstance(node, ast.Call):  # ctypes.POINTER(ctypes.c_int32)
+        fname = (
+            node.func.attr if isinstance(node.func, ast.Attribute)
+            else node.func.id if isinstance(node.func, ast.Name) else ""
+        )
+        if fname == "POINTER" and node.args:
+            inner = _canon_py_expr(node.args[0], aliases)
+            return {"i32": "p_i32", "i64": "p_i64", "f32": "p_f32"}.get(
+                inner, f"p_?{inner}"
+            )
+    return "?expr"
+
+
+def parse_py_declarations(
+    native_init: str, declare_fn: str
+) -> dict[str, tuple[str, list[str], int]]:
+    """``{symbol: (restype, [argtypes], line)}`` from a ``_declare_*``
+    function's ``sig = {...}`` table in gofr_tpu/native/__init__.py."""
+    with open(native_init, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=native_init)
+    fn = next(
+        (
+            n for n in ast.walk(tree)
+            if isinstance(n, ast.FunctionDef) and n.name == declare_fn
+        ),
+        None,
+    )
+    if fn is None:
+        return {}
+    aliases: dict[str, str] = {}
+    sig_dict: ast.Dict | None = None
+    for stmt in fn.body:
+        if not isinstance(stmt, ast.Assign):
+            continue
+        targets, values = stmt.targets, [stmt.value]
+        if (
+            len(targets) == 1
+            and isinstance(targets[0], ast.Tuple)
+            and isinstance(stmt.value, ast.Tuple)
+        ):
+            targets = list(targets[0].elts)  # i32, i64 = ..., ...
+            values = list(stmt.value.elts)
+        for tgt, val in zip(targets, values):
+            if isinstance(tgt, ast.Name):
+                if tgt.id == "sig" and isinstance(val, ast.Dict):
+                    sig_dict = val
+                else:
+                    aliases[tgt.id] = _canon_py_expr(val, aliases)
+    if sig_dict is None:
+        return {}
+    out: dict[str, tuple[str, list[str], int]] = {}
+    for key, value in zip(sig_dict.keys, sig_dict.values):
+        if not (isinstance(key, ast.Constant) and isinstance(key.value, str)):
+            continue
+        if not (isinstance(value, ast.Tuple) and len(value.elts) == 2):
+            continue
+        res_expr, args_expr = value.elts
+        args = (
+            [_canon_py_expr(a, aliases) for a in args_expr.elts]
+            if isinstance(args_expr, ast.List)
+            else []
+        )
+        out[key.value] = (_canon_py_expr(res_expr, aliases), args, key.lineno)
+    return out
+
+
+def check_ffi(repo_root: str) -> list[Finding]:
+    """Cross-check every native TU against the ctypes declarations."""
+    findings: list[Finding] = []
+    native_init = os.path.join(repo_root, "gofr_tpu", "native", "__init__.py")
+    init_rel = "gofr_tpu/native/__init__.py"
+    if not os.path.exists(native_init):
+        return [Finding("ffi-layout", init_rel, 0, "ctypes loader not found")]
+    for c_rel, declare_fn in C_UNITS.items():
+        c_path = os.path.join(repo_root, c_rel)
+        if not os.path.exists(c_path):
+            findings.append(
+                Finding("ffi-layout", c_rel, 0, "native source file missing")
+            )
+            continue
+        c_syms = parse_c_exports(c_path)
+        py_syms = (
+            parse_py_declarations(native_init, declare_fn) if declare_fn else {}
+        )
+        for name, (c_res, c_args, c_line) in sorted(c_syms.items()):
+            if name in DLSYM_ONLY:
+                continue
+            if declare_fn is None:
+                findings.append(
+                    Finding(
+                        "ffi-unbound", c_rel, c_line,
+                        f"{name}: exported from a TU with no ctypes "
+                        "declaration table",
+                    )
+                )
+                continue
+            if name not in py_syms:
+                findings.append(
+                    Finding(
+                        "ffi-unbound", c_rel, c_line,
+                        f"{name}: exported but not declared in "
+                        f"{declare_fn} — callers get default int restype "
+                        "and unchecked args",
+                    )
+                )
+                continue
+            py_res, py_args, py_line = py_syms[name]
+            if py_res != c_res:
+                findings.append(
+                    Finding(
+                        "ffi-mismatch", init_rel, py_line,
+                        f"{name}: restype {py_res} != C {c_res} ({c_rel})",
+                    )
+                )
+            if py_args != c_args:
+                findings.append(
+                    Finding(
+                        "ffi-mismatch", init_rel, py_line,
+                        f"{name}: argtypes {py_args} != C {c_args} ({c_rel})",
+                    )
+                )
+        for name, (_, _, py_line) in sorted(py_syms.items()):
+            if name not in c_syms:
+                findings.append(
+                    Finding(
+                        "ffi-stale", init_rel, py_line,
+                        f"{name}: declared in {declare_fn} but not exported "
+                        f"by {c_rel} — getattr will raise at load time",
+                    )
+                )
+    return findings

@@ -119,6 +119,10 @@ class Container:
             "app_tpot_seconds", "Time per output token",
             buckets=(0.001, 0.0025, 0.005, 0.0075, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5),
         )
+        m.new_gauge(
+            "app_spec_accept_rate",
+            "Speculative-decode draft acceptance rate over drafted tokens",
+        )
 
     # -- accessors mirroring the reference's API ------------------------------
     @property

@@ -31,15 +31,23 @@ import struct
 import threading
 from typing import Any, Callable
 
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+try:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+    _HAS_CRYPTO = True
+except ImportError:  # wire primitives (RFC 4251 types, banner) stay usable
+    Ed25519PrivateKey = Ed25519PublicKey = None  # type: ignore[assignment]
+    X25519PrivateKey = X25519PublicKey = None  # type: ignore[assignment]
+    Cipher = algorithms = modes = None  # type: ignore[assignment]
+    _HAS_CRYPTO = False
 
 VERSION_STRING = "SSH-2.0-gofrtpu_0.1"
 
@@ -160,6 +168,11 @@ class SSHTransport:
 
     def __init__(self, sock: socket.socket, server_side: bool = False,
                  host_key: Ed25519PrivateKey | None = None) -> None:
+        if not _HAS_CRYPTO:
+            raise RuntimeError(
+                "SSH transport needs the cryptography package "
+                "(curve25519/ed25519/AES primitives)"
+            )
         self.sock = sock
         self.server_side = server_side
         self.host_key = host_key  # server role

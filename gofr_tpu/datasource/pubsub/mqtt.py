@@ -166,6 +166,7 @@ class MQTTClient:
         self._reader: threading.Thread | None = None
         self._pinger: threading.Thread | None = None
         self._closed = False
+        self._stop_ev = threading.Event()  # interrupts reconnect/ping waits
         self._connected = False
         # PINGREQ/PINGRESP bookkeeping for the close() flush barrier: the
         # broker answers pings in order, so resp-count catching up to
@@ -291,13 +292,15 @@ class MQTTClient:
                 return
             except (OSError, MQTTError) as exc:
                 self._last_error = str(exc)
-                time.sleep(backoff)
+                if self._stop_ev.wait(backoff):
+                    return  # close() interrupted the backoff
                 backoff = min(backoff * 2, 5.0)
 
     def _ping_loop(self, sock: socket.socket) -> None:
         interval = max(self.keepalive / 2, 1)
         while not self._closed and self._sock is sock:
-            time.sleep(interval)
+            if self._stop_ev.wait(interval):
+                return  # close() interrupted the keepalive wait
             if self._closed or self._sock is not sock:
                 return  # superseded by a reconnect
             try:
@@ -398,6 +401,7 @@ class MQTTClient:
             except (MQTTError, OSError):
                 pass
         self._closed = True
+        self._stop_ev.set()
         self._connected = False
         sock, self._sock = self._sock, None
         if sock is not None:

@@ -52,6 +52,7 @@ class RedisClient:
         timeout: float = 5.0,
     ) -> None:
         self.host, self.port = host, port
+        self._address = f"{host}:{port}"  # one bounded label value per client
         self.username, self.password, self.db = username, password, db
         self.use_tls = use_tls
         self.timeout = timeout
@@ -155,7 +156,7 @@ class RedisClient:
         if self._metrics:
             self._metrics.record_histogram(
                 "app_redis_stats", duration_us / 1000.0,
-                hostname=f"{self.host}:{self.port}", type=str(parts[0]).lower(),
+                hostname=self._address, type=str(parts[0]).lower(),
             )
         return reply
 

@@ -46,6 +46,7 @@ class ConnectionPool:
         self._open = 0  # idle + in-use
         self._cond = threading.Condition()
         self._closed = False
+        self._stop_ev = threading.Event()  # interrupts the ping-loop wait
         self._ping_thread: threading.Thread | None = None
 
     # observability hooks are wired after construction by the provider
@@ -137,7 +138,8 @@ class ConnectionPool:
 
     def _ping_loop(self) -> None:
         while not self._closed:
-            time.sleep(self.ping_interval)
+            if self._stop_ev.wait(self.ping_interval):
+                return  # close_all() interrupted the wait
             if self._closed:
                 return
             self._ping_once()
@@ -197,6 +199,7 @@ class ConnectionPool:
                                 dialect=self.dialect)
 
     def close_all(self) -> None:
+        self._stop_ev.set()
         with self._cond:
             self._closed = True
             idle, self._idle = self._idle, []

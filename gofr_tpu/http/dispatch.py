@@ -8,6 +8,7 @@ through the Responder.
 
 from __future__ import annotations
 
+import asyncio
 import mimetypes
 import os
 from typing import Any
@@ -31,7 +32,7 @@ class Dispatcher:
         if match is None:
             static = self.router.static_lookup(req.path)
             if static is not None:
-                return self._serve_static(static)
+                return await self._serve_static(static)
             if req.method == "HEAD":
                 match_get = self.router.lookup("GET", req.path)
                 if match_get is not None:
@@ -55,14 +56,18 @@ class Dispatcher:
             return result.data
         return self.responder.respond(result.data, result.error, req.method)
 
-    def _serve_static(self, static: tuple[str, str]) -> WireResponse:
+    async def _serve_static(self, static: tuple[str, str]) -> WireResponse:
         path, disposition = static
         if disposition == "forbidden":
             return WireResponse(status=403, body=b"403 forbidden")
         ctype = mimetypes.guess_type(path)[0] or "application/octet-stream"
-        try:
+
+        def _read() -> bytes:  # sync file I/O runs off the event loop
             with open(path, "rb") as f:
-                content = f.read()
+                return f.read()
+
+        try:
+            content = await asyncio.get_running_loop().run_in_executor(None, _read)
         except OSError:
             return WireResponse(status=404, body=b"404 not found")
         status = 200 if disposition == "ok" else 404

@@ -17,9 +17,15 @@ import time
 import urllib.request
 from typing import Any
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import padding, rsa
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+    _HAS_CRYPTO = True
+except ImportError:  # image without cryptography: HS256 (pure stdlib)
+    InvalidSignature = hashes = padding = rsa = None  # type: ignore[assignment]
+    _HAS_CRYPTO = False
 
 
 class JWTError(Exception):
@@ -77,6 +83,8 @@ def decode(
         if not hmac.compare_digest(expected, signature):
             raise JWTError("signature verification failed")
     elif alg == "RS256":
+        if not _HAS_CRYPTO:
+            raise JWTError("RS256 token but the cryptography package is unavailable")
         if not rsa_keys:
             raise JWTError("RS256 token but no JWKS configured")
         kid = header.get("kid")
@@ -110,6 +118,8 @@ def decode(
 
 
 def jwk_to_rsa_key(jwk: dict) -> rsa.RSAPublicKey:
+    if not _HAS_CRYPTO:
+        raise JWTError("JWKS keys need the cryptography package")
     n = int.from_bytes(_b64url_decode(jwk["n"]), "big")
     e = int.from_bytes(_b64url_decode(jwk["e"]), "big")
     return rsa.RSAPublicNumbers(e, n).public_key()

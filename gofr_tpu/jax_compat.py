@@ -1,0 +1,62 @@
+"""Compatibility shims across the jax versions the deployment images span.
+
+The code targets the modern ``jax.shard_map`` API. Older images (< 0.5)
+only ship ``jax.experimental.shard_map.shard_map`` with the pre-rename
+keywords, so this module maps the new surface onto it:
+
+- ``axis_names={...}`` (the MANUAL axes) becomes ``auto = mesh axes -
+  axis_names`` (everything not manual);
+- ``check_vma=`` is the renamed ``check_rep=``.
+
+Import ``shard_map`` from here instead of ``jax`` anywhere the code must
+run on both families.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f: Any = None, **kw: Any) -> Any:
+        axis_names = kw.pop("axis_names", None)
+        if axis_names is not None:
+            mesh = kw.get("mesh")
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+            if auto:
+                kw["auto"] = auto
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        if f is None:  # decorator form: jax.shard_map(mesh=..., ...)(fn)
+            return lambda g: _experimental_shard_map(g, **kw)
+        return _experimental_shard_map(f, **kw)
+
+
+if hasattr(jax.lax, "pcast"):
+    pcast = jax.lax.pcast
+else:
+    def pcast(x: Any, axes: Any, *, to: str | None = None) -> Any:
+        """Identity on jax < 0.8: the old shard_map has no
+        varying/replicated aval typing, so there is nothing to cast."""
+        return x
+
+
+try:
+    from jax.experimental.pallas import tpu as _pltpu
+except ImportError:  # image without pallas
+    _pltpu = None
+
+# jax renamed pltpu.TPUCompilerParams -> pltpu.CompilerParams
+if _pltpu is None:
+    PallasTPUCompilerParams = None
+elif hasattr(_pltpu, "CompilerParams"):
+    PallasTPUCompilerParams = _pltpu.CompilerParams
+else:
+    PallasTPUCompilerParams = _pltpu.TPUCompilerParams
+
+__all__ = ["PallasTPUCompilerParams", "pcast", "shard_map"]

@@ -99,7 +99,9 @@ class PjrtExecutable:
     def destroy(self) -> None:
         if not self._destroyed:
             self._destroyed = True
-            self._client._lib.gofr_pjrt_executable_destroy(self._h)
+            lib = self._client._lib
+            _check(lib, lib.gofr_pjrt_executable_destroy(self._h),
+                   "executable destroy")
 
 
 class PjrtClient:
@@ -146,7 +148,8 @@ class PjrtClient:
     def close(self) -> None:
         if not self._closed:
             self._closed = True
-            self._lib.gofr_pjrt_client_destroy(self._h)
+            _check(self._lib, self._lib.gofr_pjrt_client_destroy(self._h),
+                   "client destroy")
 
 
 class PjrtPlugin:

@@ -134,9 +134,11 @@ class BlockAllocator:
                     "free_blocks": 0, "total_blocks": self.num_blocks,
                     "sequences": 0, "alloc_failures": 0,
                 }
-            if self._lib is not None:
-                self._lib.gofr_ba_destroy(self._h)
+            # flag first: a destroy failure must not leave the object
+            # half-open for __del__ to re-destroy the same native handle
             self._closed = True
+            if self._lib is not None:
+                _check(self._lib.gofr_ba_destroy(self._h), "ba_destroy")
 
     def __del__(self) -> None:  # best-effort; explicit close preferred
         try:
@@ -238,9 +240,9 @@ class Scheduler:
                     "queue_depth": 0, "busy_slots": 0, "max_slots": self.max_slots,
                     "total_admitted": 0, "total_canceled": 0,
                 }
+            self._closed = True  # see BlockAllocator.close — no re-destroy
             if self._lib is not None:
-                self._lib.gofr_sched_destroy(self._h)
-            self._closed = True
+                _check(self._lib.gofr_sched_destroy(self._h), "sched_destroy")
 
     def __del__(self) -> None:
         try:

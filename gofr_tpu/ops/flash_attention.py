@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from gofr_tpu.jax_compat import PallasTPUCompilerParams
+
 NEG_INF = -1e30
 
 
@@ -192,7 +194,7 @@ def flash_attention(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(q_t.shape, q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=PallasTPUCompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from gofr_tpu.jax_compat import shard_map
+
 
 def router_topk(
     x: jnp.ndarray,  # [T, D]
@@ -200,7 +202,7 @@ def moe_ffn_ep(
         capacity=cap,
     )
     espec = P(axis)
-    out, f, p = jax.shard_map(
+    out, f, p = shard_map(
         fn,
         mesh=mesh,
         in_specs=(P(axis), P(), espec, espec, espec),

@@ -30,6 +30,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from gofr_tpu.jax_compat import PallasTPUCompilerParams
+
 NEG_INF = -1e30
 
 # int8 arrays tile as (32, 128) on TPU; a smaller page would violate the
@@ -215,7 +217,7 @@ def _paged_attention_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(q_t.shape, q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=PallasTPUCompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(

@@ -30,7 +30,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from gofr_tpu.ops.attention import NEG_INF, gqa_repeat
 
-_shard_map = jax.shard_map
+from gofr_tpu.jax_compat import shard_map as _shard_map
 
 
 def _block_accumulate(q, k, v, acc, m, l, q_start, k_start, scale):

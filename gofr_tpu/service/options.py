@@ -147,13 +147,23 @@ class Retry(_Wrapper):
         super().__init__(inner)
         self.max_retries = max_retries
         self.backoff = backoff
+        self._stop = threading.Event()
+
+    def close(self) -> None:
+        """Interrupt any in-flight backoff wait, then close the inner
+        client — shutdown must not ride out a retry ladder."""
+        self._stop.set()
+        inner_close = getattr(self._inner, "close", None)
+        if inner_close is not None:
+            inner_close()
 
     def request(self, method: str, path: str, **kw: Any) -> ServiceResponse:
         last_exc: Exception | None = None
         last_resp: ServiceResponse | None = None
         for attempt in range(self.max_retries + 1):
             if attempt and self.backoff:
-                time.sleep(self.backoff * attempt)
+                if self._stop.wait(self.backoff * attempt):
+                    break  # closing: return what we already have
             try:
                 resp = self._inner.request(method, path, **kw)
             except CircuitBreakerError:

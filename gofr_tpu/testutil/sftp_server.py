@@ -19,7 +19,10 @@ import struct
 import threading
 from typing import Any
 
-from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+try:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+except ImportError:  # guarded in MiniSFTPServer.__init__
+    Ed25519PrivateKey = None  # type: ignore[assignment]
 
 from gofr_tpu.datasource.file import sftp as fx
 from gofr_tpu.datasource.file.ssh_transport import (
@@ -35,6 +38,11 @@ from gofr_tpu.datasource.file.ssh_transport import (
 class MiniSFTPServer:
     def __init__(self, root: str, port: int = 0, user: str = "gofr",
                  password: str = "secret") -> None:
+        if Ed25519PrivateKey is None:
+            raise RuntimeError(
+                "MiniSFTPServer needs the cryptography package "
+                "(ed25519 host key)"
+            )
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
         self.user, self.password = user, password

@@ -23,7 +23,16 @@ import pytest  # noqa: E402
 
 if os.environ.get("GOFR_TEST_TPU") != "1":
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # older jax: the option predates jax_num_cpu_devices; the XLA flag
+        # does the same thing as long as no backend has initialized yet
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
 
 # Exact f32 matmuls in tests: the platform default uses fast bf16 passes,
 # which makes sliced-vs-full einsums differ by ~1e-2 and breaks
@@ -40,3 +49,36 @@ def run_async():
         return asyncio.run(coro)
 
     return runner
+
+
+# shared environment-capability skips (import from conftest, keep one copy)
+import importlib.util  # noqa: E402
+
+requires_websockets = pytest.mark.skipif(
+    importlib.util.find_spec("websockets") is None,
+    reason="needs the websockets client library",
+)
+requires_modern_shard_map = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="needs the modern jax.shard_map / SPMD partitioner (jax>=0.5)",
+)
+
+
+# -- lock-order tier (docs/static-analysis.md) --------------------------------
+# GOFR_LOCK_ORDER=1 (set by `make lock-order`) instruments every
+# threading.Lock/RLock created during the session and fails the run on any
+# lock-order cycle — Python-side deadlock detection complementing the
+# C++-only `make native-tsan` tier.
+@pytest.fixture(autouse=True, scope="session")
+def _lock_order_tier():
+    if os.environ.get("GOFR_LOCK_ORDER") != "1":
+        yield
+        return
+    from gofr_tpu.analysis import lockorder
+
+    mon = lockorder.install()
+    try:
+        yield
+    finally:
+        lockorder.uninstall()
+    mon.check()  # raises LockOrderError on any cycle

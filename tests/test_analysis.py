@@ -1,0 +1,409 @@
+"""gofrlint (gofr_tpu/analysis/): rule fixtures, suppression mechanics,
+the FFI cross-checker against mutated C signatures, and the lock-order
+monitor. docs/static-analysis.md describes the tiers these enforce."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+
+import pytest
+
+from gofr_tpu.analysis import lockorder
+from gofr_tpu.analysis.core import run_rules
+from gofr_tpu.analysis.ffi import check_ffi
+from gofr_tpu.analysis.rules import default_rules
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_tree(tmp_path, files: dict[str, str]):
+    """Materialize {relpath: source} under tmp_path and lint the top dir."""
+    for rel, source in files.items():
+        full = tmp_path / rel
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_text(source)
+    top = tmp_path / sorted(files)[0].split("/")[0]
+    return run_rules([str(top)], default_rules())
+
+
+# ---------------------------------------------------------------- blocking
+def test_blocking_call_positive(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/http/dispatch.py": (
+            "import time\n\ndef handle():\n    time.sleep(1)\n"
+        ),
+    })
+    assert [f.rule for f in findings] == ["blocking-call"]
+    assert findings[0].line == 4
+
+
+def test_blocking_call_clean_pass(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/http/dispatch.py": "def handle():\n    return 1\n",
+    })
+    assert findings == []
+
+
+def test_blocking_call_suppression_honored(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/http/dispatch.py": (
+            "import time\n\ndef handle():\n"
+            "    time.sleep(1)  # gofrlint: disable=blocking-call -- test fixture\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_standalone_suppression_covers_next_code_line(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/http/dispatch.py": (
+            "import time\n\ndef handle():\n"
+            "    # gofrlint: disable=blocking-call -- reason spanning the\n"
+            "    # next comment line too\n"
+            "    time.sleep(1)\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/http/dispatch.py": (
+            "import time\n\ndef handle():\n"
+            "    time.sleep(1)  # gofrlint: disable=blocking-call\n"
+        ),
+    })
+    rules = sorted(f.rule for f in findings)
+    assert rules == ["bad-suppression", "blocking-call"]  # suppresses nothing
+
+
+def test_closures_are_exempt(tmp_path):
+    # deferred work (thread targets, run_in_executor payloads) is exactly
+    # how blocking calls are SUPPOSED to leave the hot path
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/http/dispatch.py": (
+            "import time\n\ndef handle():\n"
+            "    def worker():\n        time.sleep(1)\n"
+            "    return worker\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_backoff_zone_flags_only_sleep(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/service/options.py": (
+            "import time, urllib.request\n\ndef retry():\n"
+            "    urllib.request.urlopen('http://x')\n    time.sleep(2)\n"
+        ),
+    })
+    assert [f.rule for f in findings] == ["blocking-call"]
+    assert "time.sleep" in findings[0].message
+
+
+# ---------------------------------------------------------------- host-sync
+def test_host_sync_positive_and_clean(tmp_path):
+    findings = lint_tree(tmp_path / "hit", {
+        "gofr_tpu/serving/batch.py": (
+            "import numpy as np\n\ndef decode_step(x):\n"
+            "    return np.asarray(x)\n"
+        ),
+    })
+    assert [f.rule for f in findings] == ["host-sync"]
+    findings = lint_tree(tmp_path / "clean", {
+        "gofr_tpu/serving/batch2.py": (  # not a hot-zone file
+            "import numpy as np\n\ndef decode_step(x):\n"
+            "    return np.asarray(x)\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_host_sync_block_until_ready_method(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/serving/batch.py": (
+            "def decode_step(x):\n    return x.block_until_ready()\n"
+        ),
+    })
+    assert [f.rule for f in findings] == ["host-sync"]
+
+
+# ---------------------------------------------------------------- ctypes
+def test_ctypes_unchecked_positive(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/native/binding.py": (
+            "def close(lib, h):\n    lib.gofr_thing_destroy(h)\n"
+        ),
+    })
+    assert [f.rule for f in findings] == ["ctypes-unchecked"]
+
+
+def test_ctypes_checked_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/native/binding.py": (
+            "def _check(c):\n    assert c >= 0\n\n"
+            "def close(lib, h):\n    _check(lib.gofr_thing_destroy(h))\n"
+        ),
+    })
+    assert findings == []
+
+
+# ---------------------------------------------------------------- metrics
+def test_metric_unregistered_cross_file(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/a.py": 'def reg(m):\n    m.new_counter("app_good", "d")\n',
+        "gofr_tpu/b.py": (
+            "def use(m):\n"
+            '    m.increment_counter("app_good")\n'
+            '    m.increment_counter("app_typoed")\n'
+        ),
+    })
+    assert [f.rule for f in findings] == ["metric-unregistered"]
+    assert "app_typoed" in findings[0].message
+
+
+def test_metric_label_cardinality(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/a.py": (
+            'def use(m, rid):\n'
+            '    m.new_histogram("app_h", "d")\n'
+            '    m.record_histogram("app_h", 1.0, request=f"id-{rid}")\n'
+        ),
+    })
+    assert [f.rule for f in findings] == ["metric-label-cardinality"]
+
+
+def test_metric_dynamic_name(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/a.py": (
+            'def use(m, n):\n    m.increment_counter(f"app_{n}")\n'
+        ),
+    })
+    assert [f.rule for f in findings] == ["metric-dynamic-name"]
+
+
+# ---------------------------------------------------------------- FFI
+def _copy_ffi_fixture(tmp_path) -> str:
+    root = tmp_path / "repo"
+    for rel in ("native/runtime", "native/pjrt", "gofr_tpu/native"):
+        (root / rel).mkdir(parents=True)
+    for rel in (
+        "native/runtime/gofr_runtime.cc",
+        "native/pjrt/pjrt_dl.cc",
+        "native/pjrt/stub_plugin.cc",
+        "gofr_tpu/native/__init__.py",
+    ):
+        shutil.copy(os.path.join(REPO_ROOT, rel), root / rel)
+    return str(root)
+
+
+def test_ffi_clean_on_pristine_copy(tmp_path):
+    assert check_ffi(_copy_ffi_fixture(tmp_path)) == []
+
+
+def test_ffi_detects_mutated_c_signature(tmp_path):
+    root = _copy_ffi_fixture(tmp_path)
+    cc = os.path.join(root, "native/runtime/gofr_runtime.cc")
+    with open(cc) as f:
+        src = f.read()
+    mutated = src.replace(
+        "int32_t gofr_ba_alloc(int64_t h, int64_t seq_id, int64_t tokens)",
+        "int32_t gofr_ba_alloc(int64_t h, int32_t seq_id, int64_t tokens)",
+    )
+    assert mutated != src, "fixture drifted: gofr_ba_alloc signature not found"
+    with open(cc, "w") as f:
+        f.write(mutated)
+    findings = check_ffi(root)
+    assert [f.rule for f in findings] == ["ffi-mismatch"]
+    assert "gofr_ba_alloc" in findings[0].message
+
+
+def test_ffi_detects_unbound_export(tmp_path):
+    root = _copy_ffi_fixture(tmp_path)
+    cc = os.path.join(root, "native/runtime/gofr_runtime.cc")
+    with open(cc, "a") as f:
+        f.write("\nGOFR_API int32_t gofr_ba_new_export(int64_t h) { return 0; }\n")
+    findings = check_ffi(root)
+    assert [f.rule for f in findings] == ["ffi-unbound"]
+    assert "gofr_ba_new_export" in findings[0].message
+
+
+def test_ffi_detects_stale_binding(tmp_path):
+    root = _copy_ffi_fixture(tmp_path)
+    cc = os.path.join(root, "native/runtime/gofr_runtime.cc")
+    with open(cc) as f:
+        src = f.read()
+    # comment out one export: the Python declaration goes stale
+    mutated = src.replace(
+        "GOFR_API const char* gofr_runtime_version()",
+        "static const char* gofr_runtime_version_hidden()",
+    )
+    assert mutated != src
+    with open(cc, "w") as f:
+        f.write(mutated)
+    findings = check_ffi(root)
+    assert [f.rule for f in findings] == ["ffi-stale"]
+
+
+# ---------------------------------------------------------------- real tree
+def test_real_tree_is_clean():
+    """The acceptance bar: gofrlint exits 0 on the repo itself."""
+    findings = run_rules([os.path.join(REPO_ROOT, "gofr_tpu")], default_rules())
+    findings += check_ffi(REPO_ROOT)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_cli_exit_codes(tmp_path):
+    from gofr_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "gofr_tpu" / "http"
+    bad.mkdir(parents=True)
+    (bad / "dispatch.py").write_text(
+        "import time\n\ndef handle():\n    time.sleep(1)\n"
+    )
+    assert main([str(tmp_path / "gofr_tpu"), "--no-ffi"]) == 1
+    (bad / "dispatch.py").write_text("def handle():\n    return 1\n")
+    assert main([str(tmp_path / "gofr_tpu"), "--no-ffi"]) == 0
+    assert main(["--ffi-only", "--repo-root", REPO_ROOT]) == 0
+
+
+# ---------------------------------------------------------------- lock order
+@pytest.mark.lockorder
+def test_lock_order_cycle_detected():
+    # private monitor: synthetic cycles must not touch the global
+    # factories (a session-tier monitor would record them as real)
+    mon = lockorder.LockOrderMonitor()
+    a, b = mon.make_lock(), mon.make_lock()
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # AB/BA inversion
+            pass
+    assert mon.cycles()
+    with pytest.raises(lockorder.LockOrderError):
+        mon.check()
+
+
+@pytest.mark.lockorder
+def test_lock_order_consistent_is_clean():
+    mon = lockorder.LockOrderMonitor()
+    a, b, c = mon.make_lock(), mon.make_lock(), mon.make_lock()
+    for _ in range(3):
+        with a:
+            with b:
+                with c:
+                    pass
+    assert mon.cycles() == []
+    mon.check()
+
+
+@pytest.mark.lockorder
+def test_lock_order_cross_thread_edges():
+    """The monitor aggregates edges across threads — that is the point:
+    thread 1 taking A->B while thread 2 takes B->A is the deadlock."""
+    mon = lockorder.LockOrderMonitor()
+    a, b = mon.make_lock(), mon.make_lock()
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    th1 = threading.Thread(target=t1)
+    th1.start()
+    th1.join()
+    th2 = threading.Thread(target=t2)
+    th2.start()
+    th2.join()
+    assert mon.cycles()
+
+
+@pytest.mark.lockorder
+def test_rlock_reentrancy_no_self_cycle():
+    mon = lockorder.LockOrderMonitor()
+    r = mon.make_rlock()
+    with r:
+        with r:  # reentrant acquire must not record a self-edge
+            pass
+    assert mon.cycles() == []
+
+
+@pytest.mark.lockorder
+@pytest.mark.skipif(os.environ.get("GOFR_LOCK_ORDER") == "1",
+                    reason="session lock-order tier already installed")
+def test_stdlib_primitives_survive_instrumentation():
+    """Event/Condition are built on Lock/RLock; the wrappers must keep
+    their protocols (incl. _release_save/_acquire_restore) intact."""
+    mon = lockorder.install()
+    try:
+        ev = threading.Event()
+        results = []
+
+        def setter():
+            ev.set()
+
+        t = threading.Thread(target=setter)
+        t.start()
+        assert ev.wait(timeout=5)
+        t.join()
+
+        cond = threading.Condition()
+
+        def producer():
+            with cond:
+                results.append(1)
+                cond.notify()
+
+        t2 = threading.Thread(target=producer)
+        with cond:
+            t2.start()
+            assert cond.wait_for(lambda: results, timeout=5)
+        t2.join()
+    finally:
+        lockorder.uninstall()
+    assert mon.locks_created >= 2
+    mon.check()
+
+
+@pytest.mark.lockorder
+@pytest.mark.skipif(os.environ.get("GOFR_LOCK_ORDER") == "1",
+                    reason="session lock-order tier already installed")
+def test_engine_locks_under_monitor():
+    """A slice of the real target: allocator + scheduler wrappers used
+    concurrently under instrumentation record a clean (acyclic) order."""
+    mon = lockorder.install()
+    try:
+        from gofr_tpu.native.runtime import BlockAllocator, Scheduler
+
+        ba = BlockAllocator(32, 4, force_python=True)
+        sched = Scheduler(4, 16, 1024, force_python=True)
+
+        def worker(wid: int) -> None:
+            for i in range(20):
+                sid = wid * 100 + i
+                ba.alloc(sid, 3)
+                ba.stats()
+                ba.free(sid)
+                sched.submit(sid, 8, 4)
+                sched.stats()
+                sched.cancel(sid)
+                sched.admit(4)
+
+        threads = [threading.Thread(target=worker, args=(w,)) for w in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        ba.close()
+        sched.close()
+    finally:
+        lockorder.uninstall()
+    mon.check()

