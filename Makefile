@@ -5,10 +5,20 @@
 PY ?= python
 ASAN_RT := $(shell g++ -print-file-name=libasan.so 2>/dev/null)
 
-.PHONY: check import-check lint lock-order test bench-smoke native native-asan
+.PHONY: check ci import-check lint lock-order test bench-smoke native native-asan
 
 check: import-check lint test native-asan bench-smoke
 	@echo "CHECK OK"
+
+# pre-merge gate (docs/static-analysis.md): gofrlint + shardcheck over the
+# tree, the analyzer's own fixture suites, then the full tier-1 pytest run.
+# The fixture suites DO run again inside tier-1; the explicit first pass is
+# a deliberate fail-fast — a broken analyzer surfaces in ~30 s, not after
+# the ~15 min full suite.
+ci: lint
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_analysis.py tests/test_shardcheck.py -q
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+	@echo "CI OK"
 
 # gofrlint (docs/static-analysis.md): framework-invariant AST lints over
 # the whole package + the extern-C vs ctypes FFI signature cross-check.
@@ -43,7 +53,8 @@ native-asan:
 	GOFR_NATIVE_EXTRA_CXXFLAGS="-fsanitize=address,undefined -fno-sanitize-recover=all -g -O1" \
 	GOFR_PJRT_INCLUDE_DIRS="$$($(PY) -c 'from gofr_tpu.native import pjrt_include_dirs; print(":".join(pjrt_include_dirs()))')" \
 	LD_PRELOAD=$(ASAN_RT) \
-	ASAN_OPTIONS=detect_leaks=0 \
+	ASAN_OPTIONS="detect_leaks=0 suppressions=native/asan.supp" \
+	UBSAN_OPTIONS="print_stacktrace=1" \
 	JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/test_native_runtime.py tests/test_native_pjrt.py -q -x
 

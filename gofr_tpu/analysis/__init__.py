@@ -12,6 +12,14 @@ C++ TUs already run under ASan/UBSan/TSan (``make native-asan`` /
   HTTP/gRPC dispatch or the engine decode loop, no host-device syncs in
   the serving hot path outside annotated sync points, registered and
   bounded-cardinality metrics, status-checked ctypes calls.
+- :mod:`gofr_tpu.analysis.shardcheck` — the SPMD rule family:
+  mesh/collective axis-name consistency (``mesh-axis-unknown``,
+  ``collective-unmapped``), donated-buffer discipline
+  (``use-after-donation``), and per-request recompile hazards in the
+  decode hot path (``retrace-hazard``).
+- :mod:`gofr_tpu.analysis.baseline_io` — ``--format json`` stable
+  finding ids and the ratchet baseline (pre-existing findings don't
+  block, new ones do; ``--update-baseline``).
 - :mod:`gofr_tpu.analysis.ffi` — cross-checks every ``extern "C"``
   symbol in ``native/`` against the ctypes ``argtypes``/``restype``
   declarations (drift here is a memory-corruption bug ASan only catches

@@ -1,7 +1,17 @@
 """``python -m gofr_tpu.analysis`` — run gofrlint over the tree.
 
-Exit status 0 when clean, 1 on any unsuppressed finding, 2 on usage
-error. ``make lint`` wires this into the ``make check`` gate.
+Exit status 0 when clean, 1 on any unsuppressed (and un-baselined)
+finding, 2 on usage error. ``make lint`` wires this into the
+``make check`` / ``make ci`` gates.
+
+Output formats: human text (default) or ``--format json`` — a stable
+object per finding (``id``, ``rule``, ``file``, ``line``, ``message``)
+for CI annotation and editor integration.
+
+Ratchet baseline: findings recorded in ``gofr_tpu/analysis/baseline.json``
+don't block; new ones do. ``--update-baseline`` re-records the current
+set (ratchet down only — justify before you run it), ``--no-baseline``
+shows everything.
 """
 
 from __future__ import annotations
@@ -10,6 +20,7 @@ import argparse
 import os
 import sys
 
+from gofr_tpu.analysis import baseline_io
 from gofr_tpu.analysis.core import run_rules
 from gofr_tpu.analysis.ffi import check_ffi
 from gofr_tpu.analysis.rules import default_rules
@@ -20,11 +31,36 @@ def _default_repo_root() -> str:
     return os.path.dirname(os.path.dirname(here))
 
 
+def _list_rules() -> None:
+    from gofr_tpu.analysis import rules as rules_mod
+    from gofr_tpu.analysis import shardcheck as sc
+
+    print("blocking-call        blocking primitives in dispatch/decode zones")
+    print("host-sync            host-device syncs in the decode hot path")
+    print("metric-unregistered  metric name used but never registered")
+    print("metric-dynamic-name  computed metric name at a call site")
+    print("metric-label-cardinality  unbounded metric label key/value")
+    print("ctypes-unchecked     native status code discarded")
+    print("ffi-mismatch/ffi-unbound/ffi-stale  extern-C vs ctypes drift")
+    print("mesh-axis-unknown    axis literal not declared by the mesh")
+    print("collective-unmapped  literal-axis collective outside shard_map/pmap")
+    print("use-after-donation   donated jit buffer read before rebinding")
+    print("retrace-hazard       per-request recompiles in the decode hot path")
+    print("bad-suppression      gofrlint suppression without a reason")
+    print()
+    print("dispatch zones:", ", ".join(sorted(rules_mod.DISPATCH_ZONES)))
+    print("backoff zones: ", ", ".join(sorted(rules_mod.BACKOFF_ZONES)))
+    print(
+        "retrace zones: ",
+        ", ".join(sorted(sc.RETRACE_ZONE_FILES + sc.RETRACE_ZONE_DIRS)),
+    )
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m gofr_tpu.analysis",
         description="gofrlint: framework-invariant static analysis + "
-        "FFI signature cross-checker",
+        "shardcheck SPMD rules + FFI signature cross-checker",
     )
     parser.add_argument(
         "paths", nargs="*",
@@ -42,28 +78,33 @@ def main(argv: list[str] | None = None) -> int:
         "--ffi-only", action="store_true", help="run only the FFI cross-check"
     )
     parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format (json: stable finding ids for CI/editors)",
+    )
+    parser.add_argument(
+        "--baseline", default=None, metavar="PATH",
+        help="ratchet baseline file (default: gofr_tpu/analysis/baseline.json)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the ratchet baseline: report every finding",
+    )
+    parser.add_argument(
+        "--update-baseline", action="store_true",
+        help="re-record the current findings as the ratchet floor and exit 0",
+    )
+    parser.add_argument(
         "--list-rules", action="store_true", help="list rules and exit"
     )
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        from gofr_tpu.analysis import rules as rules_mod
-
-        print("blocking-call        blocking primitives in dispatch/decode zones")
-        print("host-sync            host-device syncs in the decode hot path")
-        print("metric-unregistered  metric name used but never registered")
-        print("metric-dynamic-name  computed metric name at a call site")
-        print("metric-label-cardinality  unbounded metric label key/value")
-        print("ctypes-unchecked     native status code discarded")
-        print("ffi-mismatch/ffi-unbound/ffi-stale  extern-C vs ctypes drift")
-        print("bad-suppression      gofrlint suppression without a reason")
-        print()
-        print("dispatch zones:", ", ".join(sorted(rules_mod.DISPATCH_ZONES)))
-        print("backoff zones: ", ", ".join(sorted(rules_mod.BACKOFF_ZONES)))
+        _list_rules()
         return 0
 
     repo_root = args.repo_root or _default_repo_root()
     findings = []
+    paths: list[str] = []
     if not args.ffi_only:
         paths = args.paths or [os.path.join(repo_root, "gofr_tpu")]
         for p in paths:
@@ -71,17 +112,72 @@ def main(argv: list[str] | None = None) -> int:
                 print(f"error: no such path: {p}", file=sys.stderr)
                 return 2
         findings.extend(run_rules(paths, default_rules()))
+    ffi_ran = False
     if not args.no_ffi:
         if os.path.isdir(os.path.join(repo_root, "native")):
             findings.extend(check_ffi(repo_root))
+            ffi_ran = True
         else:
             print(
                 f"note: {repo_root}/native not found; FFI cross-check skipped",
                 file=sys.stderr,
             )
 
+    baseline_path = args.baseline or baseline_io.default_baseline_path()
+    if args.update_baseline:
+        # a partial run (explicit paths / --ffi-only / --no-ffi) must not
+        # erase baseline entries for files and rules it never looked at
+        preserved: dict[str, int] = {}
+        old = baseline_io.load_baseline(baseline_path)
+        if old:
+            from gofr_tpu.analysis.core import iter_python_files
+
+            linted = {rel for _, rel in iter_python_files(paths)}
+            # on a file-only subset run_rules skips finalize(), so
+            # cross-file rules produced no findings — their old entries
+            # were not re-observed and must be preserved, not erased
+            full_tree = any(os.path.isdir(p) for p in paths)
+            cross_file_rules = {
+                r.name for r in default_rules() if r.cross_file
+            }
+            ffi_rules = {"ffi-mismatch", "ffi-unbound", "ffi-stale"}
+            for key, count in old.items():
+                parts = key.split("|", 2)
+                if len(parts) != 3:
+                    continue  # malformed entry: drop (ratchet tightens)
+                rule, file, _ = parts
+                covered = (
+                    file in linted
+                    and (full_tree or rule not in cross_file_rules)
+                ) or (ffi_ran and rule in ffi_rules)
+                if not covered:
+                    preserved[key] = count
+        n = baseline_io.write_baseline(baseline_path, findings, preserved)
+        print(
+            f"gofrlint: baseline updated ({n} finding(s) recorded in "
+            f"{baseline_path})",
+            file=sys.stderr,
+        )
+        return 0
+
+    baselined = 0
+    if not args.no_baseline:
+        findings, baselined = baseline_io.apply_baseline(
+            findings, baseline_io.load_baseline(baseline_path)
+        )
+
+    if args.format == "json":
+        print(baseline_io.render_json(findings))
+        return 1 if findings else 0
+
     for f in findings:
         print(f.render())
+    if baselined:
+        print(
+            f"gofrlint: {baselined} pre-existing finding(s) covered by the "
+            f"baseline ({baseline_path})",
+            file=sys.stderr,
+        )
     if findings:
         print(
             f"\ngofrlint: {len(findings)} finding(s). Fix, or justify with "

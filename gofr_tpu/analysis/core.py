@@ -135,9 +135,13 @@ def iter_python_files(paths: list[str]) -> list[tuple[str, str]]:
 
 class Rule:
     """A lint rule. ``visit_file`` yields per-file findings;
-    ``finalize`` yields whole-project findings (cross-file state)."""
+    ``finalize`` yields whole-project findings (cross-file state).
+    ``cross_file`` marks rules whose findings (wholly or partly) come
+    from ``finalize`` — consumers like the baseline updater use it to
+    know which findings a partial run could NOT have re-observed."""
 
     name = ""
+    cross_file = False
 
     def visit_file(self, sf: SourceFile) -> list[Finding]:
         return []

@@ -245,6 +245,7 @@ class MetricsRule(Rule):
     finalize. Dynamic names / unbounded labels are flagged in place."""
 
     name = "metric-unregistered"
+    cross_file = True
 
     def __init__(self) -> None:
         self._registered: set[str] = set()
@@ -331,4 +332,9 @@ class MetricsRule(Rule):
 
 
 def default_rules() -> list[Rule]:
-    return [BlockingCallRule(), HostSyncRule(), CtypesCheckedRule(), MetricsRule()]
+    from gofr_tpu.analysis.shardcheck import shardcheck_rules
+
+    return [
+        BlockingCallRule(), HostSyncRule(), CtypesCheckedRule(), MetricsRule(),
+        *shardcheck_rules(),
+    ]

@@ -11,6 +11,11 @@ Pure-functional JAX, TPU-first:
 
 Shapes follow Llama-3: 8B = 32L/32H/8KV/4096d/14336ff/128256V,
 70B = 80L/64H/8KV/8192d/28672ff (BASELINE.json configs[2]/[4]).
+
+The ``donate_argnums`` on every prefill/decode jit here are a contract
+with the serving engine: the caller rebinds the donated cache/pool from
+the call's results in the same statement. shardcheck enforces that
+tree-wide (``use-after-donation``, docs/static-analysis.md).
 """
 
 from __future__ import annotations

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from gofr_tpu.jax_compat import shard_map
+from gofr_tpu.parallel.mesh import require_axis
 
 
 def router_topk(
@@ -185,7 +186,7 @@ def moe_ffn_ep(
     """Expert-parallel MoE FFN: tokens grouped on ``axis``, experts sharded
     on ``axis``, two all_to_all transposes over ICI. With ``return_stats``
     also returns the global per-expert (f_e, P_e) for the aux loss."""
-    n = mesh.shape[axis]
+    n = require_axis(mesh, axis)
     T = x.shape[0]
     E = w_gate.shape[0]
     if T % n != 0:

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from gofr_tpu.ops.attention import NEG_INF, gqa_repeat
+from gofr_tpu.parallel.mesh import require_axis
 
 from gofr_tpu.jax_compat import shard_map as _shard_map
 
@@ -118,7 +119,7 @@ def ring_attention(
     scale: float | None = None,
 ) -> jnp.ndarray:
     """shard_map wrapper: shards seq on ``axis``, runs the ring."""
-    n = mesh.shape[axis]
+    n = require_axis(mesh, axis)
     if q.shape[1] % n != 0:
         raise ValueError(f"seq {q.shape[1]} not divisible by {axis}={n}")
     spec = P(None, axis, None, None)
@@ -179,7 +180,7 @@ def ulysses_attention(
     axis: str = "sp",
     scale: float | None = None,
 ) -> jnp.ndarray:
-    n = mesh.shape[axis]
+    n = require_axis(mesh, axis)
     if q.shape[1] % n != 0:
         raise ValueError(f"seq {q.shape[1]} not divisible by {axis}={n}")
     spec = P(None, axis, None, None)

@@ -31,6 +31,26 @@ from jax.sharding import Mesh
 
 AXIS_ORDER = ("dp", "fsdp", "pp", "sp", "ep", "tp")
 
+#: the fixed axis vocabulary, as a set — shardcheck (gofr_tpu/analysis/
+#: shardcheck.py, rule ``mesh-axis-unknown``) lint-checks every literal
+#: axis in the tree against this declaration; require_axis() is the
+#: runtime complement for axis names that only exist as values.
+KNOWN_AXES = frozenset(AXIS_ORDER)
+
+
+def require_axis(mesh: "Mesh", axis: str) -> int:
+    """Validate that ``axis`` names an axis of ``mesh`` and return its
+    size. A plain ``mesh.shape[axis]`` raises a bare KeyError three
+    frames deep in jax; this raises at the SPMD wrapper boundary with
+    the vocabulary spelled out."""
+    if axis not in mesh.shape:
+        raise ValueError(
+            f"axis {axis!r} is not an axis of the mesh "
+            f"(mesh axes: {', '.join(mesh.axis_names)}; "
+            f"framework vocabulary: {', '.join(AXIS_ORDER)})"
+        )
+    return mesh.shape[axis]
+
 
 @dataclasses.dataclass
 class MeshSpec:

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from gofr_tpu.jax_compat import pcast, shard_map
+from gofr_tpu.parallel.mesh import require_axis
 
 
 def pipeline_apply(
@@ -44,7 +45,7 @@ def pipeline_apply(
     pp (dp/tp shardings of the batch/feature dims remain in GSPMD's hands).
     Output has the same shape as ``x_mb``, valid on every pp rank.
     """
-    n = mesh.shape[axis]
+    n = require_axis(mesh, axis)
     if n == 1:
         return jax.lax.map(lambda x: stage_fn(stage_params, x), x_mb)
 
@@ -108,7 +109,7 @@ def pp_forward(
 
     if cfg.attn_impl == "cp":
         raise ValueError("attn_impl='cp' cannot nest inside pp_forward")
-    n = mesh.shape[axis]
+    n = require_axis(mesh, axis)
     if cfg.n_layers % n != 0:
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={n}")
     M = microbatches or max(n, 1)

@@ -17,16 +17,37 @@ from typing import Any
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from gofr_tpu.parallel.mesh import KNOWN_AXES
+
 
 def named_sharding(mesh: Mesh, *axes: Any) -> NamedSharding:
     return NamedSharding(mesh, P(*axes))
 
 
+def _validate_spec(pat: str, spec: P) -> None:
+    """Every axis name in a rule's PartitionSpec must be framework
+    vocabulary. shardcheck's ``mesh-axis-unknown`` catches literal specs
+    at lint time; this is the runtime twin for rule tables built from
+    config/user input, raising at table construction instead of as an
+    unbound-axis error mid-trace."""
+    for entry in spec:
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for axis in axes:
+            if axis is not None and axis not in KNOWN_AXES:
+                raise ValueError(
+                    f"sharding rule {pat!r} names unknown mesh axis "
+                    f"{axis!r} (vocabulary: {', '.join(sorted(KNOWN_AXES))})"
+                )
+
+
 class ShardingRules:
     """Ordered (pattern -> PartitionSpec) rules applied to a params pytree by
-    path; first match wins, default replicated."""
+    path; first match wins, default replicated. Axis names are validated
+    against the mesh vocabulary up front."""
 
     def __init__(self, rules: list[tuple[str, P]]) -> None:
+        for pat, spec in rules:
+            _validate_spec(pat, spec)
         self.rules = [(re.compile(pat), spec) for pat, spec in rules]
 
     def spec_for(self, path: str) -> P:

@@ -11,6 +11,12 @@ Device-side state per engine:
 - ``cache_len``  [B_slots] valid length per slot (0 = free)
 - ``last_token`` [B_slots]
 - per-slot sampling params (temperature/top_k/top_p) + PRNG key
+
+This file is a shardcheck retrace zone (``make lint``): donated buffers
+must be rebound at every call site (``use-after-donation``) and nothing
+here may branch on traced values or take unhashable statics
+(``retrace-hazard``) — one per-request recompile eats the whole TTFT
+budget.
 """
 
 from __future__ import annotations

@@ -11,6 +11,11 @@ Host side (this class): page accounting, block tables, seq lens.
 Device side: scatter prefilled slabs into owned pages (_write_pages); the
 decode-step append lives inside llama.decode_step_paged (per layer), and
 the read path is ops/paged_attention.py.
+
+shardcheck retrace/donation zone: the pool buffers are donated through
+every _write_pages*/decode dispatch and MUST be rebound in the same
+statement (``use-after-donation``, docs/static-analysis.md) — a stale
+``self.k_pool`` read after a donating call is the round-4 on-TPU crash.
 """
 
 from __future__ import annotations

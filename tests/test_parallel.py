@@ -38,6 +38,24 @@ def test_build_mesh_8_devices():
     assert mesh.shape["fsdp"] == 1
 
 
+def test_require_axis_validates_vocabulary():
+    from gofr_tpu.parallel.mesh import require_axis
+
+    mesh = build_mesh("dp=2,tp=4")
+    assert require_axis(mesh, "tp") == 4
+    with pytest.raises(ValueError, match="vocabulary"):
+        require_axis(mesh, "model")  # HF-style name, not framework vocab
+
+
+def test_sharding_rules_reject_unknown_axis():
+    from gofr_tpu.parallel.sharding import ShardingRules
+
+    with pytest.raises(ValueError, match="unknown mesh axis"):
+        ShardingRules([(r"w[qkv]$", P("model", None))])
+    # vocabulary (incl. tuple groups) constructs fine
+    ShardingRules([(r"w[qkv]$", P(("dp", "fsdp"), "tp"))])
+
+
 def test_llama_params_shard_onto_mesh():
     cfg = llama.LlamaConfig.tiny()
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
