@@ -5,20 +5,31 @@
 PY ?= python
 ASAN_RT := $(shell g++ -print-file-name=libasan.so 2>/dev/null)
 
-.PHONY: check ci import-check lint lock-order test bench-smoke native native-asan
+.PHONY: check ci import-check lint lock-order test bench-smoke native native-asan chaos
 
 check: import-check lint test native-asan bench-smoke
 	@echo "CHECK OK"
 
 # pre-merge gate (docs/static-analysis.md): gofrlint + shardcheck over the
-# tree, the analyzer's own fixture suites, then the full tier-1 pytest run.
-# The fixture suites DO run again inside tier-1; the explicit first pass is
-# a deliberate fail-fast — a broken analyzer surfaces in ~30 s, not after
-# the ~15 min full suite.
+# tree, the analyzer's own fixture suites, the fixed-seed chaos tier
+# (docs/robustness.md), then the full tier-1 pytest run. The fixture suites
+# DO run again inside tier-1; the explicit first pass is a deliberate
+# fail-fast — a broken analyzer surfaces in ~30 s, not after the ~15 min
+# full suite.
 ci: lint
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_analysis.py tests/test_shardcheck.py -q
+	$(MAKE) chaos
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
 	@echo "CI OK"
+
+# chaos tier (docs/robustness.md): the serving/engine suites under
+# FIXED-SEED fault schedules at every registered injection point, asserting
+# the request-lifecycle invariant — every submitted request reaches exactly
+# one terminal state with its slot + KV pages reclaimed, and the engine
+# thread exits cleanly. Deterministic: a red run reproduces with the same
+# seed every time (seeds live in tests/test_chaos.py::CHAOS_SEEDS).
+chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -q -m chaos
 
 # gofrlint (docs/static-analysis.md): framework-invariant AST lints over
 # the whole package + the extern-C vs ctypes FFI signature cross-check.

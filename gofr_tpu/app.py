@@ -374,15 +374,36 @@ class App:
         except RuntimeError:
             pass  # loop already closed
 
+    def drain(self) -> None:
+        """Coordinated graceful drain, from any thread: flip health to
+        DRAINING and reject new work with a retriable status immediately
+        (HTTP 503 + Retry-After, gRPC UNAVAILABLE, WS upgrade 503), then
+        run the normal shutdown sequence — whose hooks drain the serving
+        engine within its drain deadline. The admin-trigger twin of
+        SIGTERM."""
+        self.container.draining = True
+        self.stop()
+
     async def shutdown(self) -> None:
-        """gofr.go:76-101 + shutdown.go:14-48: grace period then force."""
+        """gofr.go:76-101 + shutdown.go:14-48: grace period then force.
+        Order matters for request-lifecycle correctness: the draining flag
+        flips FIRST (new work bounces with a retriable status while the
+        event loop keeps pumping in-flight streams), shutdown hooks —
+        including the engine drain, which blocks up to its drain deadline —
+        run in the executor so those streams can actually finish, and only
+        then do the servers close."""
         grace = float(self.config.get_or_default("SHUTDOWN_GRACE_PERIOD", str(DEFAULT_SHUTDOWN_GRACE_SECONDS)))
-        self.logger.info("shutting down gracefully...")
+        self.container.draining = True
+        self.logger.info("shutting down gracefully (draining)...")
+        loop = asyncio.get_running_loop()
         for hook in self._on_shutdown_hooks:
             try:
-                result = hook()
-                if asyncio.iscoroutine(result):
-                    await result
+                if asyncio.iscoroutinefunction(hook):
+                    await hook()
+                else:
+                    result = await loop.run_in_executor(None, hook)
+                    if asyncio.iscoroutine(result):
+                        await result
             except Exception as exc:
                 self.logger.error(f"error in shutdown hook: {exc}")
         try:

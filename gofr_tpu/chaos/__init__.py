@@ -1,0 +1,23 @@
+"""Deterministic chaos tier: seed-driven fault injection at the request
+path's seams. See docs/robustness.md for the seed workflow and
+``make chaos`` for the CI tier."""
+
+from gofr_tpu.chaos.injector import (
+    POINTS,
+    ChaosFault,
+    ChaosInjector,
+    active,
+    install,
+    maybe_fail,
+    uninstall,
+)
+
+__all__ = [
+    "POINTS",
+    "ChaosFault",
+    "ChaosInjector",
+    "active",
+    "install",
+    "maybe_fail",
+    "uninstall",
+]

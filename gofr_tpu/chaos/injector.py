@@ -1,0 +1,164 @@
+"""Deterministic, seed-driven fault injection (the chaos tier).
+
+The serving path claims a lifecycle invariant — every submitted request
+reaches exactly ONE terminal state (completed / canceled / deadline_exceeded
+/ shed / failed-retriable) with its slot and KV pages reclaimed — but until
+this module existed nothing could exercise the claim systematically: the
+recovery paths only fired when real hardware misbehaved. Named injection
+points sit at the seams where production faults actually arrive (the
+native-scheduler boundary, decode dispatch, paged-KV allocation, the
+outbound service client, pubsub publish); a :class:`ChaosInjector` decides
+per call, from a fixed seed, whether that call fails. Same seed → same
+fault schedule, every run, regardless of wall clock: each point draws from
+its own ``random.Random`` stream keyed by ``(seed, point)`` and an atomic
+per-point call counter, so schedules are reproducible even when threads
+interleave differently.
+
+Production cost is one module-global ``is None`` check per injection point
+— no injector installed (the default, always, outside tests) means no
+randomness, no locks, no allocation.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+from typing import Any, Callable
+
+# The registered injection points. Hooks call ``maybe_fail`` with one of
+# these names; installing an injector with an unknown point name raises so
+# a typo'd schedule cannot silently test nothing.
+POINTS = (
+    "sched.submit",     # native-scheduler boundary: request queueing
+    "sched.admit",      # native-scheduler boundary: batch admission
+    "decode.dispatch",  # engine decode dispatch (device step)
+    "kv.alloc",         # paged-KV pool allocation / extension
+    "service.request",  # outbound HTTP service client
+    "pubsub.publish",   # pubsub publish
+)
+
+
+class ChaosFault(RuntimeError):
+    """The generic injected fault: a transient, retriable infrastructure
+    error (transport reset, RPC deadline, broker hiccup)."""
+
+    retriable = True
+
+    def __init__(self, point: str, nth_call: int) -> None:
+        super().__init__(f"injected chaos fault at {point} (call #{nth_call})")
+        self.point = point
+        self.nth_call = nth_call
+
+
+def _default_fault_factories() -> dict[str, Callable[[str, int], BaseException]]:
+    """Per-point defaults matching what the real seam raises: the KV pool
+    raises OutOfBlocks (a transient the engine requeues on), the scheduler
+    queue raises QueueFull (backpressure), everything else a transport-ish
+    ChaosFault."""
+    from gofr_tpu.native.fallback import OutOfBlocks, QueueFull
+
+    return {
+        "kv.alloc": lambda p, n: OutOfBlocks(f"injected pool exhaustion at {p} (call #{n})"),
+        "sched.submit": lambda p, n: QueueFull(f"injected queue-full at {p} (call #{n})"),
+    }
+
+
+class ChaosInjector:
+    """Seed-driven fault schedule over the registered injection points.
+
+    ``rates`` maps point name → fault probability per call. ``max_faults``
+    (per point) bounds how many times a point fires, which guarantees the
+    system under test converges — after the budget is spent the point goes
+    quiet and retries/requeues succeed.
+    """
+
+    def __init__(
+        self,
+        seed: int,
+        rates: dict[str, float],
+        *,
+        max_faults: int | None = None,
+        fault_factories: dict[str, Callable[[str, int], BaseException]] | None = None,
+    ) -> None:
+        unknown = set(rates) - set(POINTS)
+        if unknown:
+            raise ValueError(f"unknown chaos point(s): {sorted(unknown)}")
+        self.seed = seed
+        self.rates = dict(rates)
+        self.max_faults = max_faults
+        self._factories = _default_fault_factories()
+        if fault_factories:
+            self._factories.update(fault_factories)
+        self._mu = threading.Lock()
+        self._rngs = {p: random.Random(f"{seed}:{p}") for p in rates}
+        self._calls = {p: 0 for p in rates}
+        self._faults = {p: 0 for p in rates}
+
+    def fire(self, point: str) -> None:
+        """Raise this point's fault if the schedule says this call fails."""
+        rate = self.rates.get(point)
+        if rate is None:
+            return
+        with self._mu:
+            self._calls[point] += 1
+            nth = self._calls[point]
+            if not rate:
+                return
+            if self.max_faults is not None and self._faults[point] >= self.max_faults:
+                return
+            if self._rngs[point].random() >= rate:
+                return
+            self._faults[point] += 1
+        factory = self._factories.get(point)
+        if factory is not None:
+            raise factory(point, nth)
+        raise ChaosFault(point, nth)
+
+    def stats(self) -> dict[str, dict[str, int]]:
+        with self._mu:
+            return {
+                p: {"calls": self._calls[p], "faults": self._faults[p]}
+                for p in self._calls
+            }
+
+
+# -- global installation ------------------------------------------------------
+# A module global read without a lock: installation happens only in tests
+# (and only between workloads); the hot-path contract is a single attribute
+# load + None check.
+_active: ChaosInjector | None = None
+_install_mu = threading.Lock()
+
+
+def maybe_fail(point: str) -> None:
+    """The hook every injection point calls. No-op unless an injector is
+    installed."""
+    inj = _active
+    if inj is not None:
+        inj.fire(point)
+
+
+def install(injector: ChaosInjector) -> None:
+    global _active
+    with _install_mu:
+        if _active is not None:
+            raise RuntimeError("a chaos injector is already installed")
+        _active = injector
+
+
+def uninstall() -> None:
+    global _active
+    with _install_mu:
+        _active = None
+
+
+@contextlib.contextmanager
+def active(injector: ChaosInjector) -> Any:
+    """``with chaos.active(ChaosInjector(seed, rates)): ...`` — install for
+    the block, always uninstall, even when the workload raises."""
+    install(injector)
+    try:
+        yield injector
+    finally:
+        uninstall()

@@ -64,6 +64,10 @@ class Container:
         self.ws_manager: Any = None
         self.extra_datasources: dict[str, Any] = {}
         self.serving: Any = None  # continuous-batching engine (serving/)
+        # request-lifecycle drain flag: flipped by App.drain()/shutdown();
+        # HTTP dispatch, the gRPC interceptor and the WS upgrader all
+        # reject new work with a retriable status while it is set
+        self.draining = False
 
         self._closed = False
         self._lock = threading.Lock()
@@ -122,6 +126,19 @@ class Container:
         m.new_gauge(
             "app_spec_accept_rate",
             "Speculative-decode draft acceptance rate over drafted tokens",
+        )
+        m.new_counter(
+            "app_requests_shed_total",
+            "Requests rejected by admission control (queue full or "
+            "estimated wait past deadline/threshold)",
+        )
+        m.new_counter(
+            "app_requests_deadline_exceeded_total",
+            "Requests whose deadline passed before completion",
+        )
+        m.new_gauge(
+            "app_estimated_queue_wait_seconds",
+            "EWMA-estimated queue wait for a newly submitted request",
         )
 
     # -- accessors mirroring the reference's API ------------------------------

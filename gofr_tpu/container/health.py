@@ -42,7 +42,12 @@ def aggregate_health(container: Any) -> dict[str, Any]:
             return all(_is_up(v) for k, v in node.items() if k != "status")
         return True
 
-    overall = "UP" if all(_is_up(v) for v in details.values()) else "DEGRADED"
+    if getattr(container, "draining", False):
+        # drain outranks everything: the LB must stop routing here, whatever
+        # the datasources say
+        overall = "DRAINING"
+    else:
+        overall = "UP" if all(_is_up(v) for v in details.values()) else "DEGRADED"
     return {
         "status": overall,
         "name": container.app_name,

@@ -13,6 +13,7 @@ from __future__ import annotations
 import threading
 from typing import Any
 
+from gofr_tpu import chaos
 from gofr_tpu.datasource.pubsub.message import Message
 
 
@@ -51,6 +52,7 @@ class InMemoryBroker:
     def publish(self, topic: str, message: bytes, metadata: dict | None = None) -> None:
         if self._metrics:
             self._metrics.increment_counter("app_pubsub_publish_total_count", topic=topic)
+        chaos.maybe_fail("pubsub.publish")
         with self._data_available:
             self._topics.setdefault(topic, []).append(
                 (message if isinstance(message, bytes) else str(message).encode(), metadata or {})

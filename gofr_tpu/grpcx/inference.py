@@ -20,7 +20,43 @@ from typing import Any
 import grpc
 import grpc.aio
 
+from gofr_tpu.http.errors import (
+    ErrorDeadlineExceeded,
+    ErrorServiceUnavailable,
+    ErrorTooManyRequests,
+)
+
 SERVICE_NAME = "gofr.v1.Inference"
+
+
+def _deadline_of(context: Any) -> float | None:
+    """The gRPC-native deadline: clients set it on the call; the remaining
+    budget propagates into the engine so queued work that cannot make it
+    is dropped instead of decoded into the void."""
+    try:
+        remaining = context.time_remaining()
+    except Exception:
+        return None
+    if remaining is None or remaining <= 0:
+        return None
+    return float(remaining)
+
+
+async def _abort_lifecycle(context: Any, exc: Exception) -> None:
+    """Map the engine's typed lifecycle errors onto gRPC status codes:
+    shed → RESOURCE_EXHAUSTED (+ retry-delay detail), drain → UNAVAILABLE,
+    expired → DEADLINE_EXCEEDED."""
+    if isinstance(exc, ErrorTooManyRequests):
+        retry_after = exc.retry_after if exc.retry_after is not None else 1.0
+        context.set_trailing_metadata((
+            ("retry-delay-s", f"{retry_after:.3f}"),
+        ))
+        await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, exc.message)
+    if isinstance(exc, ErrorServiceUnavailable):
+        await context.abort(grpc.StatusCode.UNAVAILABLE, exc.message)
+    if isinstance(exc, ErrorDeadlineExceeded):
+        await context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, exc.message)
+    raise exc
 
 _identity = lambda b: b  # noqa: E731
 
@@ -92,7 +128,13 @@ class InferenceService:
         prompt = body.get("prompt")
         if not prompt:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "prompt required")
-        result = await self.engine.generate(prompt, **self._gen_kwargs(body))
+        try:
+            result = await self.engine.generate(
+                prompt, deadline=_deadline_of(context), **self._gen_kwargs(body)
+            )
+        except (ErrorTooManyRequests, ErrorServiceUnavailable,
+                ErrorDeadlineExceeded) as exc:
+            await _abort_lifecycle(context, exc)
         return _json_bytes(
             {
                 "id": result.request_id,
@@ -114,9 +156,24 @@ class InferenceService:
         prompt = body.get("prompt")
         if not prompt:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "prompt required")
-        async for token_id, piece in self.engine.stream(prompt, **self._gen_kwargs(body)):
-            yield _json_bytes({"token": token_id, "text": piece})
-        yield _json_bytes({"done": True})
+        final: dict = {}
+        try:
+            async for token_id, piece in self.engine.stream(
+                prompt, deadline=_deadline_of(context),
+                on_result=lambda r: final.setdefault("result", r),
+                **self._gen_kwargs(body),
+            ):
+                yield _json_bytes({"token": token_id, "text": piece})
+        except (ErrorTooManyRequests, ErrorServiceUnavailable,
+                ErrorDeadlineExceeded) as exc:
+            await _abort_lifecycle(context, exc)
+        result = final.get("result")
+        done: dict[str, Any] = {"done": True}
+        if result is not None:
+            # deadline_exceeded mid-stream surfaces as the terminal frame's
+            # finish_reason — the stream itself completed normally
+            done["finish_reason"] = result.finish_reason
+        yield _json_bytes(done)
 
     async def embed(self, request: bytes, context: Any) -> bytes:
         if self.embedder is None:

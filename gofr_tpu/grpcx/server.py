@@ -26,6 +26,12 @@ import grpc.aio
 GRPC_STATUS_LABELS = {True: "OK", False: "ERROR"}
 
 
+def _is_probe(method: str) -> bool:
+    """Health/reflection keep serving during drain so orchestrators can
+    observe NOT_SERVING instead of inferring it from UNAVAILABLE."""
+    return method.startswith("/grpc.health.") or method.startswith("/grpc.reflection.")
+
+
 def _health_handlers(container: Any) -> "grpc.GenericRpcHandler":
     """Standard grpc.health.v1.Health service, hand-framed protobuf:
     HealthCheckResponse{status=1} is `0x08 0x01` (SERVING) / `0x08 0x02`
@@ -77,6 +83,13 @@ class _ObservabilityInterceptor(grpc.aio.ServerInterceptor):
 
         def wrap_unary(behavior: Callable) -> Callable:
             async def wrapped(request: Any, context: Any) -> Any:
+                if getattr(container, "draining", False) and not _is_probe(method):
+                    # retriable by contract: clients/LBs re-resolve and hit
+                    # another replica (health keeps answering NOT_SERVING)
+                    await context.abort(
+                        grpc.StatusCode.UNAVAILABLE,
+                        "server draining; retry on another replica",
+                    )
                 start = time.perf_counter()
                 span = container.tracer.start_span(f"grpc {method}", kind="server")
                 ok = True
@@ -107,6 +120,11 @@ class _ObservabilityInterceptor(grpc.aio.ServerInterceptor):
 
         def wrap_stream(behavior: Callable) -> Callable:
             async def wrapped(request: Any, context: Any):
+                if getattr(container, "draining", False) and not _is_probe(method):
+                    await context.abort(
+                        grpc.StatusCode.UNAVAILABLE,
+                        "server draining; retry on another replica",
+                    )
                 start = time.perf_counter()
                 span = container.tracer.start_span(f"grpc {method}", kind="server")
                 ok = True

@@ -15,7 +15,7 @@ from typing import Any
 
 from gofr_tpu.context import Context
 from gofr_tpu.handler import catch_all_handler, execute_handler
-from gofr_tpu.http.responder import Responder, WireResponse
+from gofr_tpu.http.responder import Responder, WireResponse, draining_response
 from gofr_tpu.http.router import Router
 
 
@@ -26,7 +26,13 @@ class Dispatcher:
         self.responder = Responder()
         self.request_timeout = request_timeout
 
+    # probe routes stay served while draining so load balancers can SEE the
+    # DRAINING state instead of inferring it from connection errors
+    _DRAIN_EXEMPT = ("/.well-known/health", "/.well-known/alive")
+
     async def __call__(self, req: Any) -> WireResponse:
+        if getattr(self.container, "draining", False) and req.path not in self._DRAIN_EXEMPT:
+            return draining_response()
         # static files first-match after routes (router.go:66-78)
         match = self.router.lookup(req.method, req.path)
         if match is None:

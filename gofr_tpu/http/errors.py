@@ -36,6 +36,17 @@ class HTTPError(Exception):
         http/responder.go:163-183). Override to add fields."""
         return None
 
+    # retriable rejections (shed, drain) advertise when to come back;
+    # the Responder copies these onto the wire response
+    retry_after: float | None = None
+
+    def response_headers(self) -> dict[str, str]:
+        if self.retry_after is not None:
+            import math
+
+            return {"Retry-After": str(max(1, math.ceil(self.retry_after)))}
+        return {}
+
 
 class ErrorInvalidRoute(HTTPError):
     status_code = 404
@@ -124,21 +135,53 @@ class ErrorServiceUnavailable(HTTPError):
     status_code = 503
     level = Level.WARN
 
+    def __init__(self, message: str = "", *, retry_after: float | None = None) -> None:
+        super().__init__(message)
+        self.retry_after = retry_after
+
     @classmethod
     def default_message(cls) -> str:
         return "service unavailable"
 
 
 class ErrorTooManyRequests(HTTPError):
-    """TPU-build addition: admission control rejection when the batch queue is
-    saturated (continuous-batching backpressure)."""
+    """TPU-build addition: admission control rejection when the batch queue
+    is saturated (continuous-batching backpressure) or the shed estimator
+    predicts the request would wait past its deadline. ``retry_after``
+    (seconds) is the estimator's predicted queue wait; it reaches clients
+    as a ``Retry-After`` header (HTTP) / retry-delay detail (gRPC)."""
 
     status_code = 429
     level = Level.WARN
 
+    def __init__(self, message: str = "", *, retry_after: float | None = None) -> None:
+        super().__init__(message)
+        self.retry_after = retry_after
+
+    def response_fields(self) -> dict[str, Any] | None:
+        if self.retry_after is not None:
+            return {"retry_after_s": round(self.retry_after, 3)}
+        return None
+
     @classmethod
     def default_message(cls) -> str:
         return "server overloaded, retry later"
+
+
+class ErrorDeadlineExceeded(HTTPError):
+    """Request-lifecycle addition: the caller's deadline passed before the
+    request produced a result (expired in queue, or shed at admission after
+    queueing). Mid-stream expiry instead resolves normally with finish
+    reason ``deadline_exceeded``. 504: the server accepted but could not
+    complete in time — distinct from 408 (client idle) and 429 (rejected
+    up front)."""
+
+    status_code = 504
+    level = Level.INFO
+
+    @classmethod
+    def default_message(cls) -> str:
+        return "deadline exceeded before completion"
 
 
 def status_from_error(err: BaseException | None, method: str, has_data: bool) -> int:

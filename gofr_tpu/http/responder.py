@@ -27,6 +27,21 @@ class WireResponse:
     stream: Any = None  # async iterator of bytes chunks → chunked transfer
 
 
+def draining_response() -> WireResponse:
+    """The one retriable-503 the server sends while draining — shared by
+    HTTP dispatch and the WebSocket upgrader so the wire contract
+    (Retry-After, Connection: close, error envelope) cannot drift."""
+    return WireResponse(
+        status=503,
+        headers={
+            "Content-Type": "application/json",
+            "Retry-After": "1",
+            "Connection": "close",
+        },
+        body=b'{"error":{"message":"server draining; retry on another replica"}}',
+    )
+
+
 def _jsonable(obj: Any) -> Any:
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         return {k: _jsonable(v) for k, v in dataclasses.asdict(obj).items()}
@@ -69,6 +84,9 @@ class Responder:
         envelope: dict[str, Any] = {}
         if err is not None:
             envelope["error"] = self._error_obj(err)
+            hdr_fn = getattr(err, "response_headers", None)
+            if callable(hdr_fn):  # Retry-After on shed/drain rejections
+                headers.update(hdr_fn() or {})
         if result is not None or err is None:
             envelope["data"] = _jsonable(result)
         if metadata:

@@ -33,7 +33,7 @@ STATUS_TEXT = {
     408: "Request Timeout", 409: "Conflict", 413: "Payload Too Large",
     429: "Too Many Requests", 499: "Client Closed Request",
     500: "Internal Server Error", 501: "Not Implemented",
-    502: "Bad Gateway", 503: "Service Unavailable",
+    502: "Bad Gateway", 503: "Service Unavailable", 504: "Gateway Timeout",
 }
 
 

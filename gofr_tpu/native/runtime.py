@@ -8,6 +8,7 @@ from __future__ import annotations
 import ctypes
 import threading
 
+from gofr_tpu import chaos
 from gofr_tpu.native import (
     GOFR_E_EXISTS,
     GOFR_E_NOMEM,
@@ -174,6 +175,7 @@ class Scheduler:
         """Queue a request; ``front=True`` re-inserts at the head of its
         priority class (requeue after a transient admission failure)."""
         self._ensure_open()
+        chaos.maybe_fail("sched.submit")
         if self._lib is None:
             return self._py.submit(req_id, prompt_len, max_new_tokens, priority, front)
         fn = self._lib.gofr_sched_submit_front if front else self._lib.gofr_sched_submit
@@ -188,6 +190,7 @@ class Scheduler:
         _check(self._lib.gofr_sched_cancel(self._h, req_id), f"cancel req {req_id}")
 
     def admit(self, cap: int) -> tuple[list[tuple[int, int]], list[int]]:
+        chaos.maybe_fail("sched.admit")
         if self._lib is None:
             return self._py.admit(cap)
         ids = (ctypes.c_int64 * cap)()

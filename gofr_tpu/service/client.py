@@ -17,6 +17,7 @@ import urllib.parse
 import urllib.request
 from typing import Any
 
+from gofr_tpu import chaos
 from gofr_tpu.tracing.trace import current_span, format_traceparent
 
 
@@ -93,6 +94,7 @@ class HTTPService:
 
         start = time.perf_counter()
         try:
+            chaos.maybe_fail("service.request")
             req = urllib.request.Request(url, data=body, method=method.upper(), headers=hdrs)
             try:
                 with urllib.request.urlopen(req, timeout=timeout or self.timeout) as resp:

@@ -133,21 +133,42 @@ class CircuitBreaker(_Wrapper):
 
 @dataclasses.dataclass
 class RetryConfig:
-    """service/retry.go:96-109: retry on transport error or 5xx."""
+    """service/retry.go:96-109: retry on transport error, 5xx, or 429.
+
+    Backoff is exponential with FULL jitter (delay drawn uniformly from
+    [0, base·multiplier^(attempt-1)], capped at ``max_backoff``): a fixed
+    interval synchronizes every client's retries into coordinated waves
+    against a recovering backend — the retry storm IS the second outage.
+    ``max_elapsed`` caps the whole ladder (wait included): a retry that
+    would start past the cap is not attempted. A ``Retry-After`` header on
+    a 429/503 response (the shed estimator's hint) takes precedence over
+    the jittered delay when larger."""
 
     max_retries: int = 3
-    backoff: float = 0.0
+    backoff: float = 0.0  # base delay (seconds) for the first retry
+    multiplier: float = 2.0
+    max_backoff: float = 30.0
+    jitter: bool = True  # full jitter; False = deterministic exponential
+    max_elapsed: float | None = None  # total ladder budget, seconds
 
     def add_option(self, inner: Any) -> "Retry":
-        return Retry(inner, self.max_retries, self.backoff)
+        return Retry(self, inner)
+
+
+# statuses worth retrying: transient server failure, plus explicit
+# backpressure (429) which always carries a Retry-After hint here
+_RETRIABLE_STATUS = {429, 500, 502, 503, 504}
 
 
 class Retry(_Wrapper):
-    def __init__(self, inner: Any, max_retries: int, backoff: float) -> None:
+    def __init__(self, cfg: RetryConfig, inner: Any) -> None:
         super().__init__(inner)
-        self.max_retries = max_retries
-        self.backoff = backoff
+        self.cfg = cfg
+        self.max_retries = cfg.max_retries
         self._stop = threading.Event()
+        import random as _random
+
+        self._rng = _random.Random()  # tests may reseed for determinism
 
     def close(self) -> None:
         """Interrupt any in-flight backoff wait, then close the inner
@@ -157,12 +178,40 @@ class Retry(_Wrapper):
         if inner_close is not None:
             inner_close()
 
+    def _delay(self, attempt: int, retry_after: float | None) -> float:
+        cfg = self.cfg
+        exp = min(cfg.max_backoff, cfg.backoff * (cfg.multiplier ** (attempt - 1)))
+        delay = self._rng.uniform(0.0, exp) if cfg.jitter else exp
+        if retry_after is not None:
+            delay = max(delay, min(retry_after, cfg.max_backoff))
+        return delay
+
+    @staticmethod
+    def _retry_after_of(resp: ServiceResponse | None) -> float | None:
+        if resp is None:
+            return None
+        for key, value in resp.headers.items():
+            if key.lower() == "retry-after":
+                try:
+                    return float(value)
+                except ValueError:
+                    return None
+        return None
+
     def request(self, method: str, path: str, **kw: Any) -> ServiceResponse:
         last_exc: Exception | None = None
         last_resp: ServiceResponse | None = None
-        for attempt in range(self.max_retries + 1):
-            if attempt and self.backoff:
-                if self._stop.wait(self.backoff * attempt):
+        start = time.monotonic()
+        for attempt in range(self.cfg.max_retries + 1):
+            if attempt:
+                # the delay gate runs even with backoff=0: a server's
+                # Retry-After hint must be honored regardless of the
+                # client's own base interval
+                delay = self._delay(attempt, self._retry_after_of(last_resp))
+                if (self.cfg.max_elapsed is not None
+                        and time.monotonic() - start + delay > self.cfg.max_elapsed):
+                    break  # the ladder's budget is spent; return what we have
+                if delay and self._stop.wait(delay):
                     break  # closing: return what we already have
             try:
                 resp = self._inner.request(method, path, **kw)
@@ -170,10 +219,12 @@ class Retry(_Wrapper):
                 raise  # breaker opening mid-retry: stop hammering
             except Exception as exc:
                 last_exc = exc
+                last_resp = None
                 continue
-            if resp.status_code < 500:
+            if resp.status_code not in _RETRIABLE_STATUS:
                 return resp
             last_resp = resp
+            last_exc = None
         if last_resp is not None:
             return last_resp
         assert last_exc is not None

@@ -25,10 +25,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from gofr_tpu.http.errors import ErrorTooManyRequests
+from gofr_tpu import chaos
+from gofr_tpu.http.errors import (
+    ErrorDeadlineExceeded,
+    ErrorServiceUnavailable,
+    ErrorTooManyRequests,
+)
 from gofr_tpu.models import llama
 from gofr_tpu.native.runtime import QueueFull, Scheduler
 from gofr_tpu.serving import batch as batch_ops
+from gofr_tpu.serving.shed import QueueWaitEstimator
 from gofr_tpu.serving.tokenizer import ByteTokenizer, Tokenizer
 
 DEFAULT_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048)
@@ -69,6 +75,13 @@ class EngineConfig:
     # Mutually exclusive with multi_step > 1 (both are chunking policies).
     spec_tokens: int = 0
     spec_ngram: int = 3
+    # load shedding: reject at submit when the EWMA queue-wait estimate
+    # exceeds this many seconds (0 disables the threshold; deadline-aware
+    # shedding is always on for requests that carry a deadline)
+    shed_max_wait_s: float = 0.0
+    # graceful drain: how long in-flight generations get to finish before
+    # the remainder is failed with a retriable error
+    drain_deadline_s: float = 30.0
 
     @classmethod
     def from_config(cls, config: Any) -> "EngineConfig":
@@ -109,6 +122,10 @@ class EngineConfig:
             ),
             spec_tokens=int(config.get_or_default("TPU_SPEC_TOKENS", "0")),
             spec_ngram=int(config.get_or_default("TPU_SPEC_NGRAM", "3")),
+            shed_max_wait_s=float(config.get_or_default("TPU_SHED_MAX_WAIT_S", "0")),
+            drain_deadline_s=float(
+                config.get_or_default("TPU_DRAIN_DEADLINE_S", "30")
+            ),
         )
 
 
@@ -119,7 +136,7 @@ class GenerationResult:
     token_ids: list[int]
     prompt_tokens: int
     completion_tokens: int
-    finish_reason: str  # "stop" | "length" | "cancel" | "error"
+    finish_reason: str  # "stop" | "length" | "cancel" | "deadline_exceeded" | "error"
     ttft_s: float
     duration_s: float
 
@@ -133,12 +150,13 @@ class _Request:
     __slots__ = (
         "id", "prompt_ids", "max_new_tokens", "temperature", "top_k", "top_p",
         "stream_cb", "future", "created", "first_token_at", "tokens", "slot",
-        "canceled", "stop_ids", "priority", "dispatched",
+        "canceled", "stop_ids", "priority", "dispatched", "deadline",
     )
 
     def __init__(self, rid: int, prompt_ids: list[int], max_new_tokens: int,
                  temperature: float, top_k: int, top_p: float,
-                 stream_cb: Callable | None, future: Any, stop_ids: set[int]) -> None:
+                 stream_cb: Callable | None, future: Any, stop_ids: set[int],
+                 deadline: float | None = None) -> None:
         self.id = rid
         self.prompt_ids = prompt_ids
         self.max_new_tokens = max_new_tokens
@@ -155,6 +173,11 @@ class _Request:
         self.stop_ids = stop_ids
         self.priority = 0
         self.dispatched = 0  # decode steps dispatched (pipelined, ≥ consumed)
+        # absolute perf_counter time the caller stops caring; None = forever
+        self.deadline = (self.created + deadline) if deadline else None
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
 
 
 class _Inflight:
@@ -289,6 +312,13 @@ class ServingEngine:
         self._running = False
         self._thread: threading.Thread | None = None
         self._wake = threading.Event()
+        # request-lifecycle robustness state: the queue-wait estimator
+        # behind load shedding, and the drain/wedge lifecycle flags
+        self._shed = QueueWaitEstimator()
+        self._draining = False
+        self._wedged = False
+        self._stop_requested = False  # distinguishes "stopped" from "not yet started"
+        self._idle = threading.Event()  # set by the loop when drained dry
 
     @classmethod
     def from_checkpoint(
@@ -356,6 +386,10 @@ class ServingEngine:
         if self._running:
             return
         self._running = True
+        self._draining = False
+        self._wedged = False
+        self._stop_requested = False
+        self._idle.clear()
         self._thread = threading.Thread(target=self._loop, name="serving-engine", daemon=True)
         self._thread.start()
         if self._logger:
@@ -364,17 +398,101 @@ class ServingEngine:
                 f"max_seq={self.config.max_seq_len}"
             )
 
-    def stop(self) -> None:
+    def stop(self, join_timeout: float = 10.0) -> None:
+        self._stop_requested = True  # BEFORE the sweep: see submit's re-check
         self._running = False
         self._wake.set()
         if self._thread is not None:
-            self._thread.join(timeout=10)
+            self._thread.join(timeout=join_timeout)
+            if self._thread.is_alive():
+                # a wedged engine thread is an incident, not a shrug: keep
+                # the thread reference (health reports WEDGED, not DOWN)
+                # and do NOT destroy the scheduler/pools it may still be
+                # touching — that would turn a hang into a use-after-free
+                self._wedged = True
+                if self._logger:
+                    self._logger.error(
+                        f"serving engine thread failed to exit within "
+                        f"{join_timeout:g}s; native resources left allocated, "
+                        "health will report WEDGED"
+                    )
+                return
             self._thread = None
+            self._wedged = False  # a later stop() that joins clean recovers
+        # the loop thread has exited: anything still registered can never
+        # reach a terminal state through it (e.g. a submit that raced the
+        # drain flag and enqueued after the loop's last scan) — fail it
+        # retriable rather than leave its caller hanging forever
+        with self._count_lock:
+            leftovers = list(self._by_id.values())
+            self._by_id.clear()
+        for req in leftovers:
+            self._settle_future(req, ErrorServiceUnavailable(
+                "engine stopped before the request was served; retry",
+                retry_after=1.0,
+            ))
         try:
             self._sched.close()  # fallible: destroy status is checked
         finally:
             if self.paged_cache is not None:
                 self.paged_cache.close()
+
+    def drain(self, deadline_s: float | None = None, *,
+              join_timeout: float = 10.0) -> bool:
+        """Coordinated graceful drain: stop admitting (submit raises a
+        retriable 503), let queued + in-flight generations finish within
+        ``deadline_s`` (config drain_deadline_s by default), fail whatever
+        remains with a retriable ErrorServiceUnavailable, then stop the
+        engine thread. Returns True when everything finished inside the
+        deadline. Runs from any thread; called on SIGTERM via the app's
+        shutdown hooks and from the admin drain trigger."""
+        if not self._running:
+            # never started (or already stopped): nothing to wait for, but
+            # stop() must still run — it sweeps queued submissions and
+            # releases the native scheduler + KV pools (both closes are
+            # idempotent), which the old on_shutdown(engine.stop) hook did
+            # unconditionally
+            self.stop(join_timeout=join_timeout)
+            return True
+        deadline_s = (
+            self.config.drain_deadline_s if deadline_s is None else deadline_s
+        )
+        self._draining = True
+        self._idle.clear()
+        self._wake.set()
+        if self._logger:
+            self._logger.info(f"serving engine draining (deadline {deadline_s:g}s)")
+        drained = self._idle.wait(timeout=deadline_s)
+        if not drained:
+            with self._count_lock:
+                remainder = list(self._by_id.values())
+            for req in remainder:
+                # the engine thread may resolve this future concurrently;
+                # _settle_future tolerates losing that race
+                self._settle_future(req, ErrorServiceUnavailable(
+                    "server draining; retry on another replica",
+                    retry_after=1.0,
+                ))
+                req.canceled = True  # loop frees slot/KV through the cancel path
+                try:
+                    self._sched.cancel(req.id)
+                except KeyError:
+                    pass
+            if self._logger and remainder:
+                self._logger.warn(
+                    f"drain deadline passed with {len(remainder)} request(s) "
+                    "in flight; failed them with a retriable error"
+                )
+            self._wake.set()
+            # give the loop a short window to reclaim the canceled slots
+            # before the thread is asked to exit
+            self._idle.wait(timeout=5.0)
+        self.stop(join_timeout=join_timeout)
+        return drained
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     def health_check(self) -> dict[str, Any]:
         active = sum(1 for s in self.slots if s is not None)
@@ -386,12 +504,24 @@ class ServingEngine:
             "scheduler_backend": self._sched.backend,
             "total_admitted": stats["total_admitted"],
             "kv_layout": self.config.kv_layout,
+            "shed": self._shed.snapshot(),
         }
         if self.paged_cache is not None and self._running:
             details["kv_pages"] = self.paged_cache.stats()
         if self._prefix_cache is not None:
             details["prefix_cache"] = self._prefix_cache.stats()
-        return {"status": "UP" if self._running else "DOWN", "details": details}
+        # UP → DRAINING → DOWN is the normal lifecycle; WEDGED means stop()
+        # timed out joining the engine thread — the process needs replacing,
+        # which is exactly why it must not masquerade as a clean DOWN
+        if self._wedged:
+            status = "WEDGED"
+        elif not self._running:
+            status = "DOWN"
+        elif self._draining:
+            status = "DRAINING"
+        else:
+            status = "UP"
+        return {"status": status, "details": details}
 
     # ------------------------------------------------------------- submission
     def submit(
@@ -403,12 +533,46 @@ class ServingEngine:
         top_k: int = 0,
         top_p: float = 1.0,
         priority: int = 0,
+        deadline: float | None = None,
         stream_cb: Callable[[int, str, bool], None] | None = None,
     ) -> Any:
         """Thread-safe submit. Returns a concurrent Future resolving to
         GenerationResult. ``stream_cb(token_id, text_piece, done)`` fires per
-        token from the engine thread. Lower ``priority`` runs first."""
+        token from the engine thread. Lower ``priority`` runs first.
+        ``deadline`` is the caller's remaining budget in seconds (from the
+        HTTP ``X-Request-Timeout`` header or the gRPC deadline): a request
+        still queued when it passes is dropped without prefilling (504), one
+        mid-stream retires with finish reason ``deadline_exceeded``."""
         import concurrent.futures
+
+        if self._draining:
+            # retriable: the LB should route the retry to another replica
+            raise ErrorServiceUnavailable(
+                "server draining; retry on another replica", retry_after=1.0
+            )
+
+        # load shedding BEFORE any per-request work: rejecting here costs
+        # microseconds; admitting a request that will wait past its
+        # deadline costs a 504 after seconds of queueing. ONE stats
+        # snapshot serves both the estimate and the queue-depth gauge —
+        # stats() takes the scheduler mutex the engine thread contends on.
+        depth = self._sched.stats()["queue_depth"]
+        est_wait = self._shed.estimate_wait(depth, self.config.max_slots)
+        if self._metrics:
+            self._metrics.set_gauge("app_estimated_queue_wait_seconds", est_wait)
+        shed_cap = self.config.shed_max_wait_s
+        if (deadline is not None and 0 < deadline < est_wait) or (
+            shed_cap > 0 and est_wait > shed_cap
+        ):
+            if self._metrics:
+                self._metrics.increment_counter("app_requests_shed_total")
+            raise ErrorTooManyRequests(
+                f"estimated queue wait {est_wait:.2f}s exceeds "
+                + (f"request deadline {deadline:.2f}s"
+                   if deadline is not None and 0 < deadline < est_wait
+                   else f"shed threshold {shed_cap:.2f}s"),
+                retry_after=est_wait,
+            )
 
         with self._count_lock:
             self._next_id += 1
@@ -429,7 +593,7 @@ class ServingEngine:
         future.request_id = rid
         req = _Request(
             rid, prompt_ids, max_new, temperature, top_k, top_p, stream_cb, future,
-            stop_ids={self.tokenizer.eos_id},
+            stop_ids={self.tokenizer.eos_id}, deadline=deadline,
         )
         req.priority = priority
         with self._count_lock:
@@ -439,8 +603,31 @@ class ServingEngine:
         except QueueFull:
             with self._count_lock:
                 self._by_id.pop(rid, None)
-            raise ErrorTooManyRequests() from None
-        self._observe_queue()
+            if self._metrics:
+                self._metrics.increment_counter("app_requests_shed_total")
+            raise ErrorTooManyRequests(retry_after=max(est_wait, 1.0)) from None
+        except RuntimeError:
+            # "scheduler closed": lost the race against a concurrent stop()
+            with self._count_lock:
+                self._by_id.pop(rid, None)
+            raise ErrorServiceUnavailable(
+                "server stopped; retry on another replica", retry_after=1.0
+            ) from None
+        if self._stop_requested:
+            # raced a concurrent stop(): the flag flips BEFORE the leftover
+            # sweep, so either that sweep saw this registration or this
+            # re-check sees the flip — the request cannot strand. (A not-
+            # yet-started engine is fine: submit-then-start is supported.)
+            with self._count_lock:
+                self._by_id.pop(rid, None)
+            try:
+                self._sched.cancel(rid)
+            except Exception:
+                pass
+            raise ErrorServiceUnavailable(
+                "server stopped; retry on another replica", retry_after=1.0
+            )
+        self._observe_queue(depth + 1)  # this request just joined the queue
         self._wake.set()
         return future
 
@@ -449,9 +636,13 @@ class ServingEngine:
         future = self.submit(prompt, **kw)
         return await asyncio.wrap_future(future)
 
-    async def stream(self, prompt: str | list[int], **kw: Any):
-        """Async iterator of (token_id, text_piece) tuples; final result
-        available after iteration via the returned generator's ``result``."""
+    async def stream(self, prompt: str | list[int], *,
+                     on_result: Callable[[GenerationResult], None] | None = None,
+                     **kw: Any):
+        """Async iterator of (token_id, text_piece) tuples. ``on_result``
+        fires with the final GenerationResult after the last token, so
+        transports can emit a terminal frame (finish reason, usage) without
+        re-plumbing the future."""
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
 
@@ -465,7 +656,9 @@ class ServingEngine:
                 if done:
                     break
                 yield token_id, piece
-            await asyncio.wrap_future(future)
+            result = await asyncio.wrap_future(future)
+            if on_result is not None:
+                on_result(result)
         finally:
             # client disconnected mid-stream (GeneratorExit) or consumer
             # stopped: free the slot instead of decoding into the void —
@@ -504,6 +697,12 @@ class ServingEngine:
                 else:
                     self._last_consume_t = None  # idle gap must not skew TPOT
                 if not did_work:
+                    if (self._draining and self._inflight is None
+                            and not any(s is not None for s in self.slots)
+                            and self._sched.stats()["queue_depth"] == 0):
+                        # drained dry: every accepted request reached a
+                        # terminal state; drain() is waiting on this
+                        self._idle.set()
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
             except Exception as exc:  # the loop must never die
@@ -539,6 +738,15 @@ class ServingEngine:
                     self._by_id.pop(rid, None)
                 self._finish(req, "cancel")
                 continue
+            if req.expired(time.perf_counter()):
+                # expired while queued: NEVER prefill it — the answer is
+                # already useless, the prefill would only steal TTFT from
+                # live requests. 504 / DEADLINE_EXCEEDED to the caller.
+                self._sched.release(slot)
+                with self._count_lock:
+                    self._by_id.pop(rid, None)
+                self._expire(req)
+                continue
             try:
                 self._prefill_into(slot, req)
             except _RequeueRequest:
@@ -556,10 +764,7 @@ class ServingEngine:
                 except Exception:
                     with self._count_lock:
                         self._by_id.pop(rid, None)
-                    if not req.future.done():
-                        req.future.set_exception(
-                            ErrorTooManyRequests()
-                        )
+                    self._try_resolve(req, exc=ErrorTooManyRequests())
             except Exception as exc:
                 # a failed prefill must not leak the slot, its KV pages, or
                 # hang the client
@@ -576,8 +781,7 @@ class ServingEngine:
                     pass
                 with self._count_lock:
                     self._by_id.pop(rid, None)
-                if not req.future.done():
-                    req.future.set_exception(exc)
+                self._try_resolve(req, exc=exc)
                 if self._logger:
                     self._logger.error(f"prefill failed for request {rid}: {exc}")
                 # pure host-side rejections (queue/page-budget limits) never
@@ -682,6 +886,7 @@ class ServingEngine:
         self._pending_tok[slot] = (first_id, S)
         self._samp_dev = None  # sampling params changed → re-upload once
 
+        self._shed.observe_ttft(req.first_token_at - req.created)
         if self._metrics:
             self._metrics.record_histogram(
                 "app_ttft_seconds", req.first_token_at - req.created
@@ -719,17 +924,24 @@ class ServingEngine:
         cache layouts (dense/paged x bf16/int8); ref
         models/llama.py:speculative_generate for the library-level twin."""
         cfg = self.model_cfg
+        chaos.maybe_fail("decode.dispatch")
         K = self.config.spec_tokens
         T = K + 1
         max_seq = self.config.max_seq_len
         self._pending_tok.clear()  # host state is authoritative in spec mode
 
         rows: list[tuple[int, _Request]] = []
+        now = time.perf_counter()
         for slot, req in enumerate(self.slots):
             if req is None:
                 continue
             if req.canceled:
                 self._retire(slot, "cancel")
+                continue
+            if req.expired(now):
+                # abandon mid-stream: free the slot for live requests and
+                # resolve with the tokens produced so far
+                self._retire(slot, "deadline_exceeded")
                 continue
             if (len(req.tokens) >= req.max_new_tokens
                     or len(req.prompt_ids) + len(req.tokens) >= max_seq):
@@ -883,8 +1095,10 @@ class ServingEngine:
     def _dispatch_decode(self) -> _Inflight | None:
         cfg = self.model_cfg
         max_seq = self.config.max_seq_len
+        chaos.maybe_fail("decode.dispatch")
 
         rows: list[tuple[int, _Request]] = []
+        now = time.perf_counter()
         for slot, req in enumerate(self.slots):
             if req is None:
                 continue
@@ -892,6 +1106,12 @@ class ServingEngine:
                 # retire immediately; a pending in-flight token (if any) is
                 # discarded at consume via the snapshot identity check
                 self._retire(slot, "cancel")
+                continue
+            if req.expired(now):
+                # deadline passed mid-stream: abandon the row, free the
+                # slot; the in-flight token (if any) is discarded at
+                # consume via the snapshot identity check
+                self._retire(slot, "deadline_exceeded")
                 continue
             total_if_done = 1 + req.dispatched  # prefill token + decode steps
             if (total_if_done >= req.max_new_tokens
@@ -1099,6 +1319,8 @@ class ServingEngine:
         self._emit_token(req, token_id)
         if req.canceled:
             self._retire(slot, "cancel")
+        elif req.expired(time.perf_counter()):
+            self._retire(slot, "deadline_exceeded")
         elif token_id in req.stop_ids:
             self._retire(slot, "stop")
         elif len(req.tokens) >= req.max_new_tokens:
@@ -1130,8 +1352,53 @@ class ServingEngine:
                 self._by_id.pop(req.id, None)
             self._finish(req, reason)
 
+    @staticmethod
+    def _try_resolve(req: _Request, value: Any = None,
+                     exc: Exception | None = None) -> bool:
+        """Settle a request's future, tolerant of a concurrent settler:
+        done()-then-set is check-then-act, and BOTH sides race — the engine
+        thread (_finish/_expire/_fail_all) against drain()/stop() sweeps.
+        Losing must never raise InvalidStateError: on the engine thread
+        that would escalate a benign lost race into _fail_all."""
+        if req.future.done():
+            return False
+        try:
+            if exc is not None:
+                req.future.set_exception(exc)
+            else:
+                req.future.set_result(value)
+            return True
+        except Exception:
+            return False  # the other settler won the race
+
+    def _settle_future(self, req: _Request, exc: Exception) -> None:
+        """Fail a request's future from OUTSIDE the engine thread. Fires
+        the stream's done callback so consumers blocked on the token queue
+        wake up."""
+        if self._try_resolve(req, exc=exc) and req.stream_cb is not None:
+            try:
+                req.stream_cb(-1, "", True)
+            except Exception:
+                pass
+
+    def _expire(self, req: _Request) -> None:
+        """Terminal state for a request whose deadline passed while still
+        queued: it never prefilled, so there is no partial result — the
+        caller gets 504 / DEADLINE_EXCEEDED."""
+        if self._metrics:
+            self._metrics.increment_counter("app_requests_deadline_exceeded_total")
+        if req.stream_cb is not None:
+            try:
+                req.stream_cb(-1, "", True)
+            except Exception:
+                pass
+        self._try_resolve(req, exc=ErrorDeadlineExceeded())
+
     def _finish(self, req: _Request, reason: str) -> None:
         now = time.perf_counter()
+        self._shed.observe_request(now - req.created)
+        if reason == "deadline_exceeded" and self._metrics:
+            self._metrics.increment_counter("app_requests_deadline_exceeded_total")
         out_ids = [t for t in req.tokens if t not in req.stop_ids]
         result = GenerationResult(
             request_id=req.id,
@@ -1148,8 +1415,7 @@ class ServingEngine:
                 req.stream_cb(-1, "", True)
             except Exception:
                 pass
-        if not req.future.done():
-            req.future.set_result(result)
+        self._try_resolve(req, value=result)
 
     def _kv_unhealthy(self) -> bool:
         """True when the persistent KV storage cannot serve another step:
@@ -1252,19 +1518,18 @@ class ServingEngine:
                     pass
                 with self._count_lock:
                     self._by_id.pop(req.id, None)
-                if not req.future.done():
-                    req.future.set_exception(exc)
+                self._try_resolve(req, exc=exc)
 
     def _buckets(self) -> tuple[int, ...]:
         return tuple(
             b for b in self.config.prefill_buckets if b <= self.config.max_seq_len
         ) or (self.config.max_seq_len,)
 
-    def _observe_queue(self) -> None:
+    def _observe_queue(self, depth: int | None = None) -> None:
         if self._metrics:
-            self._metrics.set_gauge(
-                "app_batch_queue_depth", self._sched.stats()["queue_depth"]
-            )
+            if depth is None:
+                depth = self._sched.stats()["queue_depth"]
+            self._metrics.set_gauge("app_batch_queue_depth", depth)
 
     def _span(self, name: str):
         import contextlib

@@ -18,7 +18,11 @@ import dataclasses
 import json
 from typing import Any
 
-from gofr_tpu.http.errors import ErrorInvalidParam, ErrorMissingParam
+from gofr_tpu.http.errors import (
+    ErrorInvalidParam,
+    ErrorMissingParam,
+    HTTPError,
+)
 from gofr_tpu.http.responder import WireResponse
 
 
@@ -32,14 +36,36 @@ class GenerateRequest:
     stream: bool = False
 
 
+def _shutdown_hook(engine: Any) -> Any:
+    """Drain, not stop: SIGTERM lets in-flight generations finish within
+    the drain deadline instead of abandoning their streams (engines
+    without drain — injected test doubles — fall back to stop)."""
+    return getattr(engine, "drain", None) or engine.stop
+
+
+def deadline_from_ctx(ctx: Any) -> float | None:
+    """The HTTP deadline contract: ``X-Request-Timeout`` (or bare
+    ``Request-Timeout``), seconds, float. Invalid values are a client
+    error, not a silently-ignored header."""
+    raw = ctx.header("x-request-timeout") or ctx.header("request-timeout")
+    if not raw:
+        return None
+    try:
+        value = float(raw)
+    except ValueError:
+        raise ErrorInvalidParam("X-Request-Timeout") from None
+    return value if value > 0 else None
+
+
 def register_generation_routes(app: Any, engine: Any, prefix: str = "") -> None:
     app.container.serving = engine
     app.on_start(lambda ctx: engine.start())
-    app.on_shutdown(engine.stop)
+    app.on_shutdown(_shutdown_hook(engine))
 
     async def generate(ctx: Any):
         body = ctx.bind(GenerateRequest)
         kw = _validated_generate_kwargs(body)
+        kw["deadline"] = deadline_from_ctx(ctx)
         if body.stream:
             return _sse_response(engine, body.prompt, kw)
         result = await engine.generate(body.prompt, **kw)
@@ -76,14 +102,60 @@ def register_generation_routes(app: Any, engine: Any, prefix: str = "") -> None:
 
 
 def _sse_response(engine: Any, prompt: str, kw: dict) -> WireResponse:
+    # submit EAGERLY, inside the handler, before the 200 head is committed:
+    # admission-time rejections (shed 429 + Retry-After, drain 503) must
+    # reach the client as real statuses retry middleware can key on, not
+    # as error events buried in a 200 stream
+    loop = asyncio.get_running_loop()
+    q: asyncio.Queue = asyncio.Queue()
+
+    def cb(token_id: int, piece: str, done: bool) -> None:
+        loop.call_soon_threadsafe(q.put_nowait, (token_id, piece, done))
+
+    future = engine.submit(prompt, stream_cb=cb, **kw)
+
     async def gen():
         try:
-            async for token_id, piece in engine.stream(prompt, **kw):
+            while True:
+                token_id, piece, done = await q.get()
+                if done:
+                    break
                 payload = json.dumps({"token": token_id, "text": piece})
                 yield f"data: {payload}\n\n".encode()
+            result = await asyncio.wrap_future(future)
+            if result is not None:
+                # terminal event: finish_reason (stop/length/cancel/
+                # deadline_exceeded) + usage, so streaming clients learn WHY
+                # the stream ended, not just that it did
+                yield (
+                    "data: " + json.dumps({
+                        "finish_reason": result.finish_reason,
+                        "usage": {
+                            "prompt_tokens": result.prompt_tokens,
+                            "completion_tokens": result.completion_tokens,
+                        },
+                    }) + "\n\n"
+                ).encode()
             yield b"data: [DONE]\n\n"
         except asyncio.CancelledError:
             raise
+        except HTTPError as exc:
+            # the response head (200, chunked) is already on the wire by
+            # now; a LATE typed error (queued-expiry 504, drain-deadline
+            # 503) becomes a terminal error event instead of a torn
+            # connection — admission errors never reach here, they raised
+            # from the eager submit above with a real status
+            yield (
+                "data: " + json.dumps({
+                    "error": exc.message, "status": exc.status_code,
+                }) + "\n\n"
+            ).encode()
+            yield b"data: [DONE]\n\n"
+        finally:
+            # client disconnected mid-stream (server aclose()s the
+            # generator): free the slot instead of decoding into the void
+            if not future.done():
+                engine.cancel(future.request_id)
 
     return WireResponse(
         headers={
@@ -118,14 +190,19 @@ def register_generation_ws(app: Any, engine: Any, path: str = "/ws/generate") ->
     so registering only the WS surface still serves."""
     app.container.serving = engine
     app.on_start(lambda ctx: engine.start())
-    app.on_shutdown(engine.stop)
+    app.on_shutdown(_shutdown_hook(engine))
 
     async def ws_generate(ctx: Any):
         body = ctx.bind(GenerateRequest)
         kw = _validated_generate_kwargs(body)
+        kw["deadline"] = deadline_from_ctx(ctx)
         n = 0
+        final: dict = {}
         try:
-            async for token_id, piece in engine.stream(body.prompt, **kw):
+            async for token_id, piece in engine.stream(
+                body.prompt, on_result=lambda r: final.setdefault("result", r),
+                **kw,
+            ):
                 n += 1
                 # AWAIT each frame: fire-and-forget sends could reorder
                 # after the final summary frame, and a dead/closed socket
@@ -138,9 +215,27 @@ def register_generation_ws(app: Any, engine: Any, path: str = "/ws/generate") ->
             # routine client departure mid-stream, not a server panic: the
             # stream generator's finally already canceled the request
             return None
-        return {"done": True, "tokens": n}
+        result = final.get("result")
+        summary = {"done": True, "tokens": n}
+        if result is not None:
+            summary["finish_reason"] = result.finish_reason
+        return summary
 
     app.websocket(path, ws_generate)
+
+
+def register_admin_drain(app: Any, path: str = "/.well-known/drain") -> None:
+    """The admin drain trigger: POST flips the app to DRAINING (same path
+    SIGTERM takes — new work rejected with a retriable 503, in-flight
+    generations finish within the drain deadline) and schedules shutdown.
+    NOT registered by default: wire it behind auth middleware — an
+    unauthenticated drain endpoint is a one-request denial of service."""
+
+    async def drain_handler(ctx: Any):
+        app.drain()
+        return {"status": "DRAINING"}
+
+    app.post(path, drain_handler)
 
 
 def register_embedding_routes(app: Any, bert_cfg: Any, bert_params: Any,

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gofr_tpu import chaos
 from gofr_tpu.models.llama import quantize_kv
 from gofr_tpu.native.runtime import BlockAllocator, OutOfBlocks
 
@@ -159,6 +160,7 @@ class PagedKVCache:
         ``seq_lens``."""
         if self._slot_seq[slot] is not None:
             raise KeyError(f"slot {slot} busy")
+        chaos.maybe_fail("kv.alloc")
         self.allocator.alloc(seq_id, max(prompt_len, reserve_tokens or 0))
         table = self.allocator.block_table(seq_id)
         self._slot_seq[slot] = seq_id
@@ -173,6 +175,7 @@ class PagedKVCache:
         assert seq_id is not None
         new_len = int(self.seq_lens[slot]) + 1
         if new_len > self.allocator.seq_length(seq_id):
+            chaos.maybe_fail("kv.alloc")
             self.allocator.extend(seq_id, new_len)
             table = self.allocator.block_table(seq_id)
             self.tables[slot, : len(table)] = table

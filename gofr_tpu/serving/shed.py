@@ -1,0 +1,73 @@
+"""Load shedding: an EWMA queue-wait estimator for admission control.
+
+At saturation the failure mode is not errors but tail-latency collapse:
+requests queue past the point where their answer is useful, then time out
+after holding queue and memory for seconds. The cure (AIBrix, and every
+production serving comparison in PAPERS.md) is to reject *early*: estimate
+how long a new request would wait behind the current queue and, when that
+estimate exceeds the request's own deadline or a configured shed threshold,
+reject in microseconds with 429 + ``Retry-After`` instead of timing out in
+seconds.
+
+The estimate is deliberately cheap — two EWMAs updated on the engine
+thread, one multiply on the submit path:
+
+    wait ≈ (queue_depth / max_slots) × EWMA(request service time)
+
+queue_depth/max_slots is how many admission "waves" stand ahead of this
+request; each wave costs roughly one smoothed request duration. An empty
+queue estimates 0.0 — an idle engine must never shed, even when warm-up
+(compile time) has inflated the service-time EWMA. Bias-corrected EWMAs
+would be overkill: the first observation seeds the average directly, and
+until the first completion the estimator reports 0.0 — shedding blind on
+a cold engine would reject the very traffic that warms it.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class QueueWaitEstimator:
+    """Thread-safe EWMA estimator of queue wait for a slot-based engine."""
+
+    def __init__(self, alpha: float = 0.25) -> None:
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError("alpha must be in (0, 1]")
+        self.alpha = alpha
+        self._mu = threading.Lock()
+        self._ttft_s: float | None = None
+        self._req_s: float | None = None
+
+    def _blend(self, prev: float | None, obs: float) -> float:
+        if prev is None:
+            return obs
+        return prev + self.alpha * (obs - prev)
+
+    def observe_ttft(self, seconds: float) -> None:
+        with self._mu:
+            self._ttft_s = self._blend(self._ttft_s, max(0.0, seconds))
+
+    def observe_request(self, seconds: float) -> None:
+        """One completed request's total service time (submit → terminal)."""
+        with self._mu:
+            self._req_s = self._blend(self._req_s, max(0.0, seconds))
+
+    def estimate_wait(self, queue_depth: int, max_slots: int) -> float:
+        """Predicted seconds a request submitted NOW spends queued behind
+        the ``queue_depth`` requests ahead of it. 0.0 until the first
+        completion (never shed blind) and 0.0 at empty queue (an idle
+        engine never sheds)."""
+        with self._mu:
+            req_s = self._req_s
+        if req_s is None or queue_depth <= 0:
+            return 0.0
+        waves = queue_depth / max(max_slots, 1)
+        return waves * req_s
+
+    def snapshot(self) -> dict[str, float]:
+        with self._mu:
+            return {
+                "ewma_ttft_s": self._ttft_s or 0.0,
+                "ewma_request_s": self._req_s or 0.0,
+            }

@@ -341,6 +341,17 @@ class WSUpgrader:
         match = self.router.lookup("GET", request.path)
         if match is None:
             return False
+        if getattr(self.container, "draining", False):
+            # draining: refuse the upgrade with a retriable 503 BEFORE the
+            # handshake — established sessions keep streaming until the
+            # engine drain deadline, but no new session may start
+            from gofr_tpu.http.responder import draining_response
+            from gofr_tpu.http.server import _serialize_head
+
+            resp = draining_response()
+            writer.write(_serialize_head(resp, chunked=False, keep_alive=False) + resp.body)
+            await writer.drain()
+            return True
         handler, params = match
         request.path_params = params
         client_key = request.header("sec-websocket-key")

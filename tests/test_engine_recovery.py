@@ -222,3 +222,106 @@ def test_donation_discipline_under_churn(kv_dtype, multi_step):
     finally:
         eng.stop()
     assert not errs, errs[:3]
+
+
+# -- cancellation races -------------------------------------------------------
+# Cancel while queued / mid-prefill / mid-stream, each plain and under an
+# injected decode fault (the chaos tier's decode.dispatch point): whatever
+# the interleaving, the request must reach exactly one terminal state and
+# its slot must be reclaimed.
+
+from gofr_tpu import chaos  # noqa: E402
+
+_CANCEL_TERMINAL = ("cancel", "stop", "length")
+
+
+def _await_terminal(fut, with_fault: bool):
+    """Resolve a future under optional fault injection: a normal finish
+    reason, or (only when faults are live) the injected fault itself."""
+    try:
+        res = fut.result(timeout=120)
+        assert res.finish_reason in _CANCEL_TERMINAL, res.finish_reason
+        return res.finish_reason
+    except chaos.ChaosFault:
+        assert with_fault, "ChaosFault leaked without an injector installed"
+        return "fault"
+
+
+def _fault_ctx(with_fault: bool):
+    import contextlib
+
+    if not with_fault:
+        return contextlib.nullcontext()
+    return chaos.active(
+        chaos.ChaosInjector(41, {"decode.dispatch": 0.5}, max_faults=2)
+    )
+
+
+@pytest.mark.parametrize("with_fault", [False, True])
+def test_cancel_while_queued(with_fault):
+    eng = make_engine()
+    with _fault_ctx(with_fault):
+        fut = eng.submit("queued then canceled", max_new_tokens=8)
+        eng.cancel(fut.request_id)  # engine not started: still queued
+        live = eng.submit("live", max_new_tokens=4)  # keeps decode running
+        eng.start()
+        try:
+            assert _await_terminal(fut, with_fault) in ("cancel", "fault")
+            _await_terminal(live, with_fault)
+        finally:
+            eng.stop()
+    assert all(s is None for s in eng.slots)
+
+
+@pytest.mark.parametrize("with_fault", [False, True])
+def test_cancel_mid_prefill(monkeypatch, with_fault):
+    eng = make_engine()
+    box: dict = {}
+    real = batch_ops.prefill_compute
+
+    def cancel_during_prefill(*args, **kw):
+        out = real(*args, **kw)
+        if "fut" in box:  # cancel lands between prefill compute and commit
+            eng.cancel(box["fut"].request_id)
+        return out
+
+    monkeypatch.setattr(batch_ops, "prefill_compute", cancel_during_prefill)
+    with _fault_ctx(with_fault):
+        eng.start()
+        try:
+            box["fut"] = eng.submit("prefill race", max_new_tokens=16)
+            reason = _await_terminal(box["fut"], with_fault)
+            # EOS on the very first token legally wins the race → "stop"
+            assert reason in ("cancel", "stop", "fault")
+        finally:
+            eng.stop()
+    assert all(s is None for s in eng.slots)
+
+
+@pytest.mark.parametrize("with_fault", [False, True])
+def test_cancel_mid_stream(with_fault):
+    eng = make_engine()
+    import threading
+
+    got_token = threading.Event()
+
+    def cb(token_id, piece, done):
+        if not done:
+            got_token.set()
+
+    with _fault_ctx(with_fault):
+        eng.start()
+        try:
+            fut = eng.submit(
+                "stream race pad pad", max_new_tokens=48, stream_cb=cb
+            )
+            # under a decode fault the first token may never arrive — the
+            # future fails instead, which is itself a valid terminal state
+            arrived = got_token.wait(timeout=60)
+            eng.cancel(fut.request_id)
+            reason = _await_terminal(fut, with_fault)
+            if arrived and reason != "fault":
+                assert reason in ("cancel", "stop", "length")
+        finally:
+            eng.stop()
+    assert all(s is None for s in eng.slots)

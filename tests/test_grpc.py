@@ -53,7 +53,9 @@ def test_grpc_end_to_end(engine, run_async):
             frames = []
             async for frame in client.generate_stream("xyz", max_tokens=3):
                 frames.append(frame)
-            assert frames[-1] == {"done": True}
+            # terminal frame now reports WHY the stream ended
+            assert frames[-1]["done"] is True
+            assert frames[-1].get("finish_reason") in ("length", "stop")
             assert 1 <= len(frames) - 1 <= 3
             for f in frames[:-1]:
                 assert "token" in f
@@ -88,3 +90,59 @@ def test_container_injection(engine):
     assert svc.container is None
     server.register(svc)
     assert svc.container is container
+
+
+def test_grpc_lifecycle_error_mapping(run_async):
+    """Shed → RESOURCE_EXHAUSTED (+ retry-delay trailing metadata), drain →
+    UNAVAILABLE via the interceptor, expired-in-queue → DEADLINE_EXCEEDED."""
+    import grpc
+
+    from gofr_tpu.http.errors import (
+        ErrorDeadlineExceeded,
+        ErrorTooManyRequests,
+    )
+
+    class StubEngine:
+        mode = "shed"
+
+        async def generate(self, prompt, **kw):
+            if self.mode == "shed":
+                raise ErrorTooManyRequests(retry_after=2.5)
+            raise ErrorDeadlineExceeded()
+
+    container, _ = new_mock_container()
+    port = get_free_port()
+    server = GRPCServer(container, port, MapConfig({}, use_env=False))
+    stub = StubEngine()
+    server.register(InferenceService(stub))
+
+    async def scenario():
+        await server.start()
+        client = InferenceClient(f"127.0.0.1:{port}")
+        try:
+            with pytest.raises(grpc.aio.AioRpcError) as err:
+                await client.generate("abc")
+            assert err.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+            trailing = {
+                k: v for k, v in (err.value.trailing_metadata() or ())
+            }
+            assert float(trailing["retry-delay-s"]) == pytest.approx(2.5)
+
+            stub.mode = "expired"
+            with pytest.raises(grpc.aio.AioRpcError) as err:
+                await client.generate("abc")
+            assert err.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+
+            # drain: the interceptor rejects BEFORE the handler, but health
+            # keeps answering so orchestrators see NOT_SERVING
+            container.draining = True
+            with pytest.raises(grpc.aio.AioRpcError) as err:
+                await client.echo({"ping": 1})
+            assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+            assert await client.health() is False  # DRAINING → NOT_SERVING
+        finally:
+            container.draining = False
+            await client.close()
+            await server.shutdown(grace=0.5)
+
+    run_async(scenario())

@@ -137,3 +137,83 @@ def test_auth_and_header_options(backend):
     svc2.get("keyed")
     lower = {k.lower(): v for k, v in _Handler.calls[-1][2].items()}
     assert lower["x-api-key"] == "secret-key"
+
+
+# -- retry backoff: exponential + full jitter + max-elapsed -------------------
+
+class _FakeInner:
+    """Scripted inner client: pops (status | Exception) per request."""
+
+    address = "fake"
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = 0
+
+    def request(self, method, path, **kw):
+        self.calls += 1
+        item = self.script.pop(0) if self.script else 200
+        if isinstance(item, Exception):
+            raise item
+        status, headers = item if isinstance(item, tuple) else (item, {})
+        from gofr_tpu.service.client import ServiceResponse
+
+        return ServiceResponse(status, headers, b"{}")
+
+
+def test_retry_delay_is_exponential_and_capped():
+    retry = RetryConfig(
+        max_retries=5, backoff=1.0, multiplier=2.0, max_backoff=5.0,
+        jitter=False,
+    ).add_option(_FakeInner([]))
+    assert retry._delay(1, None) == 1.0
+    assert retry._delay(2, None) == 2.0
+    assert retry._delay(3, None) == 4.0
+    assert retry._delay(4, None) == 5.0  # capped at max_backoff
+
+
+def test_retry_full_jitter_desynchronizes():
+    retry = RetryConfig(max_retries=3, backoff=1.0, jitter=True).add_option(
+        _FakeInner([])
+    )
+    retry._rng.seed(7)
+    delays = [retry._delay(3, None) for _ in range(32)]
+    # full jitter: uniform over [0, 4] — spread out, never above the window
+    assert all(0.0 <= d <= 4.0 for d in delays)
+    assert max(delays) - min(delays) > 1.0
+
+
+def test_retry_honors_retry_after_hint():
+    retry = RetryConfig(max_retries=3, backoff=0.001, jitter=False).add_option(
+        _FakeInner([])
+    )
+    assert retry._delay(1, 0.5) == 0.5  # server hint outranks tiny backoff
+    assert retry._delay(1, 99.0) == retry.cfg.max_backoff  # but stays capped
+
+
+def test_retry_max_elapsed_stops_the_ladder():
+    inner = _FakeInner([ConnectionError("down")] * 100)
+    retry = RetryConfig(
+        max_retries=50, backoff=0.05, multiplier=1.0, jitter=False,
+        max_elapsed=0.12,
+    ).add_option(inner)
+    start = time.monotonic()
+    with pytest.raises(ConnectionError):
+        retry.request("GET", "x")
+    assert time.monotonic() - start < 2.0
+    assert inner.calls < 10  # the budget, not max_retries, ended the ladder
+
+
+def test_retry_429_with_retry_after_header():
+    inner = _FakeInner([(429, {"Retry-After": "0.01"}), 200])
+    retry = RetryConfig(max_retries=2, backoff=0.001, jitter=False).add_option(inner)
+    resp = retry.request("GET", "x")
+    assert resp.status_code == 200
+    assert inner.calls == 2  # 429 is retriable backpressure, not a client bug
+
+
+def test_retry_does_not_retry_plain_4xx():
+    inner = _FakeInner([404, 200])
+    retry = RetryConfig(max_retries=3, backoff=0.0).add_option(inner)
+    assert retry.request("GET", "x").status_code == 404
+    assert inner.calls == 1
