@@ -27,6 +27,15 @@ Rules
     Every ctypes call into the native layer returns a status code;
     discarding it turns a C-side failure (bad handle, OOM) into silent
     corruption. Calls whose result is not consumed are flagged.
+``pubsub-manual-settle``
+    Subscriber handlers registered via ``app.subscribe(topic, handler)``
+    are settled by the framework loop (commit on success, nack/DLQ on
+    failure — subscriber.py). A handler that ALSO calls ``commit()``/
+    ``nack()`` on its message rides on settle idempotency at best and
+    fights the delivery policy at worst (a handler-committed message can
+    no longer be nacked into the retry/DLQ ladder). Cross-file: handler
+    registrations are collected everywhere, settle calls inside those
+    functions are flagged.
 
 Blocking/host-sync checks skip nested (closure) functions: closures in
 these zones are deferred work — thread targets and
@@ -331,10 +340,104 @@ class MetricsRule(Rule):
         return out
 
 
+class PubSubManualSettleRule(Rule):
+    """Cross-file: collect subscriber-handler registrations
+    (``*.subscribe(topic, handler)`` and
+    ``*subscription_manager.register(topic, handler)``) everywhere, flag
+    ``commit()``/``nack()`` calls inside those handler functions in
+    finalize. The commit check is receiver-filtered (``ctx.request`` /
+    ``msg``-ish names) so ``ctx.sql.commit()`` stays clean; ``nack`` is
+    pubsub-only vocabulary and flags on any receiver.
+
+    Handlers are matched by bare function/attribute name (an AST lint
+    cannot resolve cross-module references) — an unrelated function that
+    shares a registered handler's name and settles messages legitimately
+    is a known false positive; suppress it with a reason, like every
+    other finding in this suite (fix-or-justify)."""
+
+    name = "pubsub-manual-settle"
+    cross_file = True
+
+    _MSGISH = {"msg", "message", "request"}
+
+    def __init__(self) -> None:
+        self._handlers: set[str] = set()
+        # (enclosing function, path, line, method)
+        self._sites: list[tuple[str, str, int, str]] = []
+
+    @staticmethod
+    def _handler_name(node: ast.expr) -> str | None:
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            return node.attr  # e.g. worker.handler → "handler"
+        return None
+
+    def _is_registration(self, call: ast.Call) -> bool:
+        func = call.func
+        if not isinstance(func, ast.Attribute) or len(call.args) < 2:
+            return False
+        if func.attr == "subscribe":
+            # registration takes (topic, handler); a driver's one-arg
+            # subscribe(topic) never gets here because of the arg count
+            return True
+        if func.attr == "register":
+            recv = (_dotted(func.value) or "").rsplit(".", 1)[-1]
+            return recv in ("subscription_manager", "manager", "mgr")
+        return False
+
+    def _settle_method(self, call: ast.Call) -> str | None:
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return None
+        if func.attr == "nack":
+            return "nack"
+        if func.attr == "commit" and not call.args and not call.keywords:
+            recv = _dotted(func.value)
+            if recv is None:
+                return None
+            parts = recv.split(".")
+            if parts[-1] in self._MSGISH:
+                return "commit"
+        return None
+
+    def visit_file(self, sf: SourceFile) -> list[Finding]:
+        visitor = _FunctionCalls()
+        visitor.visit(sf.tree)
+        for call, func_name, _depth in visitor.calls:
+            if self._is_registration(call):
+                name = self._handler_name(call.args[1])
+                if name:
+                    self._handlers.add(name)
+                continue
+            method = self._settle_method(call)
+            if (
+                method is not None
+                and func_name is not None
+                and not sf.is_suppressed(self.name, call.lineno)
+            ):
+                self._sites.append((func_name, sf.rel_path, call.lineno, method))
+        return []
+
+    def finalize(self) -> list[Finding]:
+        return [
+            Finding(
+                self.name, path, line,
+                f"subscriber handler '{func}' calls .{method}() itself — the "
+                "framework loop settles every delivered message (commit on "
+                "success, nack/DLQ on failure); drop the manual settle or "
+                "suppress with a reason",
+            )
+            for func, path, line, method in self._sites
+            if func in self._handlers
+        ]
+
+
 def default_rules() -> list[Rule]:
     from gofr_tpu.analysis.shardcheck import shardcheck_rules
 
     return [
         BlockingCallRule(), HostSyncRule(), CtypesCheckedRule(), MetricsRule(),
+        PubSubManualSettleRule(),
         *shardcheck_rules(),
     ]

@@ -36,6 +36,9 @@ POINTS = (
     "kv.alloc",         # paged-KV pool allocation / extension
     "service.request",  # outbound HTTP service client
     "pubsub.publish",   # pubsub publish
+    "pubsub.subscribe",  # consumer-loop poll (broker fetch)
+    "pubsub.ack",       # message settlement (commit / nack)
+    "pubsub.handler",   # subscriber handler invocation
 )
 
 

@@ -107,6 +107,27 @@ class Container:
         m.new_counter("app_pubsub_publish_success_count", "Number of successful publish operations")
         m.new_counter("app_pubsub_subscribe_total_count", "Number of total subscribe operations")
         m.new_counter("app_pubsub_subscribe_success_count", "Number of successful subscribe operations")
+        # delivery-reliability plane (docs/datasources.md "Delivery semantics")
+        m.new_counter(
+            "app_pubsub_commit_fail_count",
+            "Commits that failed after a successful handler run (the broker redelivers)",
+        )
+        m.new_counter(
+            "app_pubsub_redeliveries_total",
+            "Messages delivered more than once to this consumer group",
+        )
+        m.new_counter(
+            "app_pubsub_dlq_total",
+            "Messages dead-lettered after exhausting their delivery budget",
+        )
+        m.new_gauge(
+            "app_pubsub_consumer_lag",
+            "Undelivered backlog behind this consumer group, per topic",
+        )
+        m.new_histogram(
+            "app_pubsub_handler_duration_seconds",
+            "Subscriber handler execution time",
+        )
         # TPU serving metrics (SURVEY §5.5)
         m.new_gauge("app_tpu_hbm_used_bytes", "HBM bytes in use per device")
         m.new_gauge("app_tpu_hbm_limit_bytes", "HBM capacity per device")

@@ -16,6 +16,13 @@ from gofr_tpu.container.datasources import iter_health_checkers
 def aggregate_health(container: Any) -> dict[str, Any]:
     details: dict[str, Any] = iter_health_checkers(container.datasource_pairs())
 
+    manager = getattr(container, "subscription_manager", None)
+    if manager is not None and getattr(manager, "subscriptions", None):
+        try:
+            details["pubsub_consumers"] = manager.health()
+        except Exception as exc:
+            details["pubsub_consumers"] = {"status": "DOWN", "error": str(exc)}
+
     serving = getattr(container, "serving", None)
     if serving is not None and hasattr(serving, "health_check"):
         try:

@@ -9,6 +9,7 @@ the full contract (consumer groups, commits, backlog) and external drivers
 plug in behind the same interface.
 """
 
+from gofr_tpu.datasource.pubsub.delivery import DeliveryPolicy, dlq_topic
 from gofr_tpu.datasource.pubsub.kafka import KafkaClient
 from gofr_tpu.datasource.pubsub.message import Message
 from gofr_tpu.datasource.pubsub.memory import InMemoryBroker
@@ -45,4 +46,7 @@ def build_pubsub(config):
     raise ValueError(f"unknown PUBSUB_BACKEND {backend!r}")
 
 
-__all__ = ["Message", "InMemoryBroker", "KafkaClient", "build_pubsub"]
+__all__ = [
+    "Message", "InMemoryBroker", "KafkaClient", "build_pubsub",
+    "DeliveryPolicy", "dlq_topic",
+]

@@ -464,11 +464,25 @@ class EventHubClient:
                 self._send_raw(wire.encode_frame(0, disp))
                 self._grant_credit(lk, 100)
 
+            def _nack(requeue: bool, did: int = delivery_id, lk: _Link = link) -> None:
+                # AMQP 1.0 §3.4: RELEASED returns the delivery to the node
+                # for redelivery; drop settles with ACCEPTED (the Event Hub
+                # checkpoint model has no per-message poison slot)
+                if not requeue:
+                    _commit(did, lk)
+                    return
+                disp = Described(wire.DISPOSITION, [
+                    True, Uint(did), Uint(did), True,
+                    Described(wire.RELEASED, []),
+                ])
+                self._send_raw(wire.encode_frame(0, disp))
+                self._grant_credit(lk, 100)
+
             if self._metrics:
                 self._metrics.increment_counter(
                     "app_pubsub_subscribe_success_count", topic=topic
                 )
-            return Message(topic, body, metadata, committer=_commit)
+            return Message(topic, body, metadata, committer=_commit, nacker=_nack)
         return None
 
     def create_topic(self, name: str) -> None:

@@ -204,11 +204,28 @@ class GooglePubSubClient:
                 MESSAGES[f"{_P}.AcknowledgeRequest"](subscription=sub, ack_ids=[ack_id]),
             )
 
+        def _nack(requeue: bool) -> None:
+            if requeue:
+                # the native Pub/Sub nack: ack deadline 0 = redeliver now
+                self._call(
+                    "Subscriber.ModifyAckDeadline",
+                    MESSAGES[f"{_P}.ModifyAckDeadlineRequest"](
+                        subscription=sub, ack_ids=[ack_id],
+                        ack_deadline_seconds=0,
+                    ),
+                )
+            else:
+                _commit()
+
         return Message(
             topic=topic,
             value=bytes(rm.message.data),
             metadata=dict(rm.message.attributes),
             committer=_commit,
+            nacker=_nack,
+            # broker-assigned PubsubMessage.message_id is stable across
+            # redeliveries (unlike the per-delivery ack_id)
+            message_id=str(rm.message.message_id) or None,
         )
 
     # -- admin / health ----------------------------------------------------

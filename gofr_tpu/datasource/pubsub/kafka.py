@@ -187,13 +187,28 @@ class KafkaClient:
         if key and "key" not in metadata:
             metadata["key"] = key.decode("utf-8", "replace")
         # NOTE: the subscribe/commit counters are recorded by the framework
-        # subscriber loop (subscriber.py:79,93) — counting here too would
+        # subscriber loop (subscriber.py) — counting here too would
         # double every consumed message
+        def _nack(requeue: bool, t: str = topic, o: int = offset) -> None:
+            # Kafka's wire protocol has no per-message nack: emulate by
+            # holding the offset. requeue → rewind the local position to the
+            # nacked message and drop everything buffered past it, so the
+            # next fetch redelivers from here; drop → commit past it.
+            if requeue:
+                buf2 = self._buffers.get(t)
+                if buf2 is not None:
+                    buf2.clear()
+                self._positions[t] = o
+            else:
+                self._commit(t, o + 1)
+
         return Message(
             topic=topic,
             value=value,
             metadata=metadata,
             committer=lambda: self._commit(topic, offset + 1),
+            nacker=_nack,
+            message_id=str(offset),
         )
 
     def _fetch_into(self, topic: str, buf: deque) -> None:

@@ -81,7 +81,17 @@ class InMemoryBroker:
                     self._offsets[key] = idx + 1
                     self._pending.pop(key, None)
 
-            return Message(topic=topic, value=value, metadata=metadata, committer=_commit)
+            def _nack(requeue: bool, idx: int = offset) -> None:
+                if requeue:
+                    # leave the pending marker: the next subscribe call
+                    # redelivers this offset (the at-least-once contract)
+                    return
+                _commit(idx)  # drop = advance past it without processing
+
+            return Message(
+                topic=topic, value=value, metadata=metadata,
+                committer=_commit, nacker=_nack, message_id=str(offset),
+            )
 
     # -- topic admin (kafka.go topic create/delete) ----------------------------
     def create_topic(self, name: str) -> None:

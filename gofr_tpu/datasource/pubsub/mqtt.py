@@ -353,16 +353,32 @@ class MQTTClient:
 
     def _make_message(self, topic: str, payload: bytes, qos: int, pid: int) -> Message:
         def _commit() -> None:
+            # a failed PUBACK send must RAISE: the subscriber loop counts
+            # commit failures distinctly and must not count a success (the
+            # broker will redeliver the unacked message as DUP)
             if qos > 0:
-                try:
-                    self._send(packet(PUBACK, 0, struct.pack(">H", pid)))
-                except (MQTTError, OSError):
-                    pass  # broker redelivers; at-least-once holds
+                self._send(packet(PUBACK, 0, struct.pack(">H", pid)))
+
+        def _nack(requeue: bool) -> None:
+            # MQTT 3.1.1 has no negative ack (the broker only redelivers
+            # DUP after reconnect): emulate requeue by re-enqueueing into
+            # the local inbox so a later subscribe() delivers it again;
+            # drop = PUBACK without processing.
+            if requeue:
+                with self._inbox_cv:
+                    self._inbox.append((topic, payload, qos, pid))
+                    self._inbox_cv.notify_all()
+            else:
+                _commit()
 
         if self._metrics:
             self._metrics.increment_counter("app_pubsub_subscribe_total_count", topic=topic)
+        # the packet id is stable across redeliveries (broker resends with
+        # DUP under the same pid; local re-enqueue keeps it) — but only
+        # QoS>0 carries one
         return Message(topic=topic, value=payload, metadata={"qos": str(qos)},
-                       committer=_commit)
+                       committer=_commit, nacker=_nack,
+                       message_id=str(pid) if qos > 0 else None)
 
     def create_topic(self, name: str) -> None:
         pass  # MQTT topics are implicit

@@ -272,7 +272,16 @@ class NatsClient:
             if reply:
                 self.publish(reply, b"+ACK")
 
-        return Message(topic=subject, value=body, metadata=headers, committer=_commit)
+        def _nack(requeue: bool) -> None:
+            # JetStream-style negative ack: -NAK asks for immediate
+            # redelivery, +TERM stops delivery of the message for good
+            if reply:
+                self.publish(reply, b"-NAK" if requeue else b"+TERM")
+
+        return Message(
+            topic=subject, value=body, metadata=headers,
+            committer=_commit, nacker=_nack,
+        )
 
     # -- admin / health ----------------------------------------------------
     def create_topic(self, name: str) -> None:

@@ -329,6 +329,10 @@ class _ConnState:
     def _disposition(self, fields: list) -> None:
         first = int(fields[1])
         last = int(fields[2]) if len(fields) > 2 and fields[2] is not None else first
+        state = fields[4] if len(fields) > 4 else None
+        released = (
+            isinstance(state, Described) and state.descriptor == wire.RELEASED
+        )
         with self.server._cond:
             for link in self._receivers.values():
                 for did in range(first, last + 1):
@@ -336,8 +340,14 @@ class _ConnState:
                     if offset is None:
                         continue
                     part = self.server._topics[link.topic][link.partition]
-                    prev = part.acked.get(link.group, 0)
-                    part.acked[link.group] = max(prev, offset + 1)
+                    if released:
+                        # AMQP RELEASED: the delivery goes back to the node —
+                        # rewind the group cursor so the pump redelivers it
+                        cur = part.cursors.get(link.group, 0)
+                        part.cursors[link.group] = min(cur, offset)
+                    else:
+                        prev = part.acked.get(link.group, 0)
+                        part.acked[link.group] = max(prev, offset + 1)
             self.server._cond.notify_all()
 
     # -- delivery ----------------------------------------------------------

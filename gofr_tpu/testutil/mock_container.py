@@ -65,12 +65,12 @@ class MockPubSub:
     """In-memory broker fake with published-message capture."""
 
     def __init__(self) -> None:
-        self.published: list[tuple[str, bytes]] = []
+        self.published: list[tuple[str, bytes, dict]] = []
         self.queues: dict[str, list] = {}
 
-    def publish(self, topic: str, message: bytes) -> None:
-        self.published.append((topic, message))
-        self.queues.setdefault(topic, []).append(message)
+    def publish(self, topic: str, message: bytes, metadata: dict | None = None) -> None:
+        self.published.append((topic, message, metadata or {}))
+        self.queues.setdefault(topic, []).append((message, metadata or {}))
 
     def subscribe(self, topic: str) -> Any:
         from gofr_tpu.datasource.pubsub.message import Message
@@ -78,7 +78,13 @@ class MockPubSub:
         queue = self.queues.setdefault(topic, [])
         if not queue:
             return None
-        return Message(topic=topic, value=queue.pop(0))
+        value, metadata = queue.pop(0)
+
+        def _nack(requeue: bool) -> None:
+            if requeue:  # head of the queue: redelivered next subscribe
+                queue.insert(0, (value, metadata))
+
+        return Message(topic=topic, value=value, metadata=metadata, nacker=_nack)
 
     def create_topic(self, name: str) -> None:
         self.queues.setdefault(name, [])

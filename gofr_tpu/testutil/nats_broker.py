@@ -126,11 +126,21 @@ class MiniNatsBroker:
 
     def _route(self, subject: str, headers: dict, body: bytes,
                redelivered: bool = False) -> None:
+        nak_pending: _Pending | None = None
         with self._lock:
-            # ack inboxes bypass group delivery
+            # ack inboxes bypass group delivery: +ACK/+TERM settle, -NAK
+            # asks for immediate redelivery (the JetStream ack vocabulary)
             if subject.startswith("_ACK."):
-                self._pending.pop(subject, None)
-                return
+                p = self._pending.pop(subject, None)
+                if p is not None and body.strip() == b"-NAK":
+                    nak_pending = p
+                else:
+                    return
+        if nak_pending is not None:
+            self._route(nak_pending.subject, nak_pending.headers,
+                        nak_pending.body, redelivered=True)
+            return
+        with self._lock:
             by_group: dict[str, list[_Subscription]] = {}
             plain: list[_Subscription] = []
             for s in self._subs:

@@ -247,6 +247,83 @@ def test_ffi_detects_stale_binding(tmp_path):
     assert [f.rule for f in findings] == ["ffi-stale"]
 
 
+# ------------------------------------------------------ pubsub manual settle
+def test_manual_settle_in_registered_handler_flagged(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/worker.py": (
+            "def on_job(ctx):\n"
+            "    ctx.request.commit()\n",
+        )[0],
+        "gofr_tpu/wiring.py": (
+            "def wire(app):\n"
+            "    app.subscribe('jobs', on_job)\n"
+        ),
+    })
+    assert [f.rule for f in findings] == ["pubsub-manual-settle"]
+    assert findings[0].path.endswith("worker.py") and findings[0].line == 2
+
+
+def test_manual_nack_flagged_on_any_receiver(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/worker.py": (
+            "def on_job(ctx):\n"
+            "    thing = ctx.request\n"
+            "    thing.nack(True)\n"
+            "def wire(mgr):\n"
+            "    mgr.register('jobs', on_job)\n"
+        ),
+    })
+    assert [f.rule for f in findings] == ["pubsub-manual-settle"]
+
+
+def test_settle_outside_registered_handler_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/worker.py": (
+            "def framework_loop(msg):\n"
+            "    msg.commit()\n"  # the loop itself settles — not a handler
+        ),
+    })
+    assert findings == []
+
+
+def test_sql_commit_in_handler_is_not_a_settle(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/worker.py": (
+            "def on_job(ctx):\n"
+            "    ctx.sql.commit()\n"  # transaction commit, not message settle
+            "def wire(app):\n"
+            "    app.subscribe('jobs', on_job)\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_manual_settle_suppressible_with_reason(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/worker.py": (
+            "def on_job(ctx):\n"
+            "    ctx.request.commit()  # gofrlint: disable=pubsub-manual-settle"
+            " -- commit-before-side-effect wanted here\n"
+            "def wire(app):\n"
+            "    app.subscribe('jobs', on_job)\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_method_reference_handler_flagged(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/worker.py": (
+            "class Worker:\n"
+            "    def handle(self, ctx):\n"
+            "        ctx.request.nack(False)\n"
+            "def wire(app, w):\n"
+            "    app.subscribe('jobs', w.handle)\n"
+        ),
+    })
+    assert [f.rule for f in findings] == ["pubsub-manual-settle"]
+
+
 # ---------------------------------------------------------------- real tree
 def test_real_tree_is_clean():
     """The acceptance bar: gofrlint exits 0 on the repo itself."""

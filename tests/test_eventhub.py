@@ -273,3 +273,37 @@ def test_publish_respects_link_credit(server):
         assert link.credit == before - 40
     finally:
         client.close()
+
+
+def test_nack_requeue_releases_the_delivery(server):
+    """AMQP RELEASED disposition returns the delivery to the node: the
+    broker rewinds the group cursor and redelivers."""
+    client = make_client(server)
+    try:
+        client.publish("hub", b"flaky-job")
+        msg = _poll(client, "hub")
+        assert msg is not None and msg.value == b"flaky-job"
+        msg.nack(True)
+        again = _poll(client, "hub")
+        assert again is not None and again.value == b"flaky-job"
+        again.commit()
+        assert _poll(client, "hub", timeout=0.5) is None
+    finally:
+        client.close()
+
+
+def test_nack_drop_checkpoints_past_the_message(server):
+    client = make_client(server)
+    try:
+        client.publish("hub", b"poison")
+        msg = _poll(client, "hub")
+        assert msg is not None
+        msg.nack(False)  # ACCEPTED: checkpoint advances
+        assert _poll(client, "hub", timeout=0.5) is None
+    finally:
+        client.close()
+    c2 = make_client(server)
+    try:
+        assert _poll(c2, "hub", timeout=0.5) is None  # not redelivered
+    finally:
+        c2.close()

@@ -187,3 +187,39 @@ def test_subscriber_loop_end_to_end(server, run_async):
             client.close()
 
     run_async(scenario())
+
+
+def test_nack_requeue_via_zero_ack_deadline(server):
+    """The native Pub/Sub nack: ModifyAckDeadline(0) → immediate
+    redelivery; drop acknowledges."""
+    c = make_client(server, group="nackers")
+    try:
+        c.create_topic("retry")
+        c.subscribe("retry")
+        c.publish("retry", b"try-again")
+        msg = c.subscribe("retry")
+        assert msg is not None and msg.value == b"try-again"
+        msg.nack(True)
+        deadline = time.time() + 5
+        again = None
+        while again is None and time.time() < deadline:
+            again = c.subscribe("retry")
+        assert again is not None and again.value == b"try-again"
+        again.commit()
+        assert c.backlog("retry") == 0
+    finally:
+        c.close()
+
+
+def test_nack_drop_acknowledges(server):
+    c = make_client(server, group="droppers")
+    try:
+        c.create_topic("dropt")
+        c.subscribe("dropt")
+        c.publish("dropt", b"dead")
+        msg = c.subscribe("dropt")
+        assert msg is not None
+        msg.nack(False)
+        assert c.backlog("dropt") == 0
+    finally:
+        c.close()

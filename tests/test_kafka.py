@@ -338,3 +338,52 @@ class TestRecordBatchV2:
             assert broker.log("t2") == []
         finally:
             sock.close()
+
+
+class TestNack:
+    def test_nack_requeue_redelivers_from_held_offset(self, broker):
+        c = make_client(broker, group="nack-rq")
+        try:
+            c.publish("jobs", b"j1")
+            c.publish("jobs", b"j2")
+            m1 = c.subscribe("jobs")
+            assert m1.value == b"j1"
+            m1.nack(True)  # offset-hold emulation: rewind + drop the buffer
+            again = c.subscribe("jobs")
+            assert again is not None and again.value == b"j1"
+            again.commit()
+            m2 = c.subscribe("jobs")
+            assert m2 is not None and m2.value == b"j2"
+            m2.commit()
+        finally:
+            c.close()
+
+    def test_nack_drop_commits_past_the_message(self, broker):
+        c = make_client(broker, group="nack-drop")
+        try:
+            c.publish("drops", b"poison")
+            c.publish("drops", b"fine")
+            c.subscribe("drops").nack(False)
+            nxt = c.subscribe("drops")
+            assert nxt is not None and nxt.value == b"fine"
+            nxt.commit()
+        finally:
+            c.close()
+        # a fresh client in the same group resumes past BOTH messages:
+        # the drop was committed broker-side, not just skipped locally
+        c2 = make_client(broker, group="nack-drop")
+        try:
+            assert c2.subscribe("drops") is None
+        finally:
+            c2.close()
+
+    def test_nack_is_idempotent_after_commit(self, broker):
+        c = make_client(broker, group="nack-idem")
+        try:
+            c.publish("idem", b"x")
+            m = c.subscribe("idem")
+            m.commit()
+            m.nack(True)  # settled: no rewind happens
+            assert c.subscribe("idem") is None
+        finally:
+            c.close()

@@ -205,3 +205,38 @@ class TestSubscriberIntegration:
                 loop.call_soon_threadsafe(stop_ev.set)
             t.join(timeout=10)
             driver.close()
+
+
+class TestNack:
+    def test_nack_requeue_redelivers_locally(self, broker):
+        c = make_client(broker, client_id="nack-rq")
+        try:
+            c.subscribe("retries")  # establish the subscription first
+            c.publish("retries", b"again-please")
+            msg = None
+            deadline = time.monotonic() + 5
+            while msg is None and time.monotonic() < deadline:
+                msg = c.subscribe("retries")
+            assert msg is not None
+            msg.nack(True)  # 3.1.1 has no negative ack: local re-enqueue
+            again = c.subscribe("retries")
+            assert again is not None and again.value == b"again-please"
+            again.commit()
+            assert c.subscribe("retries") is None
+        finally:
+            c.close()
+
+    def test_nack_drop_pubacks(self, broker):
+        c = make_client(broker, client_id="nack-drop")
+        try:
+            c.subscribe("drops")  # establish the subscription first
+            c.publish("drops", b"gone")
+            msg = None
+            deadline = time.monotonic() + 5
+            while msg is None and time.monotonic() < deadline:
+                msg = c.subscribe("drops")
+            assert msg is not None
+            msg.nack(False)  # PUBACK without processing
+            assert c.subscribe("drops") is None
+        finally:
+            c.close()

@@ -188,3 +188,42 @@ def test_connection_loss_is_visible_and_recoverable():
     finally:
         c.close()
         b2.close()
+
+
+def test_nack_requeue_redelivers_immediately(broker):
+    c = make_client(broker, group="nak")
+    try:
+        c.subscribe("nak.tasks")  # establish the queue-group subscription
+        pub = make_client(broker, group="nak-pub")
+        try:
+            pub.publish("nak.tasks", b"retry-me")
+        finally:
+            pub.close()
+        msg = _poll(c, "nak.tasks")
+        assert msg is not None
+        msg.nack(True)  # -NAK on the ack inbox: immediate redelivery
+        again = _poll(c, "nak.tasks", timeout=2.0)  # well under ack_wait retry
+        assert again is not None and again.value == b"retry-me"
+        assert again.metadata.get("Nats-Redelivered") == "true"
+        again.commit()
+        # committed: no further redelivery inside the ack window
+        assert _poll(c, "nak.tasks", timeout=1.2) is None
+    finally:
+        c.close()
+
+
+def test_nack_drop_settles_without_redelivery(broker):
+    c = make_client(broker, group="term")
+    try:
+        c.subscribe("nak.dead")
+        pub = make_client(broker, group="term-pub")
+        try:
+            pub.publish("nak.dead", b"drop-me")
+        finally:
+            pub.close()
+        msg = _poll(c, "nak.dead")
+        assert msg is not None
+        msg.nack(False)  # +TERM: settled for good
+        assert _poll(c, "nak.dead", timeout=1.2) is None  # past ack_wait: no retry
+    finally:
+        c.close()

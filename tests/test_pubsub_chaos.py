@@ -1,0 +1,310 @@
+"""The consumer-plane chaos tier (``make chaos``, docs/robustness.md).
+
+Fixed-seed fault schedules at the new pubsub injection points —
+``pubsub.subscribe`` (broker poll), ``pubsub.ack`` (settlement),
+``pubsub.handler`` (handler invocation) — driving a real subscriber
+workload over the memory broker AND the kafka wire driver, asserting the
+**delivery invariant**:
+
+    every published message is either successfully handled (once or more)
+    and committed, or lands in ``<topic>.dlq`` with its full attempt
+    history — never lost, never looping.
+
+A chaos fault at ``pubsub.handler`` fails the delivery like a handler bug
+would, so under the schedule a non-poison message may legitimately exhaust
+its budget and dead-letter — that still satisfies the invariant (the DLQ
+entry carries the history); what may never happen is a message vanishing
+or redelivering forever.
+
+Seeds are FIXED: a red run reproduces with ``pytest
+tests/test_pubsub_chaos.py -k <seed>`` every time. Add seeds, never
+rotate them.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from gofr_tpu import chaos
+from gofr_tpu.datasource.pubsub import InMemoryBroker
+from gofr_tpu.datasource.pubsub.delivery import (
+    DLQ_ATTEMPTS_KEY,
+    DLQ_ERROR_KEY,
+    DLQ_SOURCE_TOPIC_KEY,
+    DLQ_SUFFIX,
+)
+from gofr_tpu.subscriber import STOPPED, SubscriptionManager
+from gofr_tpu.testutil import new_mock_container
+
+CHAOS_SEEDS = (101, 202, 303)
+N_MESSAGES = 12
+MAX_ATTEMPTS = 3
+
+# fault schedule: every consumer-plane seam fires, budget-bounded so the
+# workload converges (the injector goes quiet once the budget is spent)
+RATES = {
+    "pubsub.subscribe": 0.10,
+    "pubsub.ack": 0.10,
+    "pubsub.handler": 0.25,
+}
+
+
+def _configs() -> dict[str, str]:
+    return {
+        "PUBSUB_MAX_ATTEMPTS": str(MAX_ATTEMPTS),
+        "PUBSUB_RETRY_BACKOFF_SECONDS": "0.01",
+        "PUBSUB_RETRY_MAX_BACKOFF_SECONDS": "0.05",
+    }
+
+
+def _spy_dlq_publishes(client) -> list[bytes]:
+    """Record every dead-letter publish that went through (post-chaos):
+    accounting that works identically for the memory and kafka drivers."""
+    dlq_published: list[bytes] = []
+    real_publish = client.publish
+
+    def spying_publish(topic, value, metadata=None):
+        real_publish(topic, value, metadata)
+        if topic.endswith(DLQ_SUFFIX):
+            dlq_published.append(bytes(value))
+
+    client.publish = spying_publish
+    return dlq_published
+
+
+async def _run_workload(client, manager, topic: str,
+                        handled: dict[bytes, int], dlq_published: list[bytes],
+                        timeout: float = 90.0) -> list[bytes]:
+    """Publish N messages, consume under faults, wait until every message
+    is accounted for: handled at least once OR dead-lettered."""
+    payloads = [f"msg-{i}".encode() for i in range(N_MESSAGES)]
+    for p in payloads:
+        # publishes happen OUTSIDE the fault schedule's reach — this suite
+        # targets the consumer plane (pubsub.publish is covered elsewhere)
+        client.publish(topic, p)
+
+    await manager.start()
+    try:
+        deadline = time.monotonic() + timeout
+
+        def settled() -> bool:
+            return all(
+                handled.get(p, 0) >= 1 or p in dlq_published for p in payloads
+            )
+
+        while time.monotonic() < deadline and not settled():
+            await asyncio.sleep(0.02)
+        consumer = manager._consumers[topic]
+        assert settled(), (
+            f"delivery invariant broken — unaccounted messages: "
+            f"{[p for p in payloads if not handled.get(p) and p not in dlq_published]} "
+            f"(state={consumer.state}, dlq={consumer.dlq}, "
+            f"redeliveries={consumer.redeliveries})"
+        )
+        # let in-flight settlement (final commits) finish before stop
+        await asyncio.sleep(0.1)
+    finally:
+        await manager.stop()
+    return payloads
+
+
+def _assert_invariant(payloads, handled, poison, dlq_published, dlq_messages,
+                      consumer, topic: str):
+    # zero lost: every message is handled once-or-more or dead-lettered
+    for p in payloads:
+        assert handled.get(p, 0) >= 1 or p in dlq_published, f"{p!r} was lost"
+    # a poison message can never be "handled" — it MUST be in the DLQ
+    dlq_values = [m.value for m in dlq_messages]
+    for p in poison:
+        assert p not in handled
+        assert p in dlq_values, f"poison {p!r} never dead-lettered"
+    # every DLQ entry carries its full attempt history
+    for m in dlq_messages:
+        assert m.metadata[DLQ_SOURCE_TOPIC_KEY] == topic
+        assert int(m.metadata[DLQ_ATTEMPTS_KEY]) >= MAX_ATTEMPTS
+        assert m.metadata[DLQ_ERROR_KEY]
+        first = float(m.metadata["gofr_dlq_first_delivery_ts"])
+        last = float(m.metadata["gofr_dlq_last_delivery_ts"])
+        assert first <= last
+    # zero infinitely-redelivered: deliveries per message are bounded by
+    # the policy budget plus the (budget-bounded) injected faults
+    total_deliveries = sum(handled.values()) + sum(
+        int(m.metadata[DLQ_ATTEMPTS_KEY]) for m in dlq_messages
+    )
+    assert total_deliveries <= N_MESSAGES * (MAX_ATTEMPTS + 4), (
+        f"redelivery hot loop: {total_deliveries} deliveries"
+    )
+    # the consumer survived the storm: parked would mean the restart
+    # budget was spent on what should be absorbable faults
+    assert consumer.state == STOPPED and not consumer.parked
+
+
+def _drain_dlq(client, topic: str) -> list:
+    out = []
+    misses = 0
+    while misses < 3:  # wire drivers may need a fetch round-trip or two
+        m = client.subscribe(topic + DLQ_SUFFIX)
+        if m is None:
+            misses += 1
+            continue
+        m.commit()
+        out.append(m)
+    return out
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_delivery_invariant_memory_driver(seed, run_async):
+    container, _ = new_mock_container(_configs())
+    broker = InMemoryBroker(poll_timeout=0.02)
+    container.register_datasource("pubsub", broker)
+    manager = SubscriptionManager(container)
+    manager._rng.seed(seed)
+
+    topic = "chaos-mem"
+    handled: dict[bytes, int] = {}
+    poison = {b"msg-3", b"msg-7"}
+    dlq_published = _spy_dlq_publishes(broker)
+
+    def handler(ctx):
+        value = ctx.request.value
+        if value in poison:
+            raise ValueError(f"poison {value!r}")
+        handled[value] = handled.get(value, 0) + 1
+
+    manager.register(topic, handler)
+    inj = chaos.ChaosInjector(seed, RATES, max_faults=2)
+
+    import gofr_tpu.subscriber as sub
+    orig = sub.ERROR_BACKOFF_SECONDS
+    sub.ERROR_BACKOFF_SECONDS = 0.02  # keep injected subscribe faults cheap
+    try:
+        with chaos.active(inj):
+            payloads = run_async(
+                _run_workload(broker, manager, topic, handled, dlq_published)
+            )
+    finally:
+        sub.ERROR_BACKOFF_SECONDS = orig
+
+    stats = inj.stats()
+    assert any(v["faults"] for v in stats.values()), stats  # chaos actually hit
+    dlq_messages = _drain_dlq(broker, topic)
+    _assert_invariant(payloads, handled, poison, dlq_published,
+                      dlq_messages, manager._consumers[topic], topic)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_delivery_invariant_kafka_wire_driver(seed, run_async):
+    from gofr_tpu.datasource.pubsub.kafka import KafkaClient
+    from gofr_tpu.testutil.kafka_broker import MiniKafkaBroker
+
+    mini = MiniKafkaBroker()
+    client = KafkaClient(mini.address, consumer_group=f"chaos-{seed}",
+                         poll_timeout=0.02)
+    client.connect()
+    container, _ = new_mock_container(_configs())
+    container.register_datasource("pubsub", client)
+    manager = SubscriptionManager(container)
+    manager._rng.seed(seed)
+
+    topic = "chaos-kafka"
+    handled: dict[bytes, int] = {}
+    poison = {b"msg-1", b"msg-8"}
+    dlq_published = _spy_dlq_publishes(client)
+
+    def handler(ctx):
+        value = ctx.request.value
+        if value in poison:
+            raise ValueError(f"poison {value!r}")
+        handled[value] = handled.get(value, 0) + 1
+
+    manager.register(topic, handler)
+    inj = chaos.ChaosInjector(seed, RATES, max_faults=2)
+
+    import gofr_tpu.subscriber as sub
+    orig = sub.ERROR_BACKOFF_SECONDS
+    sub.ERROR_BACKOFF_SECONDS = 0.02
+    try:
+        with chaos.active(inj):
+            payloads = run_async(
+                _run_workload(client, manager, topic, handled, dlq_published)
+            )
+        dlq_messages = _drain_dlq(client, topic)
+        _assert_invariant(payloads, handled, poison, dlq_published,
+                          dlq_messages, manager._consumers[topic], topic)
+    finally:
+        sub.ERROR_BACKOFF_SECONDS = orig
+        client.close()
+        mini.close()
+
+
+@pytest.mark.chaos
+def test_ack_fault_redelivers_instead_of_losing(run_async):
+    """A commit that fails (pubsub.ack fault) must surface as a
+    redelivery, not a lost message and not a phantom success count."""
+    container, _ = new_mock_container(_configs())
+    broker = InMemoryBroker(poll_timeout=0.02)
+    container.register_datasource("pubsub", broker)
+    manager = SubscriptionManager(container)
+    handled = []
+    manager.register("ackchaos", lambda ctx: handled.append(ctx.request.value))
+    inj = chaos.ChaosInjector(7, {"pubsub.ack": 1.0}, max_faults=1)
+
+    async def scenario():
+        broker.publish("ackchaos", b"only-one")
+        await manager.start()
+        try:
+            with chaos.active(inj):
+                deadline = time.monotonic() + 20
+                while time.monotonic() < deadline and broker.backlog("ackchaos"):
+                    await asyncio.sleep(0.02)
+        finally:
+            await manager.stop()
+
+    run_async(scenario())
+    assert len(handled) == 2  # first commit faulted → exactly one redelivery
+    m = container.metrics_manager
+    assert m.get("app_pubsub_subscribe_success_count").value({"topic": "ackchaos"}) == 1
+    assert m.get("app_pubsub_commit_fail_count").value({"topic": "ackchaos"}) == 1
+
+
+@pytest.mark.chaos
+def test_subscribe_fault_backs_off_and_recovers(run_async):
+    """A pubsub.subscribe fault rides the in-loop error backoff — the
+    consumer never crashes its supervisor budget over a broker hiccup."""
+    container, _ = new_mock_container(_configs())
+    broker = InMemoryBroker(poll_timeout=0.02)
+    container.register_datasource("pubsub", broker)
+    manager = SubscriptionManager(container)
+    got = []
+    manager.register("subchaos", lambda ctx: got.append(ctx.request.value))
+    inj = chaos.ChaosInjector(11, {"pubsub.subscribe": 1.0}, max_faults=3)
+
+    import gofr_tpu.subscriber as sub
+    orig = sub.ERROR_BACKOFF_SECONDS
+    sub.ERROR_BACKOFF_SECONDS = 0.02
+
+    async def scenario():
+        broker.publish("subchaos", b"through-the-storm")
+        await manager.start()
+        try:
+            with chaos.active(inj):
+                deadline = time.monotonic() + 20
+                while time.monotonic() < deadline and not got:
+                    await asyncio.sleep(0.02)
+        finally:
+            await manager.stop()
+
+    try:
+        run_async(scenario())
+    finally:
+        sub.ERROR_BACKOFF_SECONDS = orig
+    assert got == [b"through-the-storm"]
+    assert inj.stats()["pubsub.subscribe"]["faults"] == 3
+    assert manager._consumers["subchaos"].restarts == 0

@@ -1,0 +1,531 @@
+"""Delivery-reliability layer (docs/datasources.md "Delivery semantics"):
+the ack/nack settlement contract on Message, nack across all six drivers,
+DeliveryPolicy config resolution, and the supervised SubscriptionManager —
+bounded redelivery, dead-letter routing, commit-failure accounting, the
+restart budget, and consumer-state health."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from gofr_tpu import chaos
+from gofr_tpu.config import MapConfig
+from gofr_tpu.datasource.pubsub import InMemoryBroker
+from gofr_tpu.datasource.pubsub.delivery import (
+    ATTEMPTS_KEY,
+    DLQ_ATTEMPTS_KEY,
+    DLQ_ERROR_KEY,
+    DLQ_FIRST_TS_KEY,
+    DLQ_LAST_TS_KEY,
+    DLQ_SOURCE_TOPIC_KEY,
+    DeliveryPolicy,
+    dlq_topic,
+)
+from gofr_tpu.datasource.pubsub.message import Message
+from gofr_tpu.subscriber import (
+    BACKOFF,
+    RUNNING,
+    STOPPED,
+    SubscriptionManager,
+)
+from gofr_tpu.testutil import new_mock_container
+
+
+# ---------------------------------------------------------------- contract
+class TestMessageSettlement:
+    def test_commit_is_idempotent_and_sets_committed(self):
+        calls = []
+        m = Message("t", b"v", committer=lambda: calls.append("c"))
+        assert m.committed is False
+        m.commit()
+        m.commit()
+        assert calls == ["c"]
+        assert m.committed is True
+
+    def test_nack_is_idempotent(self):
+        calls = []
+        m = Message("t", b"v", nacker=lambda r: calls.append(r))
+        m.nack(True)
+        m.nack(True)
+        assert calls == [True]
+        assert m.committed is True  # settled
+
+    def test_commit_after_nack_is_noop_and_vice_versa(self):
+        log = []
+        m = Message("t", b"v", committer=lambda: log.append("commit"),
+                    nacker=lambda r: log.append(("nack", r)))
+        m.nack(False)
+        m.commit()
+        assert log == [("nack", False)]
+        m2 = Message("t", b"v", committer=lambda: log.append("commit2"),
+                     nacker=lambda r: log.append("nack2"))
+        m2.commit()
+        m2.nack(True)
+        assert log[-1] == "commit2"
+
+    def test_failed_commit_leaves_message_unsettled(self):
+        def boom():
+            raise ConnectionError("broker gone")
+
+        m = Message("t", b"v", committer=boom)
+        with pytest.raises(ConnectionError):
+            m.commit()
+        assert m.committed is False  # redeliverable; a later commit may succeed
+
+    def test_nack_drop_without_nacker_falls_back_to_commit(self):
+        calls = []
+        m = Message("t", b"v", committer=lambda: calls.append("c"))
+        m.nack(False)
+        assert calls == ["c"]
+        m2 = Message("t", b"v", committer=lambda: calls.append("c2"))
+        m2.nack(True)  # requeue with no nacker: broker redelivers anyway
+        assert calls == ["c"]
+
+
+# ---------------------------------------------------------------- drivers
+class TestMemoryNack:
+    def test_requeue_redelivers(self):
+        b = InMemoryBroker(poll_timeout=0.01)
+        b.publish("t", b"m1")
+        msg = b.subscribe("t")
+        msg.nack(True)
+        again = b.subscribe("t")
+        assert again is not None and again.value == b"m1"
+        again.commit()
+        assert b.subscribe("t") is None
+
+    def test_drop_advances_past_the_message(self):
+        b = InMemoryBroker(poll_timeout=0.01)
+        b.publish("t", b"poison")
+        b.publish("t", b"next")
+        b.subscribe("t").nack(False)
+        nxt = b.subscribe("t")
+        assert nxt is not None and nxt.value == b"next"
+
+
+class TestPolicy:
+    def test_defaults_and_global_config(self):
+        cfg = MapConfig({"PUBSUB_MAX_ATTEMPTS": "7",
+                         "PUBSUB_RETRY_BACKOFF_SECONDS": "0.5"}, use_env=False)
+        p = DeliveryPolicy.from_config(cfg, "orders")
+        assert p.max_attempts == 7
+        assert p.backoff == 0.5
+        assert DeliveryPolicy.from_config(None, "x").max_attempts == 5
+
+    def test_per_topic_override_normalizes_the_topic_name(self):
+        cfg = MapConfig({
+            "PUBSUB_MAX_ATTEMPTS": "9",
+            "PUBSUB_ASR_JOBS_MAX_ATTEMPTS": "2",
+        }, use_env=False)
+        assert DeliveryPolicy.from_config(cfg, "asr-jobs").max_attempts == 2
+        assert DeliveryPolicy.from_config(cfg, "other").max_attempts == 9
+
+    def test_delay_ladder_full_jitter_capped(self):
+        import random
+
+        p = DeliveryPolicy(backoff=1.0, multiplier=2.0, max_backoff=3.0)
+        rng = random.Random(1)
+        for attempt, cap in ((1, 1.0), (2, 2.0), (3, 3.0), (6, 3.0)):
+            for _ in range(20):
+                assert 0.0 <= p.delay(attempt, rng) <= cap
+        det = DeliveryPolicy(backoff=1.0, multiplier=2.0, max_backoff=8.0,
+                             jitter=False)
+        assert [det.delay(a) for a in (1, 2, 3, 4, 5)] == [1, 2, 4, 8, 8]
+
+    def test_delay_huge_attempt_counts_do_not_overflow(self):
+        # attempts grow without bound when a DLQ publish keeps failing;
+        # 2.0**1024 would raise OverflowError and skip the pacing sleep
+        p = DeliveryPolicy(backoff=1.0, multiplier=2.0, max_backoff=3.0,
+                           jitter=False)
+        assert p.delay(1100) == 3.0
+        assert p.delay(10**9) == 3.0
+
+    def test_dlq_topic_naming(self):
+        assert dlq_topic("orders") == "orders.dlq"
+
+
+# ------------------------------------------------- supervised consumer runtime
+def make_manager(configs: dict[str, str] | None = None):
+    container, mocks = new_mock_container(configs)
+    broker = InMemoryBroker(poll_timeout=0.02)
+    container.register_datasource("pubsub", broker)
+    mgr = SubscriptionManager(container)
+    mgr._rng.seed(0)
+    return container, broker, mgr
+
+
+async def drain_until(predicate, timeout: float = 15.0, interval: float = 0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+def test_poison_message_lands_in_dlq_and_topic_keeps_flowing(run_async):
+    """The acceptance regression: a handler that always raises on topic T
+    drives the message to T.dlq after exactly max_attempts deliveries, and
+    T continues delivering subsequent messages."""
+    container, broker, mgr = make_manager({
+        "PUBSUB_T_MAX_ATTEMPTS": "3",
+        "PUBSUB_RETRY_BACKOFF_SECONDS": "0.01",
+    })
+    deliveries: list[bytes] = []
+    good: list[bytes] = []
+
+    def handler(ctx):
+        value = ctx.request.value
+        deliveries.append(value)
+        if value == b"poison":
+            raise ValueError("cannot digest this")
+        good.append(value)
+
+    mgr.register("T", handler)
+
+    async def scenario():
+        broker.publish("T", b"poison")
+        broker.publish("T", b"wholesome")
+        await mgr.start()
+        try:
+            assert await drain_until(lambda: b"wholesome" in good)
+            assert await drain_until(
+                lambda: mgr._consumers["T"].dlq == 1
+            )
+        finally:
+            await mgr.stop()
+
+    run_async(scenario())
+
+    # exactly max_attempts deliveries of the poison message, then DLQ
+    assert deliveries.count(b"poison") == 3
+    dead = broker.subscribe("T.dlq")
+    assert dead is not None
+    assert dead.value == b"poison"
+    assert dead.metadata[DLQ_SOURCE_TOPIC_KEY] == "T"
+    assert dead.metadata[DLQ_ATTEMPTS_KEY] == "3"
+    assert "cannot digest" in dead.metadata[DLQ_ERROR_KEY]
+    first = float(dead.metadata[DLQ_FIRST_TS_KEY])
+    last = float(dead.metadata[DLQ_LAST_TS_KEY])
+    assert first <= last
+    # the topic itself is fully consumed — nothing loops
+    assert broker.backlog("T") == 0
+    m = container.metrics_manager
+    assert m.get("app_pubsub_dlq_total").value({"topic": "T"}) == 1
+    assert m.get("app_pubsub_redeliveries_total").value({"topic": "T"}) == 2
+
+
+def test_transient_failure_recovers_without_dlq(run_async):
+    container, broker, mgr = make_manager({
+        "PUBSUB_RETRY_BACKOFF_SECONDS": "0.01",
+    })
+    seen = {"n": 0}
+    done = []
+
+    def handler(ctx):
+        seen["n"] += 1
+        # the attempts counter is visible to the handler via metadata
+        assert ctx.request.metadata[ATTEMPTS_KEY] == str(seen["n"])
+        if seen["n"] < 3:
+            raise TimeoutError("downstream flapped")
+        done.append(ctx.request.value)
+
+    mgr.register("jobs", handler)
+
+    async def scenario():
+        broker.publish("jobs", b"job-1")
+        await mgr.start()
+        try:
+            assert await drain_until(lambda: done)
+        finally:
+            await mgr.stop()
+
+    run_async(scenario())
+    assert done == [b"job-1"]
+    assert mgr._consumers["jobs"].dlq == 0
+    assert broker.subscribe("jobs.dlq") is None
+    assert mgr._consumers["jobs"].redeliveries == 2
+    # attempt bookkeeping is pruned once the message settles
+    assert mgr._consumers["jobs"].attempts == {}
+
+
+def test_success_metric_counts_only_after_commit_succeeds(run_async):
+    """Satellite: a failed commit must NOT count as subscribe success —
+    it is a distinct commit-failure series, and the broker redelivers."""
+    container, broker, mgr = make_manager({
+        "PUBSUB_RETRY_BACKOFF_SECONDS": "0.01",
+    })
+    handled = []
+
+    def handler(ctx):
+        handled.append(ctx.request.value)
+
+    mgr.register("q", handler)
+
+    # first commit attempt fails at the broker, later ones succeed
+    fail_once = {"left": 1}
+    real_subscribe = broker.subscribe
+
+    def flaky_subscribe(topic):
+        msg = real_subscribe(topic)
+        if msg is None or topic != "q":
+            return msg
+        real_committer = msg._committer
+
+        def maybe_fail_commit():
+            if fail_once["left"] > 0:
+                fail_once["left"] -= 1
+                raise ConnectionError("commit lost")
+            real_committer()
+
+        msg._committer = maybe_fail_commit
+        return msg
+
+    broker.subscribe = flaky_subscribe
+
+    async def scenario():
+        broker.publish("q", b"m")
+        await mgr.start()
+        try:
+            # generous timeout: this runs mid-suite on a loaded box
+            assert await drain_until(
+                lambda: broker.backlog("q") == 0 and len(handled) >= 2,
+                timeout=45,
+            )
+        finally:
+            await mgr.stop()
+
+    run_async(scenario())
+    m = container.metrics_manager
+    # handled twice (commit failure → redelivery), success counted ONCE
+    assert m.get("app_pubsub_subscribe_success_count").value({"topic": "q"}) == 1
+    assert m.get("app_pubsub_commit_fail_count").value({"topic": "q"}) == 1
+    assert mgr._consumers["q"].commit_failures == 1
+
+
+def test_idle_poll_is_bounded_not_a_busy_spin(run_async):
+    """Satellite: a driver that returns None instantly (no internal poll
+    timeout) must not spin the event loop — the idle sleep bounds the
+    poll rate."""
+    container, _ = new_mock_container()
+
+    class InstantNone:
+        def __init__(self):
+            self.polls = 0
+
+        def subscribe(self, topic):
+            self.polls += 1
+            return None
+
+    driver = InstantNone()
+    container.pubsub = driver
+    mgr = SubscriptionManager(container)
+    mgr.register("idle", lambda ctx: None)
+
+    async def scenario():
+        await mgr.start()
+        await asyncio.sleep(0.3)
+        await mgr.stop()
+
+    run_async(scenario())
+    # 0.3 s / 50 ms idle sleep ≈ 6 polls; a busy spin would be thousands
+    assert driver.polls <= 12
+
+
+def test_consumer_state_in_container_health(run_async):
+    container, broker, mgr = make_manager()
+    mgr.register("t1", lambda ctx: None)
+
+    async def scenario():
+        await mgr.start()
+        try:
+            assert await drain_until(
+                lambda: mgr._consumers["t1"].state == RUNNING
+            )
+            health = container.health()
+            consumers = health["details"]["pubsub_consumers"]
+            assert consumers["status"] == "UP"
+            snap = consumers["details"]["topics"]["t1"]
+            assert snap["state"] == RUNNING
+            assert snap["max_attempts"] == 5
+            assert health["status"] == "UP"
+        finally:
+            await mgr.stop()
+        assert mgr._consumers["t1"].state == STOPPED
+
+    run_async(scenario())
+
+
+def test_supervisor_restarts_crashed_loop_then_parks_it(run_async):
+    """A crashing topic loop is restarted with a budget; once the budget
+    is spent the topic parks STOPPED and health reports DOWN."""
+    import gofr_tpu.subscriber as sub
+
+    container, broker, mgr = make_manager()
+    mgr.register("doomed", lambda ctx: None)
+    crashes = {"n": 0}
+
+    async def crashing_loop(consumer):
+        crashes["n"] += 1
+        raise RuntimeError("loop bug")
+
+    mgr._loop = crashing_loop
+
+    async def scenario(monkey_backoff):
+        await mgr.start()
+        try:
+            assert await drain_until(
+                lambda: crashes["n"] > sub.MAX_CONSECUTIVE_RESTARTS
+                and mgr._consumers["doomed"].state == STOPPED,
+                timeout=10,
+            )
+        finally:
+            await mgr.stop()
+
+    orig = sub.ERROR_BACKOFF_SECONDS
+    sub.ERROR_BACKOFF_SECONDS = 0.01
+    try:
+        run_async(scenario(0.01))
+    finally:
+        sub.ERROR_BACKOFF_SECONDS = orig
+
+    assert crashes["n"] == sub.MAX_CONSECUTIVE_RESTARTS + 1
+    assert mgr._consumers["doomed"].restarts == sub.MAX_CONSECUTIVE_RESTARTS + 1
+    health = mgr.health()
+    assert health["status"] == "DOWN"
+
+
+def test_subscribe_error_backs_off_and_recovers(run_async):
+    import gofr_tpu.subscriber as sub
+
+    container, broker, mgr = make_manager()
+    state = {"fail": 2}
+    real_subscribe = broker.subscribe
+
+    def flaky(topic):
+        if state["fail"] > 0:
+            state["fail"] -= 1
+            raise ConnectionError("broker hiccup")
+        return real_subscribe(topic)
+
+    broker.subscribe = flaky
+    got = []
+    mgr.register("r", lambda ctx: got.append(ctx.request.value))
+
+    async def scenario():
+        broker.publish("r", b"after-the-storm")
+        await mgr.start()
+        try:
+            assert await drain_until(lambda: got)
+        finally:
+            await mgr.stop()
+
+    orig = sub.ERROR_BACKOFF_SECONDS
+    sub.ERROR_BACKOFF_SECONDS = 0.01
+    try:
+        run_async(scenario())
+    finally:
+        sub.ERROR_BACKOFF_SECONDS = orig
+    assert got == [b"after-the-storm"]
+    # the error path never crashed the loop: no restarts burned
+    assert mgr._consumers["r"].restarts == 0
+
+
+def test_handler_settled_message_is_not_double_settled(run_async):
+    """A handler that commits (or nacks) itself is safe: the framework's
+    follow-up settle is an idempotent no-op (the lint still flags the
+    pattern — pubsub-manual-settle)."""
+    container, broker, mgr = make_manager()
+    settled = []
+
+    def handler(ctx):
+        msg = ctx.request
+        real = msg._committer
+        msg._committer = lambda: settled.append("broker-commit") or real()
+        msg.commit()
+
+    mgr.register("manual", handler)
+
+    async def scenario():
+        broker.publish("manual", b"m")
+        await mgr.start()
+        try:
+            assert await drain_until(lambda: broker.backlog("manual") == 0)
+            await asyncio.sleep(0.05)
+        finally:
+            await mgr.stop()
+
+    run_async(scenario())
+    assert settled == ["broker-commit"]  # exactly once, not twice
+
+
+def test_publish_fault_surfaces_typed_retriable_through_context(run_async):
+    """Satellite: publisher-side chaos at pubsub.publish surfaces inside
+    handler code as the typed, retriable ChaosFault — not some unrelated
+    unhandled error — so handlers can catch-and-retry."""
+    container, broker, mgr = make_manager({
+        "PUBSUB_RETRY_BACKOFF_SECONDS": "0.01",
+    })
+    caught = []
+
+    def handler(ctx):
+        try:
+            ctx.get_publisher().publish("downstream", ctx.request.value)
+        except chaos.ChaosFault as exc:
+            assert exc.retriable is True
+            caught.append(exc.point)
+            raise  # fail the delivery: the framework nacks + retries
+
+    mgr.register("up", handler)
+    inj = chaos.ChaosInjector(5, {"pubsub.publish": 1.0}, max_faults=1)
+
+    async def scenario():
+        broker.publish("up", b"payload")
+        await mgr.start()
+        try:
+            with chaos.active(inj):
+                assert await drain_until(
+                    lambda: broker.backlog("up") == 0 and broker.backlog("downstream") > 0
+                )
+        finally:
+            await mgr.stop()
+
+    run_async(scenario())
+    assert caught == ["pubsub.publish"]
+    # retry after the injected fault delivered the downstream publish
+    msg = broker.subscribe("downstream")
+    assert msg is not None and msg.value == b"payload"
+
+
+def test_dlq_topic_never_chains_another_dlq(run_async):
+    """A failing handler ON a .dlq topic must not dead-letter again into
+    <t>.dlq.dlq — it keeps redelivering at the max-ladder pace instead
+    (never lost, nothing migrates into an invisible topic)."""
+    container, broker, mgr = make_manager({
+        "PUBSUB_MAX_ATTEMPTS": "2",
+        "PUBSUB_RETRY_BACKOFF_SECONDS": "0.01",
+    })
+    deliveries = []
+
+    def bad_drainer(ctx):
+        deliveries.append(ctx.request.value)
+        raise RuntimeError("drainer bug")
+
+    mgr.register("jobs.dlq", bad_drainer)
+
+    async def scenario():
+        broker.publish("jobs.dlq", b"dead-1")
+        await mgr.start()
+        try:
+            # well past max_attempts deliveries: still redelivering
+            assert await drain_until(lambda: len(deliveries) >= 4)
+        finally:
+            await mgr.stop()
+
+    run_async(scenario())
+    assert mgr._consumers["jobs.dlq"].dlq == 0
+    assert broker.subscribe("jobs.dlq.dlq") is None  # never chained
+    assert broker.backlog("jobs.dlq") == 1  # never lost, never committed
