@@ -26,14 +26,19 @@ ci: lint
 # FIXED-SEED fault schedules at every registered injection point, asserting
 # the request-lifecycle invariant — every submitted request reaches exactly
 # one terminal state with its slot + KV pages reclaimed, and the engine
-# thread exits cleanly — plus the pubsub delivery invariant (every
+# thread exits cleanly — plus the engine-supervision invariant (an injected
+# engine.step hang/crash or device.loss poisoning is detected by the
+# watchdog, warm-restarted under budget, queued requests survive the
+# restart, a budget-exhausted engine parks WEDGED instead of flapping;
+# tests/test_supervisor.py), plus the pubsub delivery invariant (every
 # published message handled-and-committed or dead-lettered with history;
 # never lost, never looping) over the memory + kafka-wire drivers.
 # Deterministic: a red run reproduces with the same seed every time (seeds
-# live in tests/test_chaos.py::CHAOS_SEEDS and
+# live in tests/test_chaos.py::CHAOS_SEEDS,
+# tests/test_supervisor.py::CHAOS_SEEDS and
 # tests/test_pubsub_chaos.py::CHAOS_SEEDS).
 chaos:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py tests/test_pubsub_chaos.py -q -m chaos
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py tests/test_supervisor.py tests/test_pubsub_chaos.py -q -m chaos
 
 # gofrlint (docs/static-analysis.md): framework-invariant AST lints over
 # the whole package + the extern-C vs ctypes FFI signature cross-check.

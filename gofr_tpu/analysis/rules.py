@@ -27,6 +27,11 @@ Rules
     Every ctypes call into the native layer returns a status code;
     discarding it turns a C-side failure (bad handle, OOM) into silent
     corruption. Calls whose result is not consumed are flagged.
+``daemon-loop-no-heartbeat``
+    A ``while True`` loop running as a daemon-thread target must either
+    check a stop ``Event`` or stamp a heartbeat — otherwise it can
+    neither be shut down deliberately nor watched for hangs
+    (``gofr_tpu/testutil/`` scaffolding is exempt).
 ``pubsub-manual-settle``
     Subscriber handlers registered via ``app.subscribe(topic, handler)``
     are settled by the framework loop (commit on success, nack/DLQ on
@@ -75,8 +80,9 @@ BACKOFF_ZONES: dict[str, set[str] | str] = {
 # decode hot path: ONE annotated sync point per step, nothing else
 HOT_SYNC_ZONES: dict[str, set[str] | str] = {
     "gofr_tpu/serving/engine.py": {
-        "_loop", "_decode_step", "_spec_step", "_dispatch_decode",
-        "_consume_decode", "_commit_token", "_emit_token", "_chunk_absorb",
+        "_loop", "_loop_body", "_decode_step", "_spec_step",
+        "_dispatch_decode", "_consume_decode", "_commit_token",
+        "_emit_token", "_chunk_absorb",
     },
     "gofr_tpu/serving/batch.py": "*",
 }
@@ -340,6 +346,159 @@ class MetricsRule(Rule):
         return out
 
 
+class DaemonLoopHeartbeatRule(Rule):
+    """``daemon-loop-no-heartbeat``: a ``while True`` loop running on a
+    daemon thread must either check a stop ``Event`` (``.wait()`` /
+    ``.is_set()``) or stamp a heartbeat. A daemon loop with neither is
+    invisible: it cannot be shut down deliberately, and when it hangs
+    nothing — no supervisor, no watchdog — can tell. The engine loop and
+    the supervisor watchdog are the template (serving/engine.py stamps
+    ``self.heartbeat`` per iteration; supervisor.py gates on
+    ``self._stop.wait``).
+
+    Matching is per-file: ``threading.Thread(target=<fn>, daemon=True)``
+    registrations are collected, and ``while True:`` loops inside
+    same-file functions of that name are checked — ``self.<m>`` targets
+    scope to the registering class, so a sibling class's same-named
+    method is not cross-flagged. A ``.wait()``/
+    ``.is_set()`` counts only when its receiver is recognizably a
+    lifecycle event (name contains stop/shutdown/halt/...): a throttling
+    ``self._wake.wait(0.05)`` leaves the loop exactly as unstoppable as
+    no wait at all. ``gofr_tpu/testutil/`` is exempt — test scaffolding
+    threads live exactly as long as the process by design."""
+
+    name = "daemon-loop-no-heartbeat"
+
+    _STOP_METHODS = {"wait", "is_set"}
+    # a .wait()/.is_set() only counts as supervision when its receiver is
+    # recognizably a LIFECYCLE event: `self._wake.wait(0.05)` is a
+    # throttle, not a stop check — a loop gated on nothing but that is
+    # still unstoppable and unwatchable, the exact defect this rule exists
+    # to flag
+    _STOP_NAME_TOKENS = (
+        "stop", "shutdown", "shut_down", "halt", "quit", "exit", "done",
+        "closed", "closing", "cancel", "term", "finished",
+    )
+
+    @staticmethod
+    def _target_name(node: ast.expr) -> str | None:
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            return node.attr  # self._loop → "_loop"
+        return None
+
+    @staticmethod
+    def _scoped_walk(tree: ast.AST):
+        """Yield (node, enclosing ClassDef | None) over the whole tree."""
+
+        def walk(node: ast.AST, cls: ast.ClassDef | None):
+            for child in ast.iter_child_nodes(node):
+                child_cls = child if isinstance(child, ast.ClassDef) else cls
+                yield child, child_cls
+                yield from walk(child, child_cls)
+
+        yield from walk(tree, None)
+
+    def _daemon_targets(
+        self, tree: ast.AST
+    ) -> tuple[set[str], dict[int, set[str]]]:
+        """Collect daemon-thread target names. ``self.<m>`` registrations
+        scope to their enclosing class — an unrelated same-named method of
+        a sibling class in the same file must not be flagged (same
+        rationale as use-after-donation's scope-awareness). Plain-name and
+        non-self attribute targets stay file-wide."""
+        loose: set[str] = set()
+        by_class: dict[int, set[str]] = {}
+        for node, cls in self._scoped_walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func) or ""
+            if not dotted.split(".")[-1] == "Thread":
+                continue
+            kw = {k.arg: k.value for k in node.keywords if k.arg}
+            daemon = kw.get("daemon")
+            if not (isinstance(daemon, ast.Constant) and daemon.value is True):
+                continue
+            target = kw.get("target")
+            if target is None:
+                continue
+            name = self._target_name(target)
+            if not name:
+                continue
+            if (
+                cls is not None
+                and isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                by_class.setdefault(id(cls), set()).add(name)
+            else:
+                loose.add(name)
+        return loose, by_class
+
+    def _loop_is_supervised(self, loop: ast.While) -> bool:
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                if (
+                    node.func.attr in self._STOP_METHODS
+                    and self._is_stop_receiver(node.func.value)
+                ):
+                    return True  # stop-Event check gates the loop
+                if "heartbeat" in node.func.attr.lower():
+                    return True  # e.g. self._stamp_heartbeat()
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign) else [node.target]
+                )
+                for t in targets:
+                    name = (
+                        t.attr if isinstance(t, ast.Attribute)
+                        else t.id if isinstance(t, ast.Name) else ""
+                    )
+                    if "heartbeat" in name.lower():
+                        return True  # heartbeat stamp
+        return False
+
+    def _is_stop_receiver(self, node: ast.expr) -> bool:
+        dotted = (_dotted(node) or "").lower()
+        return any(tok in dotted for tok in self._STOP_NAME_TOKENS)
+
+    def visit_file(self, sf: SourceFile) -> list[Finding]:
+        if "gofr_tpu/testutil/" in sf.rel_path:
+            return []
+        loose, by_class = self._daemon_targets(sf.tree)
+        if not loose and not by_class:
+            return []
+        out: list[Finding] = []
+        for node, cls in self._scoped_walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            allowed = loose if cls is None else (
+                loose | by_class.get(id(cls), set())
+            )
+            if node.name not in allowed:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.While):
+                    continue
+                test = sub.test
+                if not (isinstance(test, ast.Constant) and test.value is True):
+                    continue
+                if self._loop_is_supervised(sub):
+                    continue
+                out.append(
+                    Finding(
+                        self.name, sf.rel_path, sub.lineno,
+                        f"'while True' in daemon-thread target '{node.name}' "
+                        "checks no stop Event and stamps no heartbeat — "
+                        "unstoppable AND unwatchable; gate on an Event.wait/"
+                        "is_set or stamp a heartbeat each iteration",
+                    )
+                )
+        return out
+
+
 class PubSubManualSettleRule(Rule):
     """Cross-file: collect subscriber-handler registrations
     (``*.subscribe(topic, handler)`` and
@@ -438,6 +597,6 @@ def default_rules() -> list[Rule]:
 
     return [
         BlockingCallRule(), HostSyncRule(), CtypesCheckedRule(), MetricsRule(),
-        PubSubManualSettleRule(),
+        DaemonLoopHeartbeatRule(), PubSubManualSettleRule(),
         *shardcheck_rules(),
     ]

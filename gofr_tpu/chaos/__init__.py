@@ -6,7 +6,9 @@ from gofr_tpu.chaos.injector import (
     POINTS,
     ChaosFault,
     ChaosInjector,
+    DeviceLost,
     active,
+    hang_factory,
     install,
     maybe_fail,
     uninstall,
@@ -16,7 +18,9 @@ __all__ = [
     "POINTS",
     "ChaosFault",
     "ChaosInjector",
+    "DeviceLost",
     "active",
+    "hang_factory",
     "install",
     "maybe_fail",
     "uninstall",

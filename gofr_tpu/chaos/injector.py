@@ -24,6 +24,7 @@ from __future__ import annotations
 import contextlib
 import random
 import threading
+import time
 from typing import Any, Callable
 
 # The registered injection points. Hooks call ``maybe_fail`` with one of
@@ -33,6 +34,8 @@ POINTS = (
     "sched.submit",     # native-scheduler boundary: request queueing
     "sched.admit",      # native-scheduler boundary: batch admission
     "decode.dispatch",  # engine decode dispatch (device step)
+    "engine.step",      # top of the engine loop iteration (raise AND hang)
+    "device.loss",      # device/executable poisoning (persistent KV dies)
     "kv.alloc",         # paged-KV pool allocation / extension
     "service.request",  # outbound HTTP service client
     "pubsub.publish",   # pubsub publish
@@ -54,6 +57,31 @@ class ChaosFault(RuntimeError):
         self.nth_call = nth_call
 
 
+class DeviceLost(ChaosFault):
+    """Injected device loss: the accelerator (or its compiled executable)
+    died under the engine. Unlike the generic transient, the engine's
+    hook POISONS the persistent KV buffers before this propagates, so
+    recovery must rebuild device state, not just retry."""
+
+    retriable = False
+
+
+def hang_factory(seconds: float) -> Callable[[str, int], None]:
+    """Fault factory for the HANG variant: instead of raising, the faulted
+    call stalls for ``seconds`` on the calling thread and then proceeds
+    normally (returns None → ``fire`` raises nothing). At ``engine.step``
+    this freezes the decode loop exactly the way a stuck PJRT dispatch
+    does — the watchdog must catch it through heartbeat age, because no
+    exception will ever surface."""
+
+    def factory(point: str, nth_call: int) -> None:
+        # gofrlint: disable=blocking-call -- the hang IS the injected fault
+        time.sleep(seconds)
+        return None
+
+    return factory
+
+
 def _default_fault_factories() -> dict[str, Callable[[str, int], BaseException]]:
     """Per-point defaults matching what the real seam raises: the KV pool
     raises OutOfBlocks (a transient the engine requeues on), the scheduler
@@ -64,6 +92,7 @@ def _default_fault_factories() -> dict[str, Callable[[str, int], BaseException]]
     return {
         "kv.alloc": lambda p, n: OutOfBlocks(f"injected pool exhaustion at {p} (call #{n})"),
         "sched.submit": lambda p, n: QueueFull(f"injected queue-full at {p} (call #{n})"),
+        "device.loss": DeviceLost,
     }
 
 
@@ -73,7 +102,10 @@ class ChaosInjector:
     ``rates`` maps point name → fault probability per call. ``max_faults``
     (per point) bounds how many times a point fires, which guarantees the
     system under test converges — after the budget is spent the point goes
-    quiet and retries/requeues succeed.
+    quiet and retries/requeues succeed. A fault factory normally returns
+    the exception to raise; one that returns ``None`` performs its fault
+    in-line instead (``hang_factory`` stalls the calling thread) and the
+    faulted call then proceeds.
     """
 
     def __init__(
@@ -115,7 +147,10 @@ class ChaosInjector:
             self._faults[point] += 1
         factory = self._factories.get(point)
         if factory is not None:
-            raise factory(point, nth)
+            fault = factory(point, nth)
+            if fault is None:
+                return  # hang-style factory: the stall already happened
+            raise fault
         raise ChaosFault(point, nth)
 
     def stats(self) -> dict[str, dict[str, int]]:

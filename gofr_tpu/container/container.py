@@ -161,6 +161,29 @@ class Container:
             "app_estimated_queue_wait_seconds",
             "EWMA-estimated queue wait for a newly submitted request",
         )
+        m.new_counter(
+            "app_requests_kv_exhausted_total",
+            "Rows retired mid-decode by KV-pool exhaustion (finish_reason "
+            "kv_exhausted) — pool pressure, not a legitimate max-tokens stop",
+        )
+        # engine supervision plane (serving/supervisor.py)
+        m.new_counter(
+            "app_engine_restarts_total",
+            "Completed self-healing engine warm restarts",
+        )
+        m.new_gauge(
+            "app_engine_heartbeat_age_seconds",
+            "Seconds since the engine loop last stamped its heartbeat",
+        )
+        m.new_gauge(
+            "app_engine_supervisor_state",
+            "Engine supervisor state: 0 UP, 1 SUSPECT, 2 RESTARTING, 3 WEDGED",
+        )
+        m.new_gauge(
+            "app_service_breaker_state",
+            "Circuit-breaker state per downstream service address: "
+            "0 closed, 1 open",
+        )
 
     # -- accessors mirroring the reference's API ------------------------------
     @property

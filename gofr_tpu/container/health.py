@@ -49,9 +49,17 @@ def aggregate_health(container: Any) -> dict[str, Any]:
             return all(_is_up(v) for k, v in node.items() if k != "status")
         return True
 
-    if getattr(container, "draining", False):
-        # drain outranks everything: the LB must stop routing here, whatever
-        # the datasources say
+    serving_status = str(
+        (details.get("serving") or {}).get("status", "")
+    ).upper()
+    if serving_status == "WEDGED":
+        # a wedged engine outranks even a deliberate drain: the process
+        # needs REPLACING, and a soothing "DRAINING" would hide that from
+        # the orchestrator watching this endpoint
+        overall = "DEGRADED"
+    elif getattr(container, "draining", False):
+        # drain outranks everything else: the LB must stop routing here,
+        # whatever the datasources say
         overall = "DRAINING"
     else:
         overall = "UP" if all(_is_up(v) for v in details.values()) else "DEGRADED"

@@ -22,8 +22,18 @@ import grpc.aio
 
 from gofr_tpu.http.errors import (
     ErrorDeadlineExceeded,
+    ErrorRequestEntityTooLarge,
     ErrorServiceUnavailable,
     ErrorTooManyRequests,
+)
+
+# the engine's typed lifecycle errors every generation RPC converts to a
+# gRPC status instead of letting them surface as INTERNAL
+LIFECYCLE_ERRORS = (
+    ErrorTooManyRequests,
+    ErrorServiceUnavailable,
+    ErrorDeadlineExceeded,
+    ErrorRequestEntityTooLarge,
 )
 
 SERVICE_NAME = "gofr.v1.Inference"
@@ -44,8 +54,12 @@ def _deadline_of(context: Any) -> float | None:
 
 async def _abort_lifecycle(context: Any, exc: Exception) -> None:
     """Map the engine's typed lifecycle errors onto gRPC status codes:
-    shed → RESOURCE_EXHAUSTED (+ retry-delay detail), drain → UNAVAILABLE,
-    expired → DEADLINE_EXCEEDED."""
+    shed → RESOURCE_EXHAUSTED (+ retry-delay detail), drain →
+    UNAVAILABLE, expired → DEADLINE_EXCEEDED, can-never-fit →
+    FAILED_PRECONDITION (permanent: retrying the same request is
+    pointless, unlike every other status here)."""
+    if isinstance(exc, ErrorRequestEntityTooLarge):
+        await context.abort(grpc.StatusCode.FAILED_PRECONDITION, exc.message)
     if isinstance(exc, ErrorTooManyRequests):
         retry_after = exc.retry_after if exc.retry_after is not None else 1.0
         context.set_trailing_metadata((
@@ -132,8 +146,7 @@ class InferenceService:
             result = await self.engine.generate(
                 prompt, deadline=_deadline_of(context), **self._gen_kwargs(body)
             )
-        except (ErrorTooManyRequests, ErrorServiceUnavailable,
-                ErrorDeadlineExceeded) as exc:
+        except LIFECYCLE_ERRORS as exc:
             await _abort_lifecycle(context, exc)
         return _json_bytes(
             {
@@ -164,8 +177,7 @@ class InferenceService:
                 **self._gen_kwargs(body),
             ):
                 yield _json_bytes({"token": token_id, "text": piece})
-        except (ErrorTooManyRequests, ErrorServiceUnavailable,
-                ErrorDeadlineExceeded) as exc:
+        except LIFECYCLE_ERRORS as exc:
             await _abort_lifecycle(context, exc)
         result = final.get("result")
         done: dict[str, Any] = {"done": True}

@@ -168,6 +168,22 @@ class ErrorTooManyRequests(HTTPError):
         return "server overloaded, retry later"
 
 
+class ErrorRequestEntityTooLarge(HTTPError):
+    """TPU-build addition: the request can NEVER be served by this
+    replica's configuration — a prompt needing more KV pages than the
+    whole pool holds, however empty. Deliberately NOT a 429: 429 invites
+    clients to retry a permanent condition forever. 413 (and gRPC
+    ``FAILED_PRECONDITION``) tells them to shrink the request or find a
+    bigger replica; no ``Retry-After`` is ever attached."""
+
+    status_code = 413
+    level = Level.INFO
+
+    @classmethod
+    def default_message(cls) -> str:
+        return "request exceeds this replica's serving capacity"
+
+
 class ErrorDeadlineExceeded(HTTPError):
     """Request-lifecycle addition: the caller's deadline passed before the
     request produced a result (expired in queue, or shed at admission after

@@ -141,6 +141,22 @@ class BlockAllocator:
             if self._lib is not None:
                 _check(self._lib.gofr_ba_destroy(self._h), "ba_destroy")
 
+    def leak(self) -> None:
+        """Quarantine-leak: mark the allocator closed WITHOUT destroying
+        the native handle. Used by the engine's warm restart when its loop
+        thread failed to join — a hung thread may still be inside a native
+        call on this handle, and destroying memory under it would trade a
+        hang for a use-after-free. The handle is deliberately abandoned;
+        __del__ will not re-destroy it."""
+        with self._mu:
+            if self._closed:
+                return
+            self._last_stats = {
+                "free_blocks": 0, "total_blocks": self.num_blocks,
+                "sequences": 0, "alloc_failures": 0,
+            }
+            self._closed = True
+
     def __del__(self) -> None:  # best-effort; explicit close preferred
         try:
             self.close()
@@ -246,6 +262,19 @@ class Scheduler:
             self._closed = True  # see BlockAllocator.close — no re-destroy
             if self._lib is not None:
                 _check(self._lib.gofr_sched_destroy(self._h), "sched_destroy")
+
+    def leak(self) -> None:
+        """Quarantine-leak the scheduler handle (see BlockAllocator.leak):
+        closed-without-destroy for the warm-restart path where the engine
+        thread may still be inside a scheduler call."""
+        with self._mu:
+            if self._closed:
+                return
+            self._last_stats = {
+                "queue_depth": 0, "busy_slots": 0, "max_slots": self.max_slots,
+                "total_admitted": 0, "total_canceled": 0,
+            }
+            self._closed = True
 
     def __del__(self) -> None:
         try:

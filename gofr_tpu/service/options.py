@@ -81,10 +81,27 @@ class CircuitBreaker(_Wrapper):
         self._lock = threading.Lock()
         self._probe_thread: threading.Thread | None = None
         self._stop = threading.Event()
+        self._set_state_gauge(False)  # the closed state is visible from t=0
 
     @property
     def is_open(self) -> bool:
         return self._open
+
+    def _set_state_gauge(self, open_: bool) -> None:
+        """An open breaker used to surface only through health_check()
+        details; the per-address gauge makes it alertable in Prometheus
+        (one series per configured downstream — bounded cardinality)."""
+        metrics = getattr(self, "metrics", None)  # innermost client's
+        if metrics is None:
+            return
+        address = getattr(self, "address", "?")
+        try:
+            metrics.set_gauge(
+                "app_service_breaker_state", 1.0 if open_ else 0.0,
+                address=address,
+            )
+        except Exception:
+            pass  # a metrics backend hiccup must never affect the breaker
 
     def request(self, method: str, path: str, **kw: Any) -> ServiceResponse:
         with self._lock:
@@ -105,9 +122,12 @@ class CircuitBreaker(_Wrapper):
     def _record_failure(self) -> None:
         with self._lock:
             self._failures += 1
-            if self._failures >= self.threshold and not self._open:
+            opened = self._failures >= self.threshold and not self._open
+            if opened:
                 self._open = True
                 self._start_probe()
+        if opened:
+            self._set_state_gauge(True)
 
     def _start_probe(self) -> None:
         """Async recovery loop (circuit_breaker.go:100-119)."""
@@ -122,6 +142,7 @@ class CircuitBreaker(_Wrapper):
                 with self._lock:
                     self._open = False
                     self._failures = 0
+                self._set_state_gauge(False)
                 self._stop.set()
                 return
 

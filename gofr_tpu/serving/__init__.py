@@ -15,10 +15,12 @@ the unit of concurrency is the *slot*, not the thread. Components:
 """
 
 from gofr_tpu.serving.engine import EngineConfig, GenerationResult, ServingEngine
+from gofr_tpu.serving.supervisor import EngineSupervisor
 from gofr_tpu.serving.tokenizer import ByteTokenizer, Tokenizer
 
 __all__ = [
     "ServingEngine",
+    "EngineSupervisor",
     "EngineConfig",
     "GenerationResult",
     "Tokenizer",

@@ -16,6 +16,7 @@ Backpressure: admission beyond ``max_queue`` raises ErrorTooManyRequests
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import dataclasses
 import threading
 import time
@@ -28,6 +29,7 @@ import numpy as np
 from gofr_tpu import chaos
 from gofr_tpu.http.errors import (
     ErrorDeadlineExceeded,
+    ErrorRequestEntityTooLarge,
     ErrorServiceUnavailable,
     ErrorTooManyRequests,
 )
@@ -136,7 +138,7 @@ class GenerationResult:
     token_ids: list[int]
     prompt_tokens: int
     completion_tokens: int
-    finish_reason: str  # "stop" | "length" | "cancel" | "deadline_exceeded" | "error"
+    finish_reason: str  # "stop" | "length" | "kv_exhausted" | "cancel" | "deadline_exceeded" | "error"
     ttft_s: float
     duration_s: float
 
@@ -146,11 +148,21 @@ class _RequeueRequest(Exception):
     unavailable: the request goes back to the queue head, not to an error."""
 
 
+class _ThreadRetired(BaseException):
+    """Raised on the engine loop thread when it discovers it has been
+    replaced (a warm restart that could not join it quarantine-leaked its
+    resources and started a successor). BaseException on purpose: the
+    per-step ``except Exception`` recovery must NOT catch it — a retired
+    thread settling futures, mutating rebuilt state, or running _fail_all
+    would race the replacement thread over state it no longer owns."""
+
+
 class _Request:
     __slots__ = (
         "id", "prompt_ids", "max_new_tokens", "temperature", "top_k", "top_p",
         "stream_cb", "future", "created", "first_token_at", "tokens", "slot",
         "canceled", "stop_ids", "priority", "dispatched", "deadline",
+        "kv_exhausted",
     )
 
     def __init__(self, rid: int, prompt_ids: list[int], max_new_tokens: int,
@@ -173,6 +185,10 @@ class _Request:
         self.stop_ids = stop_ids
         self.priority = 0
         self.dispatched = 0  # decode steps dispatched (pipelined, ≥ consumed)
+        # the row was cut short by KV-pool pressure, not by its own token
+        # budget: the limit-check retire reports "kv_exhausted", a signal
+        # distinct from a legitimate max-tokens "length" stop
+        self.kv_exhausted = False
         # absolute perf_counter time the caller stops caring; None = forever
         self.deadline = (self.created + deadline) if deadline else None
 
@@ -234,7 +250,6 @@ class ServingEngine:
         else:
             self._prefix_cache = None
 
-        B, S = self.config.max_slots, self.config.max_seq_len
         if self.config.kv_dtype not in ("bf16", "int8"):
             raise ValueError(
                 f"TPU_KV_DTYPE={self.config.kv_dtype!r}: must be bf16 or int8"
@@ -246,63 +261,12 @@ class ServingEngine:
                 "TPU_SPEC_TOKENS and TPU_BATCH_MULTI_STEP>1 are both "
                 "chunking policies; enable one"
             )
-        if self.config.kv_layout == "paged":
-            from gofr_tpu.serving.kv_cache import PagedKVCache
-
-            page = self.config.kv_page_size
-            from gofr_tpu.ops.paged_attention import INT8_MIN_PAGE
-
-            if self.config.kv_dtype == "int8" and page < INT8_MIN_PAGE:
-                import jax as _jax
-
-                if _jax.default_backend() == "tpu":
-                    # below the int8 Mosaic tile the kernel would silently
-                    # fall back to the full-gather reference, INVERTING the
-                    # bandwidth win int8 exists for (code-review r4)
-                    raise ValueError(
-                        f"TPU_KV_DTYPE=int8 with TPU_KV_LAYOUT=paged needs "
-                        f"TPU_KV_PAGE_SIZE>={INT8_MIN_PAGE} on TPU (got "
-                        f"{page}): smaller pages violate the int8 Mosaic "
-                        "tile and lose the halved-bandwidth kernel path"
-                    )
-            num_pages = self.config.kv_num_pages or (B * S + page - 1) // page
-            self.paged_cache = PagedKVCache(
-                cfg, num_pages=num_pages, page_size=page,
-                max_slots=B, max_seq_len=S,
-                kv_dtype="int8" if self.config.kv_dtype == "int8" else None,
-            )
-            self.cache = None
-        else:
-            self.paged_cache = None
-            self.cache = self._make_dense_cache()
-        self.cache_len = np.zeros(B, np.int32)  # host copy (authoritative)
-        self.last_token = np.zeros(B, np.int32)
-        self.temperature = np.ones(B, np.float32)
-        self.top_k = np.zeros(B, np.int32)
-        self.top_p = np.ones(B, np.float32)
-        self.slots: list[_Request | None] = [None] * B
+        # executable-level runtime state (KV storage, per-slot arrays,
+        # pipelined-decode device state, admission scheduler) — built by
+        # the shared helper so the supervisor's warm restart rebuilds
+        # EXACTLY this, never a hand-copied drift of it
+        self._init_runtime_state()
         self.rng = jax.random.PRNGKey(seed)
-        # --- pipelined-decode state (VERDICT r3 weak #2: the old loop
-        # synced on np.asarray(next_token) before dispatching the next step,
-        # strictly alternating host and device work — ~14× over raw decode).
-        # Now step N+1 is dispatched from step N's DEVICE-side tokens and
-        # the host consumes step N's copy while N+1 runs.
-        self._inflight: _Inflight | None = None
-        self._last_tok_dev: Any = None  # device-resident last tokens [B]
-        self._cache_len_dev: Any = None  # device-resident lengths (dense path)
-        self._pending_tok: dict[int, tuple[int, int]] = {}  # slot → (token, len)
-        self._samp_dev: tuple | None = None  # cached device sampling params
-        self._mask_dev: Any = None  # cached device active mask
-        self._mask_host: Any = None  # host copy the cache was built from
-        self._last_consume_t: float | None = None
-
-        # admission policy lives in the native scheduler (native/runtime/
-        # gofr_runtime.cc; Python fallback when no toolchain): priority +
-        # FIFO queue, free-slot assignment, per-step prefill token budget
-        self._sched = Scheduler(
-            self.config.max_slots, self.config.max_queue,
-            self.config.prefill_token_budget,
-        )
         # speculative-decode counters (observable uplift: emitted /
         # dispatches > 1 means drafts are being accepted)
         self.spec_stats = {"dispatches": 0, "accepted": 0, "emitted": 0}
@@ -319,6 +283,34 @@ class ServingEngine:
         self._wedged = False
         self._stop_requested = False  # distinguishes "stopped" from "not yet started"
         self._idle = threading.Event()  # set by the loop when drained dry
+        # -- engine supervision state (serving/supervisor.py) --------------
+        # the loop stamps this monotonic heartbeat every iteration; the
+        # supervisor's watchdog reads heartbeat_age() to detect a hung
+        # dispatch that no exception will ever surface
+        self.heartbeat = time.monotonic()
+        self.loop_crashed = False  # the loop thread died with _running set
+        self.device_poisonings = 0  # _fail_all runs that found KV poisoned
+        self._restarting = False  # warm_restart in progress: submit 503s
+        # first dispatch of a signature jit-compiles — slow but MOVING, and
+        # the heartbeat cannot show it (the stamp lands only when the
+        # dispatch returns). _cold_dispatch marks those sections so the
+        # watchdog widens its stall threshold to TPU_ENGINE_COMPILE_GRACE_S
+        # instead of restarting a healthy engine mid-compile. _warmed is
+        # per-process knowledge (the jit cache is process-global), so it
+        # deliberately survives warm_restart.
+        self._warmed: set[tuple] = set()
+        self._cold_key: tuple | None = None
+        # serializes warm_restart against stop()/drain(): exactly one of
+        # them owns the teardown — a drain racing a restart must never
+        # interleave their native-resource frees. RLock: stop() may run
+        # while the same thread already holds it through warm_restart's
+        # failure path.
+        self._lifecycle_mu = threading.RLock()
+        # makes submit's register+enqueue atomic w.r.t. warm_restart's
+        # request sweep and _restarting flips (see submit). Lock order:
+        # _lifecycle_mu → _submit_mu → _count_lock.
+        self._submit_mu = threading.Lock()
+        self._supervisor: Any = None  # EngineSupervisor backref (health)
 
     @classmethod
     def from_checkpoint(
@@ -385,21 +377,41 @@ class ServingEngine:
     def start(self) -> None:
         if self._running:
             return
-        self._running = True
         self._draining = False
         self._wedged = False
         self._stop_requested = False
-        self._idle.clear()
-        self._thread = threading.Thread(target=self._loop, name="serving-engine", daemon=True)
-        self._thread.start()
+        self.loop_crashed = False
+        self._start_loop_thread()
         if self._logger:
             self._logger.info(
                 f"serving engine started: slots={self.config.max_slots} "
                 f"max_seq={self.config.max_seq_len}"
             )
 
+    def _start_loop_thread(self) -> None:
+        """Spawn the engine loop thread — shared by start() and
+        warm_restart so the ordering invariants live in ONE place:
+        the heartbeat is pre-stamped before the thread exists (a watchdog
+        polling the gap must not see a stale age), and self._thread is
+        assigned BEFORE _running flips — a thawing wedged/quarantined
+        predecessor re-checks `me is self._thread` and retires, where the
+        reverse order would let it pass both loop guards and run an
+        iteration it no longer owns."""
+        self.heartbeat = time.monotonic()
+        self._idle.clear()
+        thread = threading.Thread(
+            target=self._loop, name="serving-engine", daemon=True
+        )
+        self._thread = thread
+        self._running = True
+        thread.start()
+
     def stop(self, join_timeout: float = 10.0) -> None:
         self._stop_requested = True  # BEFORE the sweep: see submit's re-check
+        with self._lifecycle_mu:  # a mid-flight warm_restart finishes first
+            self._stop_inner(join_timeout)
+
+    def _stop_inner(self, join_timeout: float) -> None:
         self._running = False
         self._wake.set()
         if self._thread is not None:
@@ -416,6 +428,21 @@ class ServingEngine:
                         f"{join_timeout:g}s; native resources left allocated, "
                         "health will report WEDGED"
                     )
+                # the hung thread can never settle what's registered, and
+                # a wedged engine never will either — fail every future
+                # retriable NOW rather than strand its caller forever.
+                # (Pure host-side future settlement, safe under a live
+                # thread — unlike the native frees below, which stay
+                # skipped; _try_resolve is idempotent if the thread thaws
+                # mid-settle.)
+                with self._count_lock:
+                    leftovers = list(self._by_id.values())
+                    self._by_id.clear()
+                for req in leftovers:
+                    self._settle_future(req, ErrorServiceUnavailable(
+                        "engine wedged; retry on another replica",
+                        retry_after=1.0,
+                    ))
                 return
             self._thread = None
             self._wedged = False  # a later stop() that joins clean recovers
@@ -490,9 +517,203 @@ class ServingEngine:
         self.stop(join_timeout=join_timeout)
         return drained
 
+    def warm_restart(self, join_timeout: float = 5.0) -> bool:
+        """Self-healing restart, driven by the supervisor's watchdog when
+        the loop thread hung, crashed, or keeps poisoning its device state.
+
+        Contract (docs/robustness.md "The engine plane"):
+
+        - in-flight generations fail RETRIABLE (503 + Retry-After /
+          UNAVAILABLE) — their partial KV is gone with the pools;
+        - queued, never-prefilled requests are requeued with their
+          original deadlines (``_Request.deadline`` is absolute) and
+          priority/FIFO order, and complete on the rebuilt engine;
+        - native resources (scheduler, page allocator) are destroyed only
+          when the old thread actually joined; under a still-hung thread
+          they are deliberately QUARANTINE-LEAKED — same rationale as
+          stop()'s wedge path: a leak is recoverable, a use-after-free
+          is not;
+        - executable-level state (KV pools, device-resident decode state,
+          prefix cache) is rebuilt exactly the way __init__ built it.
+
+        Returns True when the engine is serving again. Returns False
+        without touching anything when drain()/stop() already owns the
+        lifecycle — a restart racing a drain resolves to ONE winner.
+        """
+        with self._lifecycle_mu:
+            if self._draining or self._stop_requested or self._wedged:
+                return False  # drain/stop won the race: stand down
+            # BEFORE the sweep, under the submit mutex: any submit section
+            # that already registered has fully enqueued (the sweep below
+            # sees it); any later one observes the flag and fails
+            # retriable without touching the doomed scheduler. BOUNDED
+            # acquire: a submit thread wedged inside a hung scheduler call
+            # can hold the mutex forever — the healing plane must heal
+            # past it, not deadlock behind it (that thread is lost to the
+            # same hang being quarantined; its registered request is swept
+            # and requeued like any other).
+            locked = self._submit_mu.acquire(timeout=max(join_timeout, 1.0))
+            try:
+                self._restarting = True
+            finally:
+                if locked:
+                    self._submit_mu.release()
+            try:
+                old_thread = self._thread
+                self._running = False
+                self._wake.set()
+                joined = True
+                if old_thread is not None:
+                    old_thread.join(timeout=join_timeout)
+                    joined = not old_thread.is_alive()
+                # partition everything registered: queued-never-prefilled
+                # requests survive the restart, in-flight ones cannot (their
+                # KV residency dies with the pools) and fail retriable
+                with self._count_lock:
+                    pending = list(self._by_id.values())
+                    self._by_id.clear()
+                requeue: list[_Request] = []
+                for req in pending:
+                    if req.slot is None and not req.tokens and not req.canceled:
+                        requeue.append(req)
+                    else:
+                        self._settle_future(req, ErrorServiceUnavailable(
+                            "engine restarting; retry", retry_after=1.0,
+                        ))
+                old_sched, old_paged = self._sched, self.paged_cache
+                if joined:
+                    self._thread = None
+                    try:
+                        old_sched.close()
+                    except Exception:
+                        pass
+                    if old_paged is not None:
+                        try:
+                            old_paged.close()
+                        except Exception:
+                            pass
+                else:
+                    # the hung thread may still be inside these objects:
+                    # mark them abandoned, never destroy them — the loop's
+                    # thread-identity guard retires the thread when it thaws
+                    old_sched.leak()
+                    if old_paged is not None:
+                        old_paged.leak()
+                    if self._logger:
+                        self._logger.error(
+                            f"engine thread failed to join within "
+                            f"{join_timeout:g}s during warm restart; old "
+                            "scheduler/KV pool quarantine-leaked"
+                        )
+                # the old thread's compile-grace claim dies with it: if it
+                # is hung inside a cold dispatch, the key describes leaked
+                # state — and the identity-gated clear in _cold_dispatch
+                # means nobody else will ever drop it
+                self._cold_key = None
+                try:
+                    # rebuild EXACTLY what __init__ built — the shared
+                    # helper means a field added there cannot be missed here
+                    self._init_runtime_state()
+                    self._reset_prefix_cache()
+                except Exception:
+                    # the rebuild itself failed (a real device loss can
+                    # leave the allocator refusing KV pools for a while):
+                    # the requeued requests live ONLY in this local list
+                    # now — settle them retriable before the failure
+                    # escapes, or they'd strand forever while the
+                    # supervisor retries over an empty queue
+                    for req in requeue:
+                        self._settle_future(req, ErrorServiceUnavailable(
+                            "engine restart failed; retry", retry_after=1.0,
+                        ))
+                    raise
+                for req in requeue:  # _by_id iteration preserved FIFO order
+                    with self._count_lock:
+                        self._by_id[req.id] = req
+                    try:
+                        self._sched.submit(
+                            req.id, len(req.prompt_ids), req.max_new_tokens,
+                            req.priority,
+                        )
+                    except Exception:
+                        with self._count_lock:
+                            self._by_id.pop(req.id, None)
+                        self._settle_future(req, ErrorServiceUnavailable(
+                            "engine restarting; retry", retry_after=1.0,
+                        ))
+                self.loop_crashed = False
+            finally:
+                # under the (bounded) mutex: a submit section sequenced
+                # after this flip sees the REBUILT scheduler, never the
+                # old one
+                locked = self._submit_mu.acquire(
+                    timeout=max(join_timeout, 1.0)
+                )
+                try:
+                    self._restarting = False
+                finally:
+                    if locked:
+                        self._submit_mu.release()
+            # resume: a fresh loop thread over the rebuilt state
+            self._start_loop_thread()
+            if self._logger:
+                self._logger.warn(
+                    f"engine warm restart complete: {len(requeue)} queued "
+                    f"request(s) requeued, {len(pending) - len(requeue)} "
+                    "in-flight failed retriable"
+                )
+            return True
+
     @property
     def draining(self) -> bool:
         return self._draining
+
+    def heartbeat_age(self) -> float:
+        """Seconds since the loop thread last stamped its heartbeat. Only
+        meaningful while the engine is running — the supervisor's watchdog
+        compares it against TPU_ENGINE_STALL_S."""
+        return time.monotonic() - self.heartbeat
+
+    @property
+    def in_cold_dispatch(self) -> bool:
+        """True while the loop is inside a dispatch whose signature has
+        never completed before — i.e. one that may be jit-compiling. The
+        watchdog widens its stall threshold to compile_grace_s for these:
+        a multi-second first compile is progress, not a hang."""
+        return self._cold_key is not None
+
+    @contextlib.contextmanager
+    def _cold_dispatch(self, *key: Any) -> Any:
+        """Context manager marking a possibly-compiling dispatch section
+        (keyed by executable signature). The key is warmed only when the
+        section completes, so a dispatch that faults keeps its grace."""
+        if key in self._warmed:
+            yield
+            return
+        self._cold_key = key
+        try:
+            yield
+        finally:
+            # only the loop's current owner may clear the marker: a
+            # retired (quarantined) thread thawing out of its dispatch
+            # here must not strip the REPLACEMENT thread's in-flight
+            # compile grace — the watchdog would read a healthy first
+            # compile as a stall and burn restart budget on it. (With no
+            # loop thread at all — direct calls, tests — the caller owns
+            # the marker and clears it.)
+            if self._thread is None or threading.current_thread() is self._thread:
+                self._cold_key = None
+        # warming is process-global truth (the jit cache outlives the
+        # thread), so even a retired thread's completed compile counts
+        self._warmed.add(key)
+
+    def _check_retired(self) -> None:
+        """Quarantine guard for the loop thread: after a warm restart that
+        could not join it, self._thread names a successor — the old thread
+        must unwind NOW (without settling futures or touching rebuilt
+        state), not at the next iteration top."""
+        if threading.current_thread() is not self._thread:
+            raise _ThreadRetired()
 
     def health_check(self) -> dict[str, Any]:
         active = sum(1 for s in self.slots if s is not None)
@@ -506,19 +727,31 @@ class ServingEngine:
             "kv_layout": self.config.kv_layout,
             "shed": self._shed.snapshot(),
         }
+        if self._running:
+            details["heartbeat_age_s"] = round(self.heartbeat_age(), 3)
         if self.paged_cache is not None and self._running:
             details["kv_pages"] = self.paged_cache.stats()
         if self._prefix_cache is not None:
             details["prefix_cache"] = self._prefix_cache.stats()
+        sup = self._supervisor
+        if sup is not None:
+            details["supervisor"] = sup.snapshot()
+        sup_state = sup.state if sup is not None else None
         # UP → DRAINING → DOWN is the normal lifecycle; WEDGED means stop()
-        # timed out joining the engine thread — the process needs replacing,
-        # which is exactly why it must not masquerade as a clean DOWN
-        if self._wedged:
+        # timed out joining the engine thread OR the supervisor spent its
+        # restart budget — the process needs replacing, which is exactly
+        # why it must not masquerade as a clean DOWN. SUSPECT/RESTARTING
+        # are the supervisor's self-healing window.
+        if self._wedged or sup_state == "WEDGED":
             status = "WEDGED"
+        elif self._restarting or sup_state == "RESTARTING":
+            status = "RESTARTING"
         elif not self._running:
             status = "DOWN"
         elif self._draining:
             status = "DRAINING"
+        elif sup_state == "SUSPECT":
+            status = "SUSPECT"
         else:
             status = "UP"
         return {"status": status, "details": details}
@@ -549,6 +782,12 @@ class ServingEngine:
             # retriable: the LB should route the retry to another replica
             raise ErrorServiceUnavailable(
                 "server draining; retry on another replica", retry_after=1.0
+            )
+        if self._restarting:
+            # the supervisor is mid warm-restart: the scheduler/KV pools are
+            # being replaced under us — retriable, the restart is seconds
+            raise ErrorServiceUnavailable(
+                "engine restarting; retry", retry_after=1.0
             )
 
         # load shedding BEFORE any per-request work: rejecting here costs
@@ -596,37 +835,67 @@ class ServingEngine:
             stop_ids={self.tokenizer.eos_id}, deadline=deadline,
         )
         req.priority = priority
-        with self._count_lock:
-            self._by_id[rid] = req
-        try:
-            self._sched.submit(rid, len(prompt_ids), max_new, priority)
-        except QueueFull:
-            with self._count_lock:
-                self._by_id.pop(rid, None)
-            if self._metrics:
-                self._metrics.increment_counter("app_requests_shed_total")
-            raise ErrorTooManyRequests(retry_after=max(est_wait, 1.0)) from None
-        except RuntimeError:
-            # "scheduler closed": lost the race against a concurrent stop()
-            with self._count_lock:
-                self._by_id.pop(rid, None)
+        # registration + enqueue are ATOMIC w.r.t. warm_restart (same
+        # mutex): either the restart's sweep sees this request and
+        # requeues/settles it, or this section observes _restarting and
+        # fails retriable BEFORE touching the scheduler the restart is
+        # about to replace. Without the mutex a submit could register
+        # after the sweep yet enqueue into the old (about-to-be-leaked)
+        # scheduler — stranding a deadline-less future forever — or
+        # enqueue the same rid into the rebuilt scheduler a second time.
+        # _restarting cannot flip while this section holds the mutex:
+        # warm_restart flips it under the same lock.
+        # bounded acquire: if another submit is wedged INSIDE a hung
+        # scheduler call while holding the mutex, fail fast and retriable
+        # instead of piling every client thread up behind it forever
+        if not self._submit_mu.acquire(timeout=5.0):
             raise ErrorServiceUnavailable(
-                "server stopped; retry on another replica", retry_after=1.0
-            ) from None
-        if self._stop_requested:
-            # raced a concurrent stop(): the flag flips BEFORE the leftover
-            # sweep, so either that sweep saw this registration or this
-            # re-check sees the flip — the request cannot strand. (A not-
-            # yet-started engine is fine: submit-then-start is supported.)
-            with self._count_lock:
-                self._by_id.pop(rid, None)
-            try:
-                self._sched.cancel(rid)
-            except Exception:
-                pass
-            raise ErrorServiceUnavailable(
-                "server stopped; retry on another replica", retry_after=1.0
+                "engine busy; retry on another replica", retry_after=1.0
             )
+        try:
+            if self._restarting:
+                raise ErrorServiceUnavailable(
+                    "engine restarting; retry", retry_after=1.0
+                )
+            with self._count_lock:
+                self._by_id[rid] = req
+            try:
+                self._sched.submit(rid, len(prompt_ids), max_new, priority)
+            except QueueFull:
+                with self._count_lock:
+                    self._by_id.pop(rid, None)
+                if self._metrics:
+                    self._metrics.increment_counter("app_requests_shed_total")
+                raise ErrorTooManyRequests(
+                    retry_after=max(est_wait, 1.0)
+                ) from None
+            except RuntimeError:
+                # "scheduler closed": lost the race against a concurrent
+                # stop()
+                with self._count_lock:
+                    self._by_id.pop(rid, None)
+                raise ErrorServiceUnavailable(
+                    "server stopped; retry on another replica",
+                    retry_after=1.0,
+                ) from None
+            if self._stop_requested:
+                # raced a concurrent stop(): the flag (monotonic, unlike
+                # _restarting) flips BEFORE the leftover sweep, so either
+                # that sweep saw this registration or this re-check sees
+                # the flip — the request cannot strand. (A not-yet-started
+                # engine is fine: submit-then-start is supported.)
+                with self._count_lock:
+                    self._by_id.pop(rid, None)
+                try:
+                    self._sched.cancel(rid)
+                except Exception:
+                    pass
+                raise ErrorServiceUnavailable(
+                    "server stopped; retry on another replica",
+                    retry_after=1.0,
+                )
+        finally:
+            self._submit_mu.release()
         self._observe_queue(depth + 1)  # this request just joined the queue
         self._wake.set()
         return future
@@ -682,8 +951,45 @@ class ServingEngine:
 
     # ------------------------------------------------------------- the loop
     def _loop(self) -> None:
+        me = threading.current_thread()
+        try:
+            self._loop_body(me)
+        except _ThreadRetired:
+            return  # quarantined thread thawed: exit, touch nothing
+        except BaseException as exc:
+            # an escape from the body (the engine.step chaos point sits
+            # OUTSIDE the per-step recovery, like a C-extension aborting
+            # mid-dispatch would) is an unhandled loop exit: flag it so the
+            # supervisor's watchdog can tell "crashed" from "stopped"
+            if self._running and me is self._thread:
+                self.loop_crashed = True
+                if self._logger:
+                    import traceback
+
+                    self._logger.error(
+                        "serving engine loop thread died",
+                        stack=traceback.format_exc(limit=20),
+                    )
+            if not isinstance(exc, Exception):
+                raise  # SystemExit/KeyboardInterrupt must propagate
+            # ordinary exceptions end here: the crash flag + log ARE the
+            # signal — re-raising would only spam the thread excepthook
+
+    def _loop_body(self, me: threading.Thread) -> None:
         cfg = self.config
-        while self._running:
+        # the identity guard retires a quarantined thread: after a warm
+        # restart that could not join it, self._thread points at the NEW
+        # loop thread — the old one must exit the moment it thaws instead
+        # of racing the replacement over rebuilt state
+        while self._running and me is self._thread:
+            self.heartbeat = time.monotonic()
+            chaos.maybe_fail("engine.step")
+            if not self._running or me is not self._thread:
+                # stopped or replaced while hung at the chaos point: re-check
+                # the loop condition instead of running one doomed iteration
+                # (a warm_restart waiting in join() has already swept the
+                # queue this iteration would admit from)
+                continue
             try:
                 did_work = self._admit()
                 if any(s is not None for s in self.slots):
@@ -705,7 +1011,11 @@ class ServingEngine:
                         self._idle.set()
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
-            except Exception as exc:  # the loop must never die
+            except Exception as exc:  # the step must never kill the loop
+                # a retired thread's step error is noise from quarantined
+                # state — it must not _fail_all (that would sweep the
+                # REPLACEMENT engine's requests) or keep looping
+                self._check_retired()
                 if self._logger:
                     import traceback
 
@@ -720,7 +1030,17 @@ class ServingEngine:
 
     # -- admission -------------------------------------------------------------
     def _admit(self) -> bool:
-        pairs, canceled_ids = self._sched.admit(self.config.admission_per_step)
+        # bind ONCE: a warm restart that replaces this thread mid-admit
+        # swaps self._sched for a rebuilt one — the pairs delivered below
+        # belong to THIS scheduler, and releases/requeues must never land
+        # on the replacement's
+        sched = self._sched
+        pairs, canceled_ids = sched.admit(self.config.admission_per_step)
+        # the admit call itself can hang (native mutex held under a wedged
+        # step); a thread thawing out of it retired would otherwise process
+        # the old scheduler's pairs against the REPLACEMENT engine's state
+        # — releasing its slots, allocating its pages for requeued rids
+        self._check_retired()
         for rid in canceled_ids:
             with self._count_lock:
                 req = self._by_id.pop(rid, None)
@@ -730,10 +1050,10 @@ class ServingEngine:
             with self._count_lock:
                 req = self._by_id.get(rid)
             if req is None:  # should not happen; release the slot defensively
-                self._sched.release(slot)
+                sched.release(slot)
                 continue
             if req.canceled:  # canceled between admit() and here
-                self._sched.release(slot)
+                sched.release(slot)
                 with self._count_lock:
                     self._by_id.pop(rid, None)
                 self._finish(req, "cancel")
@@ -742,7 +1062,7 @@ class ServingEngine:
                 # expired while queued: NEVER prefill it — the answer is
                 # already useless, the prefill would only steal TTFT from
                 # live requests. 504 / DEADLINE_EXCEEDED to the caller.
-                self._sched.release(slot)
+                sched.release(slot)
                 with self._count_lock:
                     self._by_id.pop(rid, None)
                 self._expire(req)
@@ -755,9 +1075,10 @@ class ServingEngine:
                 # requests must not starve it); the REST of the admitted
                 # batch still proceeds — their slots are already claimed and
                 # the scheduler never re-delivers an admitted pair
-                self._sched.release(slot)
+                self._check_retired()  # warm_restart already requeued it
+                sched.release(slot)
                 try:
-                    self._sched.submit(
+                    sched.submit(
                         rid, len(req.prompt_ids), req.max_new_tokens,
                         req.priority, front=True,
                     )
@@ -767,7 +1088,10 @@ class ServingEngine:
                     self._try_resolve(req, exc=ErrorTooManyRequests())
             except Exception as exc:
                 # a failed prefill must not leak the slot, its KV pages, or
-                # hang the client
+                # hang the client. A RETIRED thread unwinds instead: its
+                # request was already requeued/settled by warm_restart, and
+                # slots/pools here belong to the replacement engine.
+                self._check_retired()
                 self.slots[slot] = None
                 self.cache_len[slot] = 0
                 if self.paged_cache is not None:
@@ -776,7 +1100,7 @@ class ServingEngine:
                     except Exception:
                         pass
                 try:
-                    self._sched.release(slot)
+                    sched.release(slot)
                 except KeyError:
                     pass
                 with self._count_lock:
@@ -786,7 +1110,9 @@ class ServingEngine:
                     self._logger.error(f"prefill failed for request {rid}: {exc}")
                 # pure host-side rejections (queue/page-budget limits) never
                 # touched the device — don't pay a blocking probe for them
-                if not isinstance(exc, ErrorTooManyRequests) and self._kv_unhealthy():
+                if not isinstance(
+                    exc, (ErrorTooManyRequests, ErrorRequestEntityTooLarge)
+                ) and self._kv_unhealthy():
                     # the failing call donated the SHARED cache (insert_slot*/
                     # write_prefill) and died after donation committed: every
                     # active slot's KV is gone, not just this request's —
@@ -812,9 +1138,12 @@ class ServingEngine:
             from gofr_tpu.serving.kv_cache import OutOfBlocks
 
             if self.paged_cache.pages_needed(bucket) > self.paged_cache.num_pages:
-                raise ErrorTooManyRequests(
+                # permanent, not transient: however empty the pool gets,
+                # this prompt can NEVER fit — a 429 would invite clients to
+                # retry forever; 413 / FAILED_PRECONDITION says "shrink it"
+                raise ErrorRequestEntityTooLarge(
                     f"prompt needs {self.paged_cache.pages_needed(bucket)} KV pages; "
-                    f"pool has {self.paged_cache.num_pages}"
+                    f"pool has {self.paged_cache.num_pages} in total"
                 )
             try:
                 self.paged_cache.alloc_slot(
@@ -842,25 +1171,36 @@ class ServingEngine:
         span = self._span(
             f"serve.prefill b{bucket}" + (" (prefix hit)" if cached else "")
         )
-        with span:
+        # bind the KV storage ONCE, before the long dispatch: a warm
+        # restart that replaces this thread mid-compute swaps
+        # self.paged_cache/self.cache for rebuilt ones — re-reading them
+        # after the dispatch would donate the REPLACEMENT engine's pools
+        # from a quarantined thread
+        pc, dense = self.paged_cache, self.cache
+        with span, self._cold_dispatch("prefill", bucket, cached is not None):
             if cached is not None:
                 last_logits, k_slab, v_slab = cached
             else:
                 last_logits, k_slab, v_slab = batch_ops.prefill_compute(
                     cfg, self.params, jnp.asarray(tokens), seq_len
                 )
-                if cache_key is not None:
-                    # slabs are fresh, never-donated arrays: safe to retain
-                    self._prefix_cache.put(cache_key, (last_logits, k_slab, v_slab))
-            if self.paged_cache is not None:
-                self.paged_cache.write_prefill(slot, k_slab, v_slab)
-            elif self.cache.quantized:
+            self._check_retired()  # replaced during the compute: no writes
+            # ...including the prefix cache: a retired thread thawing out
+            # of a device-loss hang would insert DEAD slabs into the cache
+            # warm_restart just reset, poisoning every future hit on this
+            # prefix
+            if cached is None and cache_key is not None:
+                # slabs are fresh, never-donated arrays: safe to retain
+                self._prefix_cache.put(cache_key, (last_logits, k_slab, v_slab))
+            if pc is not None:
+                pc.write_prefill(slot, k_slab, v_slab)
+            elif dense.quantized:
                 self.cache = batch_ops.insert_slot_quantized(
-                    self.cache, k_slab, v_slab, jnp.int32(slot)
+                    dense, k_slab, v_slab, jnp.int32(slot)
                 )
             else:
-                self.cache.k, self.cache.v = batch_ops.insert_slot(
-                    self.cache.k, self.cache.v, k_slab, v_slab, jnp.int32(slot)
+                dense.k, dense.v = batch_ops.insert_slot(
+                    dense.k, dense.v, k_slab, v_slab, jnp.int32(slot)
                 )
             # sample the first token with this request's params
             self.rng, key = jax.random.split(self.rng)
@@ -874,6 +1214,16 @@ class ServingEngine:
             )
             first_id = int(first[0])
 
+        # the dispatch is back: a warm restart may have replaced this
+        # thread while it sat in the compile — commit nothing if so (the
+        # request was requeued; the successor thread redoes the prefill)
+        self._check_retired()
+        # progress stamp: a multi-prefill admission can legitimately
+        # outlast TPU_ENGINE_STALL_S in one loop iteration — the watchdog
+        # must see "slow but moving", not "hung"; a truly stuck dispatch
+        # stamps nothing anywhere (and a first-call jit compile widens the
+        # threshold via _cold_dispatch above)
+        self.heartbeat = time.monotonic()
         req.slot = slot
         req.first_token_at = time.perf_counter()
         self.slots[slot] = req
@@ -892,6 +1242,7 @@ class ServingEngine:
                 "app_ttft_seconds", req.first_token_at - req.created
             )
         self._emit_token(req, first_id)
+        self._check_retired()  # stream_cb may have blocked across a restart
         if first_id in req.stop_ids:
             self._retire(slot, "stop")
         elif len(req.tokens) >= req.max_new_tokens:
@@ -903,6 +1254,7 @@ class ServingEngine:
         The dispatch feeds on step N's device-side tokens directly, so the
         device never waits for host bookkeeping; the host's np.asarray of
         step N's tokens overlaps step N+1's compute."""
+        self._check_retired()  # replaced during a long _admit: unwind first
         if self.config.spec_tokens > 0:
             return self._spec_step()
         inflight = self._dispatch_decode()
@@ -925,6 +1277,10 @@ class ServingEngine:
         models/llama.py:speculative_generate for the library-level twin."""
         cfg = self.model_cfg
         chaos.maybe_fail("decode.dispatch")
+        self._maybe_device_loss()
+        # a hang at the chaos point can outlive a warm restart: re-check
+        # ownership BEFORE reading slots/pools that may since be rebuilt
+        self._check_retired()
         K = self.config.spec_tokens
         T = K + 1
         max_seq = self.config.max_seq_len
@@ -985,7 +1341,8 @@ class ServingEngine:
                                 f"KV pool exhausted; retiring request "
                                 f"{req.id} early"
                             )
-                        self._retire(slot, "length")
+                        req.kv_exhausted = True
+                        self._retire(slot, "kv_exhausted")
                 rows = kept
                 if not rows:
                     return True
@@ -1009,36 +1366,50 @@ class ServingEngine:
         start_d = jnp.asarray(np.maximum(self.cache_len, 1))
 
         t0 = time.perf_counter()
-        if pc is not None:
-            cap = np.zeros(B, np.int32)
-            for slot, _ in rows:
-                cap[slot] = pc.owned_capacity(slot)
-            cap_d = jnp.asarray(cap)
-            if pc.quantized:
-                (out, n_acc, pc.k_pool, pc.v_pool, pc.ks_pool, pc.vs_pool,
-                 self.rng) = batch_ops.verify_and_sample_paged_q(
-                    cfg, self.params, pc.k_pool, pc.v_pool,
-                    pc.ks_pool, pc.vs_pool, pc.tables_device(), chunk_d,
-                    start_d, self._mask_dev, cap_d,
-                    temp_d, topk_d, topp_d, self.rng,
-                )
-            else:
-                (out, n_acc, pc.k_pool, pc.v_pool, self.rng) = (
-                    batch_ops.verify_and_sample_paged(
+        with self._cold_dispatch(
+            "spec", "paged" if pc is not None else "dense",
+            pc.quantized if pc is not None else self.cache.quantized,
+        ):
+            if pc is not None:
+                cap = np.zeros(B, np.int32)
+                for slot, _ in rows:
+                    cap[slot] = pc.owned_capacity(slot)
+                cap_d = jnp.asarray(cap)
+                # unpack into LOCALS (and the pre-bound pc, which a
+                # restart never mutates): a retired thread's unpack must
+                # not clobber the replacement engine's state — self.*
+                # commits happen only after the retirement check below
+                if pc.quantized:
+                    (out, n_acc, pc.k_pool, pc.v_pool, pc.ks_pool,
+                     pc.vs_pool, new_rng) = batch_ops.verify_and_sample_paged_q(
                         cfg, self.params, pc.k_pool, pc.v_pool,
-                        pc.tables_device(), chunk_d, start_d,
-                        self._mask_dev, cap_d,
+                        pc.ks_pool, pc.vs_pool, pc.tables_device(), chunk_d,
+                        start_d, self._mask_dev, cap_d,
                         temp_d, topk_d, topp_d, self.rng,
                     )
+                else:
+                    (out, n_acc, pc.k_pool, pc.v_pool, new_rng) = (
+                        batch_ops.verify_and_sample_paged(
+                            cfg, self.params, pc.k_pool, pc.v_pool,
+                            pc.tables_device(), chunk_d, start_d,
+                            self._mask_dev, cap_d,
+                            temp_d, topk_d, topp_d, self.rng,
+                        )
+                    )
+                new_cache = self.cache  # dense path untouched
+            else:
+                out, n_acc, new_cache, new_rng = batch_ops.verify_and_sample(
+                    cfg, self.params, self.cache, chunk_d, start_d,
+                    temp_d, topk_d, topp_d, self.rng,
                 )
-        else:
-            out, n_acc, self.cache, self.rng = batch_ops.verify_and_sample(
-                cfg, self.params, self.cache, chunk_d, start_d,
-                temp_d, topk_d, topp_d, self.rng,
-            )
 
-        out_np = np.asarray(out)  # gofrlint: disable=host-sync -- the step's only sync point
-        na_np = np.asarray(n_acc)  # gofrlint: disable=host-sync -- already materialized with out above
+            out_np = np.asarray(out)  # gofrlint: disable=host-sync -- the step's only sync point
+            na_np = np.asarray(n_acc)  # gofrlint: disable=host-sync -- already materialized with out above
+        # the sync returned: a warm restart may have replaced this thread
+        # while the chunk verified — commit nothing to rebuilt state if so
+        self._check_retired()
+        self.cache, self.rng = new_cache, new_rng
+        self.heartbeat = time.monotonic()  # the sync returned: progress
         step_time = time.perf_counter() - t0
 
         n_active = 0
@@ -1096,6 +1467,10 @@ class ServingEngine:
         cfg = self.model_cfg
         max_seq = self.config.max_seq_len
         chaos.maybe_fail("decode.dispatch")
+        self._maybe_device_loss()
+        # a hang at the chaos point can outlive a warm restart: re-check
+        # ownership BEFORE reading slots/pools that may since be rebuilt
+        self._check_retired()
 
         rows: list[tuple[int, _Request]] = []
         now = time.perf_counter()
@@ -1150,14 +1525,18 @@ class ServingEngine:
                     if slot in inflight_slots:
                         # a valid token for this row is still in flight:
                         # clamp so no further step is dispatched, deliver
-                        # that token at consume, and length-retire there —
+                        # that token at consume, and retire there —
                         # retiring now would silently drop a token the
                         # client paid for (code-review r4)
+                        if 1 + req.dispatched < req.max_new_tokens:
+                            req.kv_exhausted = True  # the clamp, not the
+                            # budget, is what ends this row
                         req.max_new_tokens = min(
                             req.max_new_tokens, 1 + req.dispatched
                         )
                     else:
-                        self._retire(slot, "length")
+                        req.kv_exhausted = True
+                        self._retire(slot, "kv_exhausted")
             rows = kept
         if not rows:
             return None
@@ -1195,24 +1574,31 @@ class ServingEngine:
             pc = self.paged_cache
             # first chunk token's length: seq_lens already includes all T
             seq_start = jnp.asarray(np.maximum(pc.seq_lens - (T_paged - 1), 1))
-            if pc.quantized:
-                (tokens, last, pc.k_pool, pc.v_pool, pc.ks_pool, pc.vs_pool,
-                 self.rng) = batch_ops.decode_and_sample_paged_multi_q(
-                    cfg, self.params, pc.k_pool, pc.v_pool,
-                    pc.ks_pool, pc.vs_pool,
-                    pc.tables_device(), seq_start,
-                    self._last_tok_dev, mask_d,
-                    temp_d, topk_d, topp_d, self.rng, T_paged,
-                )
-            else:
-                (tokens, last, pc.k_pool, pc.v_pool, self.rng) = (
-                    batch_ops.decode_and_sample_paged_multi(
+            # unpack into LOCALS (and the pre-bound pc): a retired
+            # thread returning from a hung dispatch must not clobber the
+            # replacement engine's state at assignment time — self.*
+            # commits happen only after the retirement check
+            with self._cold_dispatch("decode", "paged", pc.quantized, T_paged):
+                if pc.quantized:
+                    (tokens, last, pc.k_pool, pc.v_pool, pc.ks_pool,
+                     pc.vs_pool, new_rng) = batch_ops.decode_and_sample_paged_multi_q(
                         cfg, self.params, pc.k_pool, pc.v_pool,
+                        pc.ks_pool, pc.vs_pool,
                         pc.tables_device(), seq_start,
                         self._last_tok_dev, mask_d,
                         temp_d, topk_d, topp_d, self.rng, T_paged,
                     )
-                )
+                else:
+                    (tokens, last, pc.k_pool, pc.v_pool, new_rng) = (
+                        batch_ops.decode_and_sample_paged_multi(
+                            cfg, self.params, pc.k_pool, pc.v_pool,
+                            pc.tables_device(), seq_start,
+                            self._last_tok_dev, mask_d,
+                            temp_d, topk_d, topp_d, self.rng, T_paged,
+                        )
+                    )
+            self._check_retired()
+            self.rng = new_rng
             self._last_tok_dev = last
             self.cache_len = pc.seq_lens.copy()
             for _, req in rows:
@@ -1220,24 +1606,27 @@ class ServingEngine:
             return _Inflight(tokens, rows, t0, steps=T_paged)
         if self.paged_cache is not None:
             pc = self.paged_cache
-            if pc.quantized:
-                (next_token, pc.k_pool, pc.v_pool, pc.ks_pool, pc.vs_pool,
-                 self.rng) = batch_ops.decode_and_sample_paged_q(
-                    cfg, self.params, pc.k_pool, pc.v_pool,
-                    pc.ks_pool, pc.vs_pool,
-                    pc.tables_device(), pc.seq_lens_device(),
-                    self._last_tok_dev, mask_d,
-                    temp_d, topk_d, topp_d, self.rng,
-                )
-            else:
-                (next_token, pc.k_pool, pc.v_pool, self.rng) = (
-                    batch_ops.decode_and_sample_paged(
+            with self._cold_dispatch("decode", "paged", pc.quantized, 1):
+                if pc.quantized:
+                    (next_token, pc.k_pool, pc.v_pool, pc.ks_pool,
+                     pc.vs_pool, new_rng) = batch_ops.decode_and_sample_paged_q(
                         cfg, self.params, pc.k_pool, pc.v_pool,
+                        pc.ks_pool, pc.vs_pool,
                         pc.tables_device(), pc.seq_lens_device(),
                         self._last_tok_dev, mask_d,
                         temp_d, topk_d, topp_d, self.rng,
                     )
-                )
+                else:
+                    (next_token, pc.k_pool, pc.v_pool, new_rng) = (
+                        batch_ops.decode_and_sample_paged(
+                            cfg, self.params, pc.k_pool, pc.v_pool,
+                            pc.tables_device(), pc.seq_lens_device(),
+                            self._last_tok_dev, mask_d,
+                            temp_d, topk_d, topp_d, self.rng,
+                        )
+                    )
+            self._check_retired()  # commit to self only as the loop's owner
+            self.rng = new_rng
             self.cache_len = pc.seq_lens.copy()
         else:
             # chunk size is ALL-or-one: the full multi_step chunk only when
@@ -1250,24 +1639,35 @@ class ServingEngine:
                     and self._chunk_absorb(rows) >= self.config.multi_step):
                 T = self.config.multi_step
             if T > 1:
-                (tokens, last, self.cache, self._cache_len_dev, self.rng) = (
-                    batch_ops.decode_and_sample_multi(
+                with self._cold_dispatch("decode", "dense",
+                                         self.cache.quantized, T):
+                    (tokens, last, new_cache, new_clen,
+                     new_rng) = batch_ops.decode_and_sample_multi(
                         cfg, self.params, self.cache,
                         self._last_tok_dev, self._cache_len_dev, mask_d,
                         temp_d, topk_d, topp_d, self.rng, T,
                     )
+                self._check_retired()  # commit only as the loop's owner
+                self.cache, self._cache_len_dev, self.rng = (
+                    new_cache, new_clen, new_rng,
                 )
                 self._last_tok_dev = last
                 for slot, req in rows:
                     self.cache_len[slot] += T
                     req.dispatched += T
                 return _Inflight(tokens, rows, t0, steps=T)
-            next_token, self.cache, self._cache_len_dev, self.rng = (
-                batch_ops.decode_and_sample_pipelined(
-                    cfg, self.params, self.cache,
-                    self._last_tok_dev, self._cache_len_dev, mask_d,
-                    temp_d, topk_d, topp_d, self.rng,
+            with self._cold_dispatch("decode", "dense",
+                                     self.cache.quantized, 1):
+                next_token, new_cache, new_clen, new_rng = (
+                    batch_ops.decode_and_sample_pipelined(
+                        cfg, self.params, self.cache,
+                        self._last_tok_dev, self._cache_len_dev, mask_d,
+                        temp_d, topk_d, topp_d, self.rng,
+                    )
                 )
+            self._check_retired()  # commit only as the loop's owner
+            self.cache, self._cache_len_dev, self.rng = (
+                new_cache, new_clen, new_rng,
             )
             for slot, _ in rows:
                 self.cache_len[slot] += 1
@@ -1278,6 +1678,12 @@ class ServingEngine:
 
     def _consume_decode(self, rec: _Inflight) -> None:
         next_ids = np.asarray(rec.next_token)  # gofrlint: disable=host-sync -- the pipeline's only sync point
+        # the sync returned: a warm restart may have replaced this thread
+        # while it waited — its tokens belong to requests already settled
+        # or requeued, so commit nothing (and don't stamp a heartbeat that
+        # would mask the REPLACEMENT thread's health)
+        self._check_retired()
+        self.heartbeat = time.monotonic()  # the sync returned: progress
         now = time.perf_counter()
         step_time = now - (
             self._last_consume_t if self._last_consume_t is not None
@@ -1317,6 +1723,11 @@ class ServingEngine:
         and the speculative commit paths."""
         self.last_token[slot] = token_id
         self._emit_token(req, token_id)
+        # a stream_cb is client code and can block for minutes: a warm
+        # restart may have replaced this thread while it sat inside the
+        # emit — the retire chain below would free the REPLACEMENT
+        # engine's slot/pages, so a retired thread unwinds here instead
+        self._check_retired()
         if req.canceled:
             self._retire(slot, "cancel")
         elif req.expired(time.perf_counter()):
@@ -1324,7 +1735,9 @@ class ServingEngine:
         elif token_id in req.stop_ids:
             self._retire(slot, "stop")
         elif len(req.tokens) >= req.max_new_tokens:
-            self._retire(slot, "length")
+            # a pool-pressure clamp reports its own reason: "length" must
+            # stay unambiguous — "the request's own token budget ran out"
+            self._retire(slot, "kv_exhausted" if req.kv_exhausted else "length")
         elif len(req.prompt_ids) + len(req.tokens) >= self.config.max_seq_len:
             self._retire(slot, "length")
 
@@ -1399,6 +1812,8 @@ class ServingEngine:
         self._shed.observe_request(now - req.created)
         if reason == "deadline_exceeded" and self._metrics:
             self._metrics.increment_counter("app_requests_deadline_exceeded_total")
+        if reason == "kv_exhausted" and self._metrics:
+            self._metrics.increment_counter("app_requests_kv_exhausted_total")
         out_ids = [t for t in req.tokens if t not in req.stop_ids]
         result = GenerationResult(
             request_id=req.id,
@@ -1416,6 +1831,48 @@ class ServingEngine:
             except Exception:
                 pass
         self._try_resolve(req, value=result)
+
+    def _reset_prefix_cache(self) -> None:
+        """A DEVICE-level failure may have poisoned cached prefill slabs
+        the same way it poisoned the live KV (host-only exceptions can't,
+        so the cache survives those); a cold prefix cache only costs
+        recompute, a dead one fails every hit forever. Injected caches
+        follow the container Cache protocol, which has no clear() — drop
+        an unclearable cache rather than keep serving poisoned entries
+        out of it."""
+        if self._prefix_cache is None:
+            return
+        clear = getattr(self._prefix_cache, "clear", None)
+        try:
+            if clear is not None:
+                clear()
+            else:
+                self._prefix_cache = None
+        except Exception:
+            self._prefix_cache = None
+
+    def _maybe_device_loss(self) -> None:
+        """The ``device.loss`` chaos point: when the schedule says this
+        dispatch loses the device, the persistent KV buffers are POISONED
+        for real (deleted, exactly what a failed-after-donation dispatch
+        leaves behind) before the fault propagates — so recovery exercises
+        the genuine rebuild path, not a pretend one."""
+        try:
+            chaos.maybe_fail("device.loss")
+        except Exception:
+            self._poison_device()
+            raise
+
+    def _poison_device(self) -> None:
+        try:
+            if self.cache is not None:
+                self.cache.k.delete()
+                self.cache.v.delete()
+            elif self.paged_cache is not None:
+                self.paged_cache.k_pool.delete()
+                self.paged_cache.v_pool.delete()
+        except Exception:
+            pass  # already deleted / backend gone: the poison took either way
 
     def _kv_unhealthy(self) -> bool:
         """True when the persistent KV storage cannot serve another step:
@@ -1451,6 +1908,79 @@ class ServingEngine:
             kv_dtype="int8" if self.config.kv_dtype == "int8" else None,
         )
 
+    def _make_paged_cache(self):
+        """The one paged pool constructor, shared by __init__ and the
+        supervisor's warm restart so a rebuilt pool can never drift from
+        the one the engine started with."""
+        from gofr_tpu.ops.paged_attention import INT8_MIN_PAGE
+        from gofr_tpu.serving.kv_cache import PagedKVCache
+
+        B, S = self.config.max_slots, self.config.max_seq_len
+        page = self.config.kv_page_size
+        if self.config.kv_dtype == "int8" and page < INT8_MIN_PAGE:
+            import jax as _jax
+
+            if _jax.default_backend() == "tpu":
+                # below the int8 Mosaic tile the kernel would silently
+                # fall back to the full-gather reference, INVERTING the
+                # bandwidth win int8 exists for (code-review r4)
+                raise ValueError(
+                    f"TPU_KV_DTYPE=int8 with TPU_KV_LAYOUT=paged needs "
+                    f"TPU_KV_PAGE_SIZE>={INT8_MIN_PAGE} on TPU (got "
+                    f"{page}): smaller pages violate the int8 Mosaic "
+                    "tile and lose the halved-bandwidth kernel path"
+                )
+        num_pages = self.config.kv_num_pages or (B * S + page - 1) // page
+        return PagedKVCache(
+            self.model_cfg, num_pages=num_pages, page_size=page,
+            max_slots=B, max_seq_len=S,
+            kv_dtype="int8" if self.config.kv_dtype == "int8" else None,
+        )
+
+    def _init_runtime_state(self) -> None:
+        """Executable-level mutable state, built HERE and only here so
+        __init__ and the supervisor's warm restart can never drift: the KV
+        storage, the per-slot sampling/length arrays, the pipelined-decode
+        device state, and the admission scheduler. A field added to one
+        construction path but not the other would survive a restart with
+        stale shape or contents and only fail on the first post-restart
+        batch — sharing the constructor makes that class of bug impossible.
+
+        Admission policy lives in the native scheduler (native/runtime/
+        gofr_runtime.cc; Python fallback when no toolchain): priority +
+        FIFO queue, free-slot assignment, per-step prefill token budget.
+
+        Pipelined-decode state (VERDICT r3 weak #2): the old loop synced
+        on np.asarray(next_token) before dispatching the next step,
+        strictly alternating host and device work — ~14× over raw decode.
+        Now step N+1 is dispatched from step N's DEVICE-side tokens and
+        the host consumes step N's copy while N+1 runs."""
+        B = self.config.max_slots
+        if self.config.kv_layout == "paged":
+            self.paged_cache = self._make_paged_cache()
+            self.cache = None
+        else:
+            self.paged_cache = None
+            self.cache = self._make_dense_cache()
+        self.cache_len = np.zeros(B, np.int32)  # host copy (authoritative)
+        self.last_token = np.zeros(B, np.int32)
+        self.temperature = np.ones(B, np.float32)
+        self.top_k = np.zeros(B, np.int32)
+        self.top_p = np.ones(B, np.float32)
+        self.slots: list[_Request | None] = [None] * B
+        self._inflight: _Inflight | None = None
+        self._last_tok_dev: Any = None  # device-resident last tokens [B]
+        self._cache_len_dev: Any = None  # device-resident lengths (dense path)
+        self._pending_tok: dict[int, tuple[int, int]] = {}  # slot → (token, len)
+        self._samp_dev: tuple | None = None  # cached device sampling params
+        self._mask_dev: Any = None  # cached device active mask
+        self._mask_host: Any = None  # host copy the cache was built from
+        self._last_consume_t: float | None = None
+        self._sched = Scheduler(
+            self.config.max_slots, self.config.max_queue,
+            self.config.prefill_token_budget,
+        )
+
     def _rebuild_kv(self) -> None:
         """Reallocate the persistent KV storage after donated buffers were
         lost mid-dispatch. Every slot's residency is gone, so this only
@@ -1480,6 +2010,10 @@ class ServingEngine:
         if kv_unhealthy is None:
             kv_unhealthy = self._kv_unhealthy()  # callers pass a fresh verdict
         if kv_unhealthy:
+            # visible to the supervisor's watchdog: repeated poisonings in a
+            # short window mean the in-place KV rebuild is not sticking —
+            # escalate to a full warm restart instead of thrashing here
+            self.device_poisonings += 1
             try:
                 self._rebuild_kv()
             except Exception as rebuild_exc:
@@ -1487,22 +2021,7 @@ class ServingEngine:
                 # failure re-enters _fail_all and retries the rebuild
                 if self._logger:
                     self._logger.error(f"KV rebuild failed: {rebuild_exc}")
-            if self._prefix_cache is not None:
-                # a DEVICE-level failure may have poisoned cached prefill
-                # slabs the same way (host-only exceptions can't, so the
-                # cache survives those); a cold prefix cache only costs
-                # recompute, a dead one fails every hit forever. Injected
-                # caches follow the container Cache protocol, which has no
-                # clear() — drop an unclearable cache rather than keep
-                # serving poisoned entries out of it.
-                clear = getattr(self._prefix_cache, "clear", None)
-                try:
-                    if clear is not None:
-                        clear()
-                    else:
-                        self._prefix_cache = None
-                except Exception:
-                    self._prefix_cache = None
+            self._reset_prefix_cache()
         for slot, req in enumerate(self.slots):
             if req is not None:
                 self.slots[slot] = None

@@ -43,6 +43,19 @@ def _shutdown_hook(engine: Any) -> Any:
     return getattr(engine, "drain", None) or engine.stop
 
 
+def _wire_lifecycle(app: Any, engine: Any, supervisor: Any) -> None:
+    """Start/shutdown hooks for the generation surfaces. With a
+    supervisor (serving/supervisor.py), IT owns the lifecycle: start
+    brings up engine + watchdog, shutdown halts the watchdog FIRST so the
+    deliberate drain quiet-period is never detected as a stall."""
+    if supervisor is not None:
+        app.on_start(lambda ctx: supervisor.start())
+        app.on_shutdown(supervisor.drain)
+    else:
+        app.on_start(lambda ctx: engine.start())
+        app.on_shutdown(_shutdown_hook(engine))
+
+
 def deadline_from_ctx(ctx: Any) -> float | None:
     """The HTTP deadline contract: ``X-Request-Timeout`` (or bare
     ``Request-Timeout``), seconds, float. Invalid values are a client
@@ -57,10 +70,10 @@ def deadline_from_ctx(ctx: Any) -> float | None:
     return value if value > 0 else None
 
 
-def register_generation_routes(app: Any, engine: Any, prefix: str = "") -> None:
+def register_generation_routes(app: Any, engine: Any, prefix: str = "",
+                               supervisor: Any = None) -> None:
     app.container.serving = engine
-    app.on_start(lambda ctx: engine.start())
-    app.on_shutdown(_shutdown_hook(engine))
+    _wire_lifecycle(app, engine, supervisor)
 
     async def generate(ctx: Any):
         body = ctx.bind(GenerateRequest)
@@ -181,7 +194,8 @@ def _validated_generate_kwargs(body: GenerateRequest) -> dict:
     )
 
 
-def register_generation_ws(app: Any, engine: Any, path: str = "/ws/generate") -> None:
+def register_generation_ws(app: Any, engine: Any, path: str = "/ws/generate",
+                           supervisor: Any = None) -> None:
     """WebSocket token streaming: each inbound message is a generate
     request; tokens push back as JSON frames, then a final summary frame.
     The WS twin of the SSE stream (gofr websocket.go:30-49 handler loop ×
@@ -189,8 +203,7 @@ def register_generation_ws(app: Any, engine: Any, path: str = "/ws/generate") ->
     framing. Wires the engine lifecycle like register_generation_routes,
     so registering only the WS surface still serves."""
     app.container.serving = engine
-    app.on_start(lambda ctx: engine.start())
-    app.on_shutdown(_shutdown_hook(engine))
+    _wire_lifecycle(app, engine, supervisor)
 
     async def ws_generate(ctx: Any):
         body = ctx.bind(GenerateRequest)

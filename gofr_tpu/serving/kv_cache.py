@@ -300,3 +300,10 @@ class PagedKVCache:
 
     def close(self) -> None:
         self.allocator.close()
+
+    def leak(self) -> None:
+        """Quarantine-leak the native allocator (engine warm restart under
+        a hung thread): the page pools are plain device arrays the GC can
+        reclaim once the thread thaws, but the native handle must never be
+        destroyed under a thread that may still be inside it."""
+        self.allocator.leak()

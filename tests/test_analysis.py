@@ -324,6 +324,144 @@ def test_method_reference_handler_flagged(tmp_path):
     assert [f.rule for f in findings] == ["pubsub-manual-settle"]
 
 
+# ------------------------------------------------- daemon loop heartbeat
+def test_daemon_while_true_without_check_flagged(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/poller.py": (
+            "import threading\n"
+            "def worker():\n"
+            "    while True:\n"
+            "        poll()\n"
+            "def start():\n"
+            "    threading.Thread(target=worker, daemon=True).start()\n"
+        ),
+    })
+    assert [f.rule for f in findings] == ["daemon-loop-no-heartbeat"]
+    assert findings[0].line == 3
+
+
+def test_daemon_method_target_while_true_flagged(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/poller.py": (
+            "import threading\n"
+            "class P:\n"
+            "    def _loop(self):\n"
+            "        while True:\n"
+            "            self.step()\n"
+            "    def start(self):\n"
+            "        self._t = threading.Thread(target=self._loop, daemon=True)\n"
+            "        self._t.start()\n"
+        ),
+    })
+    assert [f.rule for f in findings] == ["daemon-loop-no-heartbeat"]
+
+
+def test_daemon_loop_with_stop_event_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/poller.py": (
+            "import threading\n"
+            "class P:\n"
+            "    def _loop(self):\n"
+            "        while True:\n"
+            "            if self._stop.is_set():\n"
+            "                return\n"
+            "            self.step()\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._loop, daemon=True).start()\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_daemon_loop_with_wake_throttle_wait_still_flagged(tmp_path):
+    """A throttling wait on a non-lifecycle event (`self._wake.wait(0.05)`)
+    must NOT count as supervision: the loop is still unstoppable and
+    unwatchable — the rule's primary target pattern."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/poller.py": (
+            "import threading\n"
+            "class P:\n"
+            "    def _loop(self):\n"
+            "        while True:\n"
+            "            self.step()\n"
+            "            self._wake.wait(0.05)\n"
+            "            self._wake.clear()\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._loop, daemon=True).start()\n"
+        ),
+    })
+    assert [f.rule for f in findings] == ["daemon-loop-no-heartbeat"]
+
+
+def test_daemon_loop_sibling_class_same_name_not_flagged(tmp_path):
+    """A `self.<m>` registration scopes to its class: an unrelated
+    same-named method of a sibling class (never run on a daemon thread)
+    must not be cross-flagged."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/poller.py": (
+            "import threading\n"
+            "class A:\n"
+            "    def _loop(self):\n"
+            "        while True:\n"
+            "            if self._stop.is_set():\n"
+            "                return\n"
+            "            self.step()\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._loop, daemon=True).start()\n"
+            "class B:\n"
+            "    def _loop(self):  # plain iterator helper, never a thread\n"
+            "        while True:\n"
+            "            if self.advance():\n"
+            "                break\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_daemon_loop_with_heartbeat_stamp_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/poller.py": (
+            "import threading, time\n"
+            "class P:\n"
+            "    def _loop(self):\n"
+            "        while True:\n"
+            "            self.heartbeat = time.monotonic()\n"
+            "            self.step()\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._loop, daemon=True).start()\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_non_daemon_while_true_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/poller.py": (
+            "import threading\n"
+            "def worker():\n"
+            "    while True:\n"
+            "        poll()\n"
+            "def start():\n"
+            "    threading.Thread(target=worker).start()\n"  # not daemon
+        ),
+    })
+    assert findings == []
+
+
+def test_daemon_loop_testutil_exempt(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/testutil/fake_server.py": (
+            "import threading\n"
+            "def _accept_loop():\n"
+            "    while True:\n"
+            "        accept()\n"
+            "def start():\n"
+            "    threading.Thread(target=_accept_loop, daemon=True).start()\n"
+        ),
+    })
+    assert findings == []
+
+
 # ---------------------------------------------------------------- real tree
 def test_real_tree_is_clean():
     """The acceptance bar: gofrlint exits 0 on the repo itself."""

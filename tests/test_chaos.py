@@ -44,7 +44,8 @@ TERMINAL_ERRORS = (
     ErrorDeadlineExceeded,
     chaos.ChaosFault,
 )
-TERMINAL_REASONS = {"stop", "length", "cancel", "deadline_exceeded"}
+TERMINAL_REASONS = {"stop", "length", "kv_exhausted", "cancel",
+                    "deadline_exceeded"}
 
 
 def tiny_cfg(max_seq: int = 64) -> llama.LlamaConfig:

@@ -269,3 +269,122 @@ def test_container_drain_flag_aggregates_and_rejects():
         disp(Request("GET", "/.well-known/alive", {}, {}, b""))
     )
     assert health.status != 503
+
+
+# -- permanent rejections & KV-exhaustion honesty -----------------------------
+
+class _RecMetrics:
+    def __init__(self):
+        self.counters: dict = {}
+
+    def increment_counter(self, name, *a, **kw):
+        self.counters[name] = self.counters.get(name, 0) + 1
+
+    def set_gauge(self, *a, **kw):
+        pass
+
+    def record_histogram(self, *a, **kw):
+        pass
+
+
+def test_never_fit_prompt_is_413_not_429():
+    """A prompt needing more KV pages than the whole pool HOLDS is a
+    permanent condition: 413 (non-retriable, no Retry-After), never a 429
+    that invites clients to retry forever."""
+    from gofr_tpu.http.errors import ErrorRequestEntityTooLarge
+
+    # bucket 32 -> 4 pages of 8; the pool holds 3 in total
+    eng = make_engine(kv_layout="paged", kv_page_size=8, kv_num_pages=3,
+                      prefill_buckets=(16, 32))
+    eng.start()
+    try:
+        fut = eng.submit("x" * 20, max_new_tokens=4)  # bucket 32
+        with pytest.raises(ErrorRequestEntityTooLarge) as exc_info:
+            fut.result(timeout=60)
+        assert exc_info.value.status_code == 413
+        assert exc_info.value.retry_after is None
+        assert exc_info.value.response_headers() == {}  # no Retry-After
+        # the engine is unharmed: a fitting prompt serves right after
+        res = eng.submit("ok", max_new_tokens=3).result(timeout=60)
+        assert res.finish_reason in ("stop", "length")
+    finally:
+        eng.stop()
+
+
+def test_grpc_maps_413_to_failed_precondition():
+    import asyncio
+
+    grpc = pytest.importorskip("grpc")
+    from gofr_tpu.grpcx.inference import _abort_lifecycle
+    from gofr_tpu.http.errors import ErrorRequestEntityTooLarge
+
+    class AbortCalled(Exception):
+        pass
+
+    class Ctx:
+        code = None
+
+        async def abort(self, code, message):
+            self.code = code
+            raise AbortCalled()
+
+        def set_trailing_metadata(self, md):
+            pass
+
+    ctx = Ctx()
+    with pytest.raises(AbortCalled):
+        asyncio.run(_abort_lifecycle(ctx, ErrorRequestEntityTooLarge("too big")))
+    assert ctx.code == grpc.StatusCode.FAILED_PRECONDITION
+
+
+def test_kv_exhaustion_reports_its_own_reason_and_metric():
+    """Mid-decode pool exhaustion used to retire rows as "length" —
+    indistinguishable from a legitimate max-tokens stop. It now reports
+    finish_reason "kv_exhausted" and counts in
+    app_requests_kv_exhausted_total."""
+    metrics = _RecMetrics()
+    cfg = tiny_cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = ServingEngine(
+        cfg, params,
+        EngineConfig(max_slots=1, max_seq_len=64, prefill_buckets=(16,),
+                     kv_layout="paged", kv_page_size=8, kv_num_pages=3),
+        ByteTokenizer(cfg.vocab_size), metrics=metrics,
+    )
+    eng.start()
+    try:
+        # bucket 16 -> 2 pages; decode grows past 24 tokens -> needs a 4th
+        res = eng.submit("abcdefghijklmn", max_new_tokens=40).result(timeout=120)
+        assert res.finish_reason == "kv_exhausted"
+        assert 0 < res.completion_tokens < 40
+        assert metrics.counters.get("app_requests_kv_exhausted_total") == 1
+    finally:
+        eng.stop()
+
+
+def test_kv_exhaustion_reaches_stream_consumers():
+    """The transport contract: SSE's terminal event, the gRPC done frame
+    and the WS summary all read the stream's final GenerationResult via
+    on_result — kv_exhausted must arrive there, end-to-end."""
+    import asyncio
+
+    eng = make_engine(kv_layout="paged", kv_page_size=8, kv_num_pages=3,
+                      max_slots=1)
+    eng.start()
+    try:
+        final: dict = {}
+
+        async def consume():
+            tokens = []
+            async for token_id, piece in eng.stream(
+                "abcdefghijklmn", max_new_tokens=40,
+                on_result=lambda r: final.setdefault("result", r),
+            ):
+                tokens.append(token_id)
+            return tokens
+
+        tokens = asyncio.run(consume())
+        assert tokens  # partial output was delivered before the pool dried up
+        assert final["result"].finish_reason == "kv_exhausted"
+    finally:
+        eng.stop()

@@ -119,6 +119,45 @@ def test_circuit_breaker_opens_and_recovers(backend):
     assert svc.get("fail").ok
 
 
+def test_circuit_breaker_state_gauge(backend):
+    """An open breaker used to surface only via health_check() details;
+    the app_service_breaker_state gauge (0 closed / 1 open, one series per
+    address) makes it visible in Prometheus."""
+
+    class Rec:
+        def __init__(self):
+            self.gauges = {}
+
+        def set_gauge(self, name, value, **labels):
+            self.gauges[(name, tuple(sorted(labels.items())))] = value
+
+        def increment_counter(self, *a, **kw):
+            pass
+
+        def record_histogram(self, *a, **kw):
+            pass
+
+    metrics = Rec()
+    _Handler.fail_count = 10
+    svc = new_http_service(
+        backend, None, metrics, None,
+        CircuitBreakerConfig(threshold=2, interval=0.1),
+    )
+    key = ("app_service_breaker_state", (("address", backend.rstrip("/")),))
+    assert metrics.gauges[key] == 0.0  # the closed state is visible from t=0
+    svc.get("fail")
+    svc.get("fail")
+    assert svc.is_open
+    assert metrics.gauges[key] == 1.0
+    # the probe loop closes the breaker off the healthy /.well-known/alive
+    deadline = time.time() + 5
+    while svc.is_open and time.time() < deadline:
+        time.sleep(0.05)
+    assert not svc.is_open
+    assert metrics.gauges[key] == 0.0
+    _Handler.fail_count = 0
+
+
 def test_auth_and_header_options(backend):
     _Handler.calls.clear()
     svc = new_http_service(

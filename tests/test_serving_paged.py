@@ -76,7 +76,7 @@ def test_pool_exhaustion_requeues_and_recovers(setup):
         futs = [engine.submit("abcdefghij", max_new_tokens=6) for _ in range(5)]
         results = [f.result(timeout=180) for f in futs]
         for r in results:
-            assert r.finish_reason in ("stop", "length")
+            assert r.finish_reason in ("stop", "length", "kv_exhausted")
             assert r.completion_tokens > 0
         stats = engine.paged_cache.stats()
         assert stats["free_blocks"] == stats["total_blocks"]  # all freed
@@ -94,7 +94,9 @@ def test_decode_outgrowing_pool_retires_early(setup):
         # bucket 16 -> 2 pages; decode grows past 24 tokens -> needs a 4th page
         fut = engine.submit("abcdefghijklmn", max_new_tokens=40)
         res = fut.result(timeout=120)
-        assert res.finish_reason == "length"
+        # pool pressure reports its OWN reason — "length" would be
+        # indistinguishable from a legitimate max-tokens stop
+        assert res.finish_reason == "kv_exhausted"
         assert 0 < res.completion_tokens < 40
         # engine still serves after the early retirement
         res2 = engine.submit("ok", max_new_tokens=3).result(timeout=120)
@@ -152,7 +154,7 @@ def test_paged_multi_step_pool_pressure_falls_back(setup):
         futs = [engine.submit("abcdefghij", max_new_tokens=6) for _ in range(5)]
         results = [f.result(timeout=180) for f in futs]
         for r in results:
-            assert r.finish_reason in ("stop", "length")
+            assert r.finish_reason in ("stop", "length", "kv_exhausted")
             assert r.completion_tokens > 0
         stats = engine.paged_cache.stats()
         assert stats["free_blocks"] == stats["total_blocks"]
