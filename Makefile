@@ -5,7 +5,7 @@
 PY ?= python
 ASAN_RT := $(shell g++ -print-file-name=libasan.so 2>/dev/null)
 
-.PHONY: check ci import-check lint lock-order test bench-smoke native native-asan chaos
+.PHONY: check ci import-check lint lock-order test bench-smoke bench-check native native-asan chaos
 
 check: import-check lint test native-asan bench-smoke
 	@echo "CHECK OK"
@@ -16,7 +16,7 @@ check: import-check lint test native-asan bench-smoke
 # DO run again inside tier-1; the explicit first pass is a deliberate
 # fail-fast — a broken analyzer surfaces in ~30 s, not after the ~15 min
 # full suite.
-ci: lint
+ci: lint bench-check
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_analysis.py tests/test_shardcheck.py -q
 	$(MAKE) chaos
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -61,6 +61,14 @@ test:
 
 bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py
+
+# ratcheted perf gate (docs/performance.md#bench-ratchet): committed
+# bench records must stay above the floors in analysis/bench_floors.json.
+# Pure JSONL comparison — no jax import, no TPU; a real TPU bench run
+# appends evidence to BENCH_LOCAL.jsonl and `bench.py --update-floors`
+# ratchets the floors up.
+bench-check:
+	$(PY) bench.py --check
 
 native:
 	$(MAKE) -C native

@@ -1163,5 +1163,23 @@ def _engine_metrics() -> Any:
     return m
 
 
+def _cli(argv: list[str]) -> int | None:
+    """``--check [run.jsonl ...]`` gates committed/observed bench records
+    against the ratcheted floors (analysis/bench_floors.json) WITHOUT
+    touching jax or the TPU — the CI perf gate (`make bench-check`).
+    ``--update-floors`` ratchets the floors up to the best committed
+    values. No flag → run the benchmarks. docs/performance.md."""
+    if not argv or argv[0] not in ("--check", "--update-floors"):
+        return None
+    from gofr_tpu.analysis.bench_ratchet import run_check
+
+    paths = argv[1:] or [os.path.join(_REPO, "BENCH_LOCAL.jsonl")]
+    return run_check(paths, update=argv[0] == "--update-floors")
+
+
 if __name__ == "__main__":
-    main()
+    rc = _cli(sys.argv[1:])
+    if rc is None:
+        main()
+    else:
+        sys.exit(rc)

@@ -77,12 +77,15 @@ BACKOFF_ZONES: dict[str, set[str] | str] = {
     "gofr_tpu/datasource/sql/pool.py": "*",
 }
 
-# decode hot path: ONE annotated sync point per step, nothing else
+# decode hot path: ONE annotated sync point per N-step block (engine.py
+# _block_sync), nothing else — the dispatch, spec, and commit functions
+# are all in the zone
 HOT_SYNC_ZONES: dict[str, set[str] | str] = {
     "gofr_tpu/serving/engine.py": {
         "_loop", "_loop_body", "_decode_step", "_spec_step",
-        "_dispatch_decode", "_consume_decode", "_commit_token",
-        "_emit_token", "_chunk_absorb",
+        "_dispatch_decode", "_consume_block", "_commit_token",
+        "_emit_token", "_emit_async", "_block_sync", "_slot_in_flight",
+        "_make_device_state", "_retire",
     },
     "gofr_tpu/serving/batch.py": "*",
 }
@@ -106,6 +109,17 @@ HOST_SYNC_CALLS = {
     "jax.device_get",
 }
 HOST_SYNC_METHODS = {"block_until_ready", "item"}
+# int()/float()/bool() on a DEVICE value is a hidden sync (jax __int__
+# blocks until the array materializes). An AST lint cannot type-infer, so
+# taint heuristically: names assigned (incl. tuple unpacks) from calls
+# rooted in these modules / with these terminal names produce device
+# values, and so do dotted names with a device-marker suffix. np.asarray
+# results are HOST values — materialization is the flagged sync itself,
+# so converting them afterwards is clean.
+DEVICE_PRODUCER_ROOTS = {"jnp", "jax", "batch_ops"}
+DEVICE_PRODUCER_NAMES = {"sample_logits", "prefill_compute"}
+DEVICE_NAME_SUFFIXES = ("_dev", "_device")
+HOST_CONVERT_CALLS = {"int", "float", "bool"}
 
 # native-layer status codes: functions WITHOUT a status return (string
 # accessors) are exempt from ctypes-unchecked
@@ -201,7 +215,93 @@ class BlockingCallRule(Rule):
 
 
 class HostSyncRule(Rule):
+    """``host-sync``: flags explicit materializations (np.asarray,
+    jax.device_get, .item(), .block_until_ready()) AND the hidden ones —
+    ``int()``/``float()``/``bool()`` on a device value blocks exactly like
+    np.asarray does. Device values are tracked heuristically per function:
+    names assigned from calls rooted in jnp/jax/batch_ops (or known
+    producer names like sample_logits), names copied from tainted names,
+    and dotted names carrying a device-marker suffix (``_dev``,
+    ``_device``). Results of np.asarray/np.array are HOST values — the
+    materialization itself is the (annotatable) sync, so converting them
+    afterwards is clean. ``.shape``/``.dtype``-style metadata reads never
+    taint a conversion."""
+
     name = "host-sync"
+
+    _BENIGN_META = {"shape", "ndim", "dtype", "size"}
+
+    def _tainted_names(self, func: ast.AST) -> set[str]:
+        """Device-valued dotted names assigned inside ``func`` (top-level
+        statements only — closures are deferred work, off the hot path).
+        Two passes give one-hop propagation through local copies."""
+        tainted: set[str] = set()
+
+        def value_is_device(expr: ast.expr) -> bool:
+            if isinstance(expr, ast.Call):
+                d = _dotted(expr.func) or ""
+                if d == "jax.device_get":
+                    return False  # a sync, flagged on its own; result is host
+                return (
+                    d.split(".")[0] in DEVICE_PRODUCER_ROOTS
+                    or d.split(".")[-1] in DEVICE_PRODUCER_NAMES
+                )
+            if isinstance(expr, (ast.Name, ast.Attribute)):
+                d = _dotted(expr)
+                return d is not None and (
+                    d in tainted or d.endswith(DEVICE_NAME_SUFFIXES)
+                )
+            if isinstance(expr, (ast.Tuple, ast.List)):
+                return any(value_is_device(e) for e in expr.elts)
+            return False
+
+        def scan(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda, ast.ClassDef)):
+                    continue
+                if isinstance(child, ast.Assign) and value_is_device(child.value):
+                    targets: list[ast.expr] = list(child.targets)
+                    while targets:
+                        t = targets.pop()
+                        if isinstance(t, (ast.Tuple, ast.List)):
+                            targets.extend(t.elts)
+                        else:
+                            d = _dotted(t)
+                            if d:
+                                tainted.add(d)
+                scan(child)
+
+        scan(func)
+        scan(func)  # second pass: one-hop propagation through copies
+        return tainted
+
+    def _convert_arg_tainted(self, call: ast.Call, tainted: set[str]) -> bool:
+        """True when any (non-metadata) name inside the conversion's
+        argument expression is a device value."""
+        if not call.args:
+            return False
+
+        hit = False
+
+        def walk(n: ast.AST) -> None:
+            nonlocal hit
+            if hit:
+                return
+            if isinstance(n, ast.Attribute) and n.attr in self._BENIGN_META:
+                return  # .shape/.dtype reads are static metadata, not syncs
+            if isinstance(n, (ast.Name, ast.Attribute)):
+                d = _dotted(n)
+                if d is not None and (
+                    d in tainted or d.endswith(DEVICE_NAME_SUFFIXES)
+                ):
+                    hit = True
+                    return
+            for child in ast.iter_child_nodes(n):
+                walk(child)
+
+        walk(call.args[0])
+        return hit
 
     def visit_file(self, sf: SourceFile) -> list[Finding]:
         funcs = _zone_functions(HOT_SYNC_ZONES, sf.rel_path)
@@ -209,6 +309,17 @@ class HostSyncRule(Rule):
             return []
         visitor = _FunctionCalls()
         visitor.visit(sf.tree)
+        taint_cache: dict[str, set[str]] = {}
+        func_nodes = {
+            n.name: n
+            for n in sf.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                for n in node.body:
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        func_nodes.setdefault(n.name, n)
         out: list[Finding] = []
         for call, func_name, depth in visitor.calls:
             if depth > 1:
@@ -228,6 +339,23 @@ class HostSyncRule(Rule):
                         "with '# gofrlint: disable=host-sync -- <why>'",
                     )
                 )
+                continue
+            if dotted in HOST_CONVERT_CALLS and func_name in func_nodes:
+                if func_name not in taint_cache:
+                    taint_cache[func_name] = self._tainted_names(
+                        func_nodes[func_name]
+                    )
+                if self._convert_arg_tainted(call, taint_cache[func_name]):
+                    out.append(
+                        Finding(
+                            self.name, sf.rel_path, call.lineno,
+                            f"{dotted}() on a device value: a hidden "
+                            "host-device sync in the decode hot path — read "
+                            "it through the block's one sanctioned "
+                            "materialization instead (or annotate with "
+                            "'# gofrlint: disable=host-sync -- <why>')",
+                        )
+                    )
         return out
 
 

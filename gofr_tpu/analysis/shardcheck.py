@@ -643,7 +643,18 @@ class DonationRule(Rule):
     loads of donated arguments after the donating call. Registry matches
     are by bare terminal name; a file defining its OWN non-donating
     function of that name shadows the registry there (no import-graph
-    resolution — precision over recall at module boundaries)."""
+    resolution — precision over recall at module boundaries).
+
+    ALIAS tracking (the dispatch shape that escaped this rule and crashed
+    the round-4 TPU engine bench with ``Array has been deleted
+    (int32[32])``): a reference to the soon-donated buffer captured into
+    another name BEFORE the donating call — a plain copy
+    (``alias = x``) or a constructor capture (``rec = Inflight(x, ...)``)
+    — reads the deleted buffer when loaded after the call, even though
+    the donated name itself was correctly rebound. Captures are collected
+    from the statements preceding the call in the same block, and loads
+    of the alias (or any of its attributes) after the call are flagged
+    until the alias is rebound."""
 
     name = "use-after-donation"
     cross_file = True
@@ -764,6 +775,15 @@ class DonationRule(Rule):
                     continue
                 rebound = _assigned_dotted(stmt)
                 for var in donated:
+                    # aliases captured BEFORE the call die with the buffer
+                    # whether or not the donated name itself is rebound
+                    for alias, cap_line in self._alias_captures(
+                        block[:i], var
+                    ):
+                        self._scan_after_alias(
+                            rel_path, block[i + 1:], alias, var, cap_line,
+                            spec, call.lineno, out,
+                        )
                     if var in rebound or any(
                         var.startswith(r + ".") for r in rebound
                     ):
@@ -790,6 +810,120 @@ class DonationRule(Rule):
                                 "call",
                             )
                         )
+
+    @staticmethod
+    def _alias_captures(
+        preceding: list[ast.stmt], var: str
+    ) -> list[tuple[str, int]]:
+        """(alias, line) pairs: names assigned in the statements BEFORE the
+        donating call whose value expression captures ``var`` — a direct
+        copy, a tuple/list containing it, or a constructor/call argument
+        (``rec = Inflight(x, ...)`` keeps a live reference to x's buffer).
+        Captures later re-bound before the donating call drop out (the
+        rebind sheds the reference)."""
+
+        def captures(expr: ast.expr) -> bool:
+            if isinstance(expr, (ast.Name, ast.Attribute)):
+                return _dotted(expr) == var
+            if isinstance(expr, ast.Call):
+                return any(
+                    captures(a) for a in expr.args
+                    if not isinstance(a, ast.Starred)
+                ) or any(
+                    kw.value is not None and captures(kw.value)
+                    for kw in expr.keywords
+                )
+            if isinstance(expr, (ast.Tuple, ast.List)):
+                return any(captures(e) for e in expr.elts)
+            return False
+
+        found: dict[str, int] = {}
+        for stmt in preceding:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            is_capture = captures(stmt.value)
+            for t in stmt.targets:
+                d = _dotted(t)
+                if not d or d == var:
+                    continue
+                if is_capture:
+                    found[d] = stmt.lineno
+                else:
+                    found.pop(d, None)  # re-bound: the reference is shed
+        return list(found.items())
+
+    def _scan_after_alias(
+        self,
+        rel_path: str,
+        rest: list[ast.stmt],
+        alias: str,
+        var: str,
+        cap_line: int,
+        spec: JitSpec,
+        call_line: int,
+        out: list[Finding],
+    ) -> None:
+        """Flag the first load of ``alias`` (or any ``alias.<attr>`` chain)
+        after the donating call, before the alias is rebound. Events come
+        from ONE walker that matches the outermost alias-rooted node and
+        never descends into its own chain — so ``rec.steps = 2`` is a
+        store (the inner ``rec`` Name's Load ctx must NOT masquerade as a
+        read of the captured buffer), in execution order (an Assign's
+        value before its targets)."""
+        events: list[tuple[str, int]] = []
+
+        def walk(n: ast.AST) -> None:
+            if isinstance(n, _SCOPE_NODES):
+                return  # nested def/class: executes at another time
+            if isinstance(n, (ast.Name, ast.Attribute)):
+                d = _dotted(n)
+                if d and (d == alias or d.startswith(alias + ".")
+                          or alias.startswith(d + ".")):
+                    ctx = getattr(n, "ctx", None)
+                    if isinstance(ctx, (ast.Store, ast.Del)):
+                        # exact/extension stores rebind or overwrite the
+                        # alias; a strict-PREFIX store rebinds its root
+                        events.append(("store", n.lineno))
+                    elif d == alias or d.startswith(alias + "."):
+                        events.append(("load", n.lineno))
+                    return  # never descend into our own chain
+            if isinstance(n, ast.Assign):
+                walk(n.value)
+                for t in n.targets:
+                    walk(t)
+                return
+            if isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                if getattr(n, "value", None) is not None:
+                    walk(n.value)
+                if isinstance(n, ast.AugAssign):
+                    d = _dotted(n.target)
+                    if d and (d == alias or d.startswith(alias + ".")):
+                        # augmented target is read-then-written
+                        events.append(("load", n.target.lineno))
+                walk(n.target)
+                return
+            for child in ast.iter_child_nodes(n):
+                walk(child)
+
+        for stmt in rest:
+            events.clear()
+            walk(stmt)
+            for kind, line in events:
+                if kind == "store":
+                    return
+                out.append(
+                    Finding(
+                        self.name, rel_path, line,
+                        f"'{alias}' (captured from '{var}' on line "
+                        f"{cap_line}) aliases a buffer donated to "
+                        f"{spec.name}() on line {call_line} and is read "
+                        "after the donation — on donating backends this "
+                        "raises 'Array has been deleted'; re-derive the "
+                        "value from the call's outputs or capture after "
+                        "the call",
+                    )
+                )
+                return
 
     @staticmethod
     def _stored_in_block(block: list[ast.stmt], var: str) -> bool:

@@ -148,6 +148,23 @@ class Container:
             "app_spec_accept_rate",
             "Speculative-decode draft acceptance rate over drafted tokens",
         )
+        # CPU-free decode hot loop (docs/performance.md): the host-overhead
+        # win must be observable — host ms per decode step should stay a
+        # small fraction of the device step time
+        m.new_gauge(
+            "app_decode_host_ms_per_step",
+            "Host-side time per decode step (dispatch bookkeeping + block "
+            "consume, excluding the device sync wait), milliseconds",
+        )
+        m.new_gauge(
+            "app_decode_block_size",
+            "Decode steps fused per device dispatch (TPU_BATCH_MULTI_STEP)",
+        )
+        m.new_gauge(
+            "app_detok_queue_depth",
+            "Detokenization/stream emissions queued behind the off-engine-"
+            "thread executor",
+        )
         m.new_counter(
             "app_requests_shed_total",
             "Requests rejected by admission control (queue full or "

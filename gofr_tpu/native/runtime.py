@@ -242,6 +242,21 @@ class Scheduler:
             "total_canceled": out[4],
         }
 
+    def pending(self) -> int:
+        """Queue depth only — the engine loop's admit-cadence fast path.
+        Canceled-but-undelivered requests still count (they sit in the
+        queue until an admit() delivers them), so a zero here means a full
+        admit round trip has nothing to do. Same close-race discipline as
+        stats(); a closed scheduler reports its last snapshot."""
+        with self._mu:
+            if self._closed:
+                return int(self._last_stats["queue_depth"])
+            if self._lib is None:
+                return int(self._py.stats()["queue_depth"])
+            out = (ctypes.c_int64 * 5)()
+            _check(self._lib.gofr_sched_stats(self._h, out), "sched_stats")
+            return int(out[0])
+
     def stats(self) -> dict[str, int]:
         with self._mu:  # see BlockAllocator.stats — same close race
             if self._closed:

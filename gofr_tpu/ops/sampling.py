@@ -59,3 +59,18 @@ def _expand(x: jnp.ndarray, ref: jnp.ndarray) -> jnp.ndarray:
     if x.ndim == 0:
         return x[None, None]
     return x[:, None]
+
+
+def stop_eval(
+    next_token: jnp.ndarray,  # [B] the token each row just emitted
+    stop_tok: jnp.ndarray,  # [B] per-row stop (EOS) id; -1 disables
+    budget: jnp.ndarray,  # [B] tokens the row may still emit, INCLUDING this one
+) -> jnp.ndarray:
+    """On-device stop-condition evaluation (the other half of the fused
+    decode step — Blink's CPU-free loop, arXiv:2604.07609): a row is done
+    when the token it just emitted is its stop token, or when that token
+    spent the last of its budget (``max_new_tokens`` and the sequence-length
+    cap are both folded into ``budget`` by the engine at admission). Keeping
+    this on device is what lets the host read back once per N-step block
+    instead of scanning every token for EOS. Returns done [B] bool."""
+    return (next_token == stop_tok) | (budget <= 1)

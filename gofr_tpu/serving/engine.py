@@ -16,6 +16,8 @@ Backpressure: admission beyond ``max_queue`` raises ErrorTooManyRequests
 from __future__ import annotations
 
 import asyncio
+import collections
+import concurrent.futures
 import contextlib
 import dataclasses
 import threading
@@ -60,11 +62,15 @@ class EngineConfig:
     # "int8" stores dense KV quantized (per-vector absmax; llama.KVCache):
     # half the decode HBM stream, double the resident slots per GB
     kv_dtype: str = "bf16"
-    # decode tokens per device dispatch (dense AND paged layouts): chunks
-    # amortize per-dispatch host/tunnel overhead; a row that stops
-    # mid-chunk wastes the tail steps, so keep small for stop-heavy
-    # workloads
-    multi_step: int = 1
+    # decode tokens per device dispatch (dense AND paged layouts), i.e.
+    # the N of the CPU-free N-step block: sampling + stop-condition
+    # evaluation run on device, so a row that stops mid-block freezes
+    # there and the host syncs ONCE per block. None = default (4; 1 when
+    # spec_tokens chunks instead). docs/performance.md.
+    multi_step: int | None = None
+    # outstanding decode blocks before the host materializes the oldest
+    # one (double-buffer depth): 1 = dispatch k+1, then consume k
+    decode_sync_every: int = 1
     # prompt-prefill (prefix) cache entries; 0 disables. A repeated prompt
     # skips its entire prefill forward pass (serving/prefix_cache.py).
     # The byte bound caps HBM regardless of bucket sizes.
@@ -92,6 +98,7 @@ class EngineConfig:
         without a code change)."""
         num_pages = config.get("TPU_KV_NUM_PAGES")
         buckets = config.get("TPU_BATCH_PREFILL_BUCKETS")
+        multi_step = config.get("TPU_BATCH_MULTI_STEP")
         return cls(
             max_slots=int(config.get_or_default("TPU_BATCH_MAX_SLOTS", "8")),
             max_seq_len=int(config.get_or_default("TPU_BATCH_MAX_TOKENS", "1024")),
@@ -114,7 +121,10 @@ class EngineConfig:
             kv_page_size=int(config.get_or_default("TPU_KV_PAGE_SIZE", "16")),
             kv_num_pages=int(num_pages) if num_pages else None,
             kv_dtype=config.get_or_default("TPU_KV_DTYPE", "bf16"),
-            multi_step=int(config.get_or_default("TPU_BATCH_MULTI_STEP", "1")),
+            multi_step=int(multi_step) if multi_step else None,
+            decode_sync_every=int(
+                config.get_or_default("TPU_DECODE_SYNC_EVERY", "1")
+            ),
             prefix_cache_entries=int(
                 config.get_or_default("TPU_PREFIX_CACHE_ENTRIES", "0")
             ),
@@ -197,22 +207,34 @@ class _Request:
 
 
 class _Inflight:
-    """A dispatched-but-not-consumed decode step (or multi-step chunk):
-    the device-side sampled tokens plus the (slot, request) snapshot the
-    dispatch was built from. The snapshot is what makes depth-1
-    pipelining safe — by consume time a slot may have been retired and
-    even re-admitted, and ``slots[slot] is req`` detects that and
-    discards the stale tokens. ``steps`` > 1 means ``next_token`` is
-    [B, steps] (chunked decode)."""
+    """A dispatched-but-not-consumed N-step decode block: the packed
+    device-side result ([B, steps+2] — token columns, done flag, n_valid;
+    batch_ops._pack_block) plus the (slot, request) snapshot the dispatch
+    was built from. The snapshot is what makes pipelining safe — by
+    consume time a slot may have been retired and even re-admitted, and
+    ``slots[slot] is req`` detects that and discards the stale tokens.
+    ``packed`` is the block's ONLY host-read device value, and it is
+    never donated anywhere — holding it here cannot alias a donated
+    carry (the round-4 use-after-donate shape)."""
 
-    __slots__ = ("next_token", "rows", "dispatched_at", "steps")
+    __slots__ = ("packed", "rows", "dispatched_at", "steps", "host_s")
 
-    def __init__(self, next_token: Any, rows: list, dispatched_at: float,
-                 steps: int = 1) -> None:
-        self.next_token = next_token
+    def __init__(self, packed: Any, rows: list, dispatched_at: float,
+                 steps: int = 1, host_s: float = 0.0) -> None:
+        self.packed = packed
         self.rows = rows
         self.dispatched_at = dispatched_at
         self.steps = steps
+        self.host_s = host_s  # host-side time spent building the dispatch
+
+
+def _block_sync(value: Any) -> np.ndarray:
+    """THE decode loop's one sanctioned host-device synchronization point:
+    materialize a dispatched block's packed result. Everything the host
+    needs from N device steps comes through this single call — tests
+    monkeypatch it to count syncs, and gofrlint's host-sync rule keeps any
+    other materialization out of the hot functions."""
+    return np.asarray(value)  # gofrlint: disable=host-sync -- the one sanctioned block-sync point
 
 
 class ServingEngine:
@@ -256,17 +278,46 @@ class ServingEngine:
             )
         if self.config.spec_tokens < 0:
             raise ValueError("TPU_SPEC_TOKENS must be >= 0")
-        if self.config.spec_tokens > 0 and self.config.multi_step > 1:
+        if (self.config.multi_step is not None and self.config.multi_step > 1
+                and self.config.spec_tokens > 0):
             raise ValueError(
                 "TPU_SPEC_TOKENS and TPU_BATCH_MULTI_STEP>1 are both "
                 "chunking policies; enable one"
             )
+        # resolve the N-step block size: an explicit TPU_BATCH_MULTI_STEP
+        # wins; speculative mode chunks through the verify executable
+        # instead (one position per draft); otherwise the CPU-free default
+        # is a 4-step block (ROADMAP item 4 — one host sync per 4 tokens)
+        if self.config.multi_step is not None:
+            self._block_steps = max(1, int(self.config.multi_step))
+        elif self.config.spec_tokens > 0:
+            self._block_steps = 1
+        else:
+            self._block_steps = 4
+        self._sync_every = max(1, int(self.config.decode_sync_every))
         # executable-level runtime state (KV storage, per-slot arrays,
         # pipelined-decode device state, admission scheduler) — built by
         # the shared helper so the supervisor's warm restart rebuilds
         # EXACTLY this, never a hand-copied drift of it
         self._init_runtime_state()
         self.rng = jax.random.PRNGKey(seed)
+        # detokenization + stream emission run OFF the engine thread on
+        # this single-worker executor, so a slow tokenizer or a blocking
+        # stream_cb overlaps the device block instead of stalling it. ONE
+        # worker on purpose: per-request frame order (tokens, then the
+        # terminal done frame) is the transports' contract. Process-
+        # lifetime (NOT rebuilt by warm_restart — pending emissions for
+        # swept requests settle harmlessly; _try_resolve is race-tolerant).
+        self._detok = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="serving-detok"
+        )
+        self._detok_depth = 0  # emissions queued, for the backlog gauge
+        self._detok_mu = threading.Lock()
+        # set whenever the detok queue is empty: drain() waits on it — the
+        # drain contract is "generations FINISHED", and terminal
+        # settlement (done frames, future resolution) rides this executor
+        self._detok_idle = threading.Event()
+        self._detok_idle.set()
         # speculative-decode counters (observable uplift: emitted /
         # dispatches > 1 means drafts are being accepted)
         self.spec_stats = {"dispatches": 0, "accepted": 0, "emitted": 0}
@@ -446,6 +497,12 @@ class ServingEngine:
                 return
             self._thread = None
             self._wedged = False  # a later stop() that joins clean recovers
+        # the engine is terminal: stop accepting emissions. wait=False on
+        # purpose — already-queued detok/settle tasks still run to
+        # completion (ThreadPoolExecutor drains its queue), so no retired
+        # request's future is stranded, and stop() never blocks behind a
+        # client stream_cb
+        self._detok.shutdown(wait=False)
         # the loop thread has exited: anything still registered can never
         # reach a terminal state through it (e.g. a submit that raced the
         # drain flag and enqueued after the loop's last scan) — fail it
@@ -489,7 +546,15 @@ class ServingEngine:
         self._wake.set()
         if self._logger:
             self._logger.info(f"serving engine draining (deadline {deadline_s:g}s)")
+        drain_start = time.monotonic()
         drained = self._idle.wait(timeout=deadline_s)
+        if drained:
+            # the loop went dry, but terminal settlement (done frames,
+            # future resolution, full-text detok) rides the detok
+            # executor — "drained" means generations FINISHED, so the
+            # queue must land inside the same deadline
+            remaining = deadline_s - (time.monotonic() - drain_start)
+            drained = self._detok_idle.wait(timeout=max(remaining, 0.0))
         if not drained:
             with self._count_lock:
                 remainder = list(self._by_id.values())
@@ -994,16 +1059,15 @@ class ServingEngine:
                 did_work = self._admit()
                 if any(s is not None for s in self.slots):
                     did_work |= self._decode_step()
-                elif self._inflight is not None:
-                    # drain: every row of the in-flight step retired while it
-                    # ran; its tokens are stale by construction
-                    prev, self._inflight = self._inflight, None
-                    self._consume_decode(prev)
+                elif self._inflight_q:
+                    # drain: every row of the in-flight blocks retired while
+                    # they ran; their tokens are stale by construction
+                    self._consume_block(self._inflight_q.popleft())
                     did_work = True
                 else:
                     self._last_consume_t = None  # idle gap must not skew TPOT
                 if not did_work:
-                    if (self._draining and self._inflight is None
+                    if (self._draining and not self._inflight_q
                             and not any(s is not None for s in self.slots)
                             and self._sched.stats()["queue_depth"] == 0):
                         # drained dry: every accepted request reached a
@@ -1035,6 +1099,12 @@ class ServingEngine:
         # belong to THIS scheduler, and releases/requeues must never land
         # on the replacement's
         sched = self._sched
+        if not sched.pending():
+            # admit cadence: nothing queued (canceled requests stay queued
+            # until delivered, so they keep the depth nonzero) — skip the
+            # native admit round trip entirely; per-block host overhead is
+            # the budget this loop is built around
+            return False
         pairs, canceled_ids = sched.admit(self.config.admission_per_step)
         # the admit call itself can hang (native mutex held under a wedged
         # step); a thread thawing out of it retired would otherwise process
@@ -1232,9 +1302,17 @@ class ServingEngine:
         self.temperature[slot] = req.temperature
         self.top_k[slot] = req.top_k
         self.top_p[slot] = req.top_p
-        # scattered into the device-resident (last_token, cache_len) at dispatch
-        self._pending_tok[slot] = (first_id, S)
-        self._samp_dev = None  # sampling params changed → re-upload once
+        # folded into the device-resident DecodeState by one donated
+        # scatter at the next dispatch: (first token, resident len,
+        # remaining budget, stop id). The budget carries BOTH limits —
+        # max_new and the sequence cap (submit already clamped max_new to
+        # the sequence budget) — so the device's stop-eval covers them.
+        # A multi-token stop set disables device stop-eval (-1 sentinel);
+        # the host's _commit_token still enforces it at each sync.
+        self._pending_admit[slot] = (
+            first_id, S, req.max_new_tokens - 1,
+            next(iter(req.stop_ids)) if len(req.stop_ids) == 1 else -1,
+        )
 
         self._shed.observe_ttft(req.first_token_at - req.created)
         if self._metrics:
@@ -1248,20 +1326,26 @@ class ServingEngine:
         elif len(req.tokens) >= req.max_new_tokens:
             self._retire(slot, "length")
 
-    # -- decode (depth-1 pipelined) --------------------------------------------
+    # -- decode (pipelined N-step blocks) --------------------------------------
     def _decode_step(self) -> bool:
-        """Dispatch the NEXT device step, then consume the PREVIOUS one.
-        The dispatch feeds on step N's device-side tokens directly, so the
-        device never waits for host bookkeeping; the host's np.asarray of
-        step N's tokens overlaps step N+1's compute."""
+        """Dispatch the NEXT N-step device block, then materialize the
+        OLDEST outstanding one. The dispatch feeds on the device-resident
+        DecodeState carry directly, so the device never waits for host
+        bookkeeping; the host's single block sync overlaps the next
+        block's compute (double-buffered — depth = decode_sync_every)."""
         self._check_retired()  # replaced during a long _admit: unwind first
         if self.config.spec_tokens > 0:
             return self._spec_step()
         inflight = self._dispatch_decode()
-        prev, self._inflight = self._inflight, inflight
-        if prev is not None:
-            self._consume_decode(prev)
-        return inflight is not None or prev is not None
+        if inflight is not None:
+            self._inflight_q.append(inflight)
+        did = inflight is not None
+        if self._inflight_q and (
+            inflight is None or len(self._inflight_q) > self._sync_every
+        ):
+            self._consume_block(self._inflight_q.popleft())
+            did = True
+        return did
 
     def _spec_step(self) -> bool:
         """Speculative decode step (VERDICT r4 item #3): host drafts up to
@@ -1284,7 +1368,7 @@ class ServingEngine:
         K = self.config.spec_tokens
         T = K + 1
         max_seq = self.config.max_seq_len
-        self._pending_tok.clear()  # host state is authoritative in spec mode
+        self._pending_admit.clear()  # host state is authoritative in spec mode
 
         rows: list[tuple[int, _Request]] = []
         now = time.perf_counter()
@@ -1352,13 +1436,12 @@ class ServingEngine:
             mask[slot] = True
         # counted AFTER the reservation fallback may have cleared drafts
         drafted_total = int((chunk[mask, 1:] >= 0).sum())
-        if self._samp_dev is None:
-            self._samp_dev = (
-                jnp.asarray(self.temperature.copy()),
-                jnp.asarray(self.top_k.copy()),
-                jnp.asarray(self.top_p.copy()),
-            )
-        temp_d, topk_d, topp_d = self._samp_dev
+        # spec mode re-uploads the [B] sampling params per chunk: three
+        # tiny host→device copies (no sync) against a K+1-position verify
+        # forward — not worth a dirty-tracking cache
+        temp_d = jnp.asarray(self.temperature.copy())
+        topk_d = jnp.asarray(self.top_k.copy())
+        topp_d = jnp.asarray(self.top_p.copy())
         if self._mask_host is None or not np.array_equal(mask, self._mask_host):
             self._mask_dev = jnp.asarray(mask)
             self._mask_host = mask
@@ -1380,7 +1463,7 @@ class ServingEngine:
                 # not clobber the replacement engine's state — self.*
                 # commits happen only after the retirement check below
                 if pc.quantized:
-                    (out, n_acc, pc.k_pool, pc.v_pool, pc.ks_pool,
+                    (packed, pc.k_pool, pc.v_pool, pc.ks_pool,
                      pc.vs_pool, new_rng) = batch_ops.verify_and_sample_paged_q(
                         cfg, self.params, pc.k_pool, pc.v_pool,
                         pc.ks_pool, pc.vs_pool, pc.tables_device(), chunk_d,
@@ -1388,7 +1471,7 @@ class ServingEngine:
                         temp_d, topk_d, topp_d, self.rng,
                     )
                 else:
-                    (out, n_acc, pc.k_pool, pc.v_pool, new_rng) = (
+                    (packed, pc.k_pool, pc.v_pool, new_rng) = (
                         batch_ops.verify_and_sample_paged(
                             cfg, self.params, pc.k_pool, pc.v_pool,
                             pc.tables_device(), chunk_d, start_d,
@@ -1398,16 +1481,20 @@ class ServingEngine:
                     )
                 new_cache = self.cache  # dense path untouched
             else:
-                out, n_acc, new_cache, new_rng = batch_ops.verify_and_sample(
+                packed, new_cache, new_rng = batch_ops.verify_and_sample(
                     cfg, self.params, self.cache, chunk_d, start_d,
                     temp_d, topk_d, topp_d, self.rng,
                 )
 
-            out_np = np.asarray(out)  # gofrlint: disable=host-sync -- the step's only sync point
-            na_np = np.asarray(n_acc)  # gofrlint: disable=host-sync -- already materialized with out above
+            # accepted tokens + per-row accept count come back as ONE
+            # packed [B, T+1] array: one sync per chunk, like the plain
+            # path's one sync per block
+            packed_np = _block_sync(packed)
         # the sync returned: a warm restart may have replaced this thread
         # while the chunk verified — commit nothing to rebuilt state if so
         self._check_retired()
+        out_np = packed_np[:, :-1]
+        na_np = packed_np[:, -1]
         self.cache, self.rng = new_cache, new_rng
         self.heartbeat = time.monotonic()  # the sync returned: progress
         step_time = time.perf_counter() - t0
@@ -1453,24 +1540,46 @@ class ServingEngine:
                 )
         return True
 
-    def _chunk_absorb(self, rows: list) -> int:
-        """How many decode steps EVERY row can absorb without crossing its
-        max_new/max_seq limits (chunk feasibility)."""
-        return min(
-            min(req.max_new_tokens - (1 + req.dispatched) for _, req in rows),
-            min(self.config.max_seq_len - 1
-                - (len(req.prompt_ids) + 1 + req.dispatched)
-                for _, req in rows),
+    def _slot_in_flight(self, slot: int, req: _Request) -> bool:
+        """True when a dispatched-but-unmaterialized block may still carry
+        tokens for this (slot, request) pair — retiring it now would drop
+        tokens the client paid for; the consume path retires it instead."""
+        return any(
+            any(s == slot and r is req for s, r in rec.rows)
+            for rec in self._inflight_q
+        )
+
+    def _make_device_state(self):
+        """Build the device-resident DecodeState from the host mirrors —
+        the cold path (first dispatch, post-_fail_all rebuild). Only valid
+        with no blocks in flight: the mirrors ARE the truth then."""
+        B = self.config.max_slots
+        budget = np.zeros(B, np.int32)
+        done = np.ones(B, bool)
+        stop = np.full(B, -1, np.int32)
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            remaining = req.max_new_tokens - len(req.tokens)
+            budget[slot] = max(remaining, 0)
+            done[slot] = remaining <= 0
+            if len(req.stop_ids) == 1:
+                stop[slot] = next(iter(req.stop_ids))
+        self.rng, sub = jax.random.split(self.rng)
+        self._pending_admit.clear()  # the mirrors already cover these rows
+        return batch_ops.make_decode_state(
+            self.last_token, np.maximum(self.cache_len, 1), done, budget,
+            stop, self.temperature, self.top_k, self.top_p, sub,
         )
 
     def _dispatch_decode(self) -> _Inflight | None:
         cfg = self.model_cfg
-        max_seq = self.config.max_seq_len
         chaos.maybe_fail("decode.dispatch")
         self._maybe_device_loss()
         # a hang at the chaos point can outlive a warm restart: re-check
         # ownership BEFORE reading slots/pools that may since be rebuilt
         self._check_retired()
+        host_t0 = time.perf_counter()
 
         rows: list[tuple[int, _Request]] = []
         now = time.perf_counter()
@@ -1478,65 +1587,45 @@ class ServingEngine:
             if req is None:
                 continue
             if req.canceled:
-                # retire immediately; a pending in-flight token (if any) is
+                # retire immediately; pending in-flight tokens (if any) are
                 # discarded at consume via the snapshot identity check
                 self._retire(slot, "cancel")
                 continue
             if req.expired(now):
-                # deadline passed mid-stream: abandon the row, free the
-                # slot; the in-flight token (if any) is discarded at
-                # consume via the snapshot identity check
+                # deadline passed mid-stream (possibly mid-block): abandon
+                # the row at this sync boundary, free the slot
                 self._retire(slot, "deadline_exceeded")
                 continue
-            total_if_done = 1 + req.dispatched  # prefill token + decode steps
-            if (total_if_done >= req.max_new_tokens
-                    or len(req.prompt_ids) + total_if_done >= max_seq):
-                continue  # final token already in flight; retires at consume
+            if req.kv_exhausted:
+                # pool-clamped: dispatch nothing further; the tokens still
+                # in flight are delivered at the next sync, then the row
+                # retires there with finish_reason kv_exhausted
+                continue
             rows.append((slot, req))
 
-        T_paged = 1
-        if self.paged_cache is not None:
-            # chunked paged decode: all-or-nothing page accounting up front
-            # (a partial extend would desync the chunk's device lengths)
-            if self.config.multi_step > 1 and rows:
-                if (self._chunk_absorb(rows) >= self.config.multi_step
-                        and self.paged_cache.try_extend_chunk(
-                            [slot for slot, _ in rows], self.config.multi_step)):
-                    T_paged = self.config.multi_step
-        if self.paged_cache is not None and T_paged == 1:
-            # account the new position before dispatch; a pool-exhausted row
-            # retires with what it has (finish_reason "length") instead of
-            # stalling the whole batch
-            from gofr_tpu.serving.kv_cache import OutOfBlocks
-
+        N = self._block_steps
+        pc = self.paged_cache
+        if pc is not None and rows:
+            # page coverage for the whole block up front, per row and
+            # INCLUDING the dispatched-not-yet-consumed gap (the device
+            # runs ahead of the committed host mirror by the in-flight
+            # blocks). A row the pool cannot cover is clamped, not
+            # stalled: the rest of the batch proceeds.
             kept = []
-            inflight_slots = (
-                {s for s, _ in self._inflight.rows} if self._inflight else set()
-            )
             for slot, req in rows:
-                try:
-                    self.paged_cache.extend_slot(slot)
+                in_flight = req.dispatched - (len(req.tokens) - 1)
+                if pc.try_reserve_slot(slot, in_flight + N):
                     kept.append((slot, req))
-                except OutOfBlocks:
+                else:
                     if self._logger:
                         self._logger.warn(
                             f"KV pool exhausted; retiring request {req.id} early"
                         )
-                    if slot in inflight_slots:
-                        # a valid token for this row is still in flight:
-                        # clamp so no further step is dispatched, deliver
-                        # that token at consume, and retire there —
-                        # retiring now would silently drop a token the
-                        # client paid for (code-review r4)
-                        if 1 + req.dispatched < req.max_new_tokens:
-                            req.kv_exhausted = True  # the clamp, not the
-                            # budget, is what ends this row
-                        req.max_new_tokens = min(
-                            req.max_new_tokens, 1 + req.dispatched
-                        )
-                    else:
-                        req.kv_exhausted = True
+                    req.kv_exhausted = True
+                    if not self._slot_in_flight(slot, req):
                         self._retire(slot, "kv_exhausted")
+                    # else: tokens the client paid for are still in flight —
+                    # commit them at the next sync and retire there
             rows = kept
         if not rows:
             return None
@@ -1545,139 +1634,77 @@ class ServingEngine:
         for slot, _ in rows:
             mask[slot] = True
 
-        if self._last_tok_dev is None:
-            self._last_tok_dev = jnp.asarray(self.last_token.copy())
-            self._cache_len_dev = jnp.asarray(np.maximum(self.cache_len, 1))
-        if self._pending_tok:
-            idx = np.fromiter(self._pending_tok.keys(), np.int32)
-            toks = np.fromiter((t for t, _ in self._pending_tok.values()), np.int32)
-            lens = np.fromiter((n for _, n in self._pending_tok.values()), np.int32)
-            self._pending_tok.clear()
-            self._last_tok_dev, self._cache_len_dev = batch_ops.scatter_slot_state(
-                self._last_tok_dev, self._cache_len_dev,
-                jnp.asarray(idx), jnp.asarray(toks), jnp.asarray(lens),
+        # the device-side carry: build cold, or fold admissions in with ONE
+        # donated scatter — steady state uploads nothing per block
+        state = self._dec_state
+        if state is None:
+            state = self._make_device_state()
+        elif self._pending_admit:
+            items = sorted(self._pending_admit.items())
+            self._pending_admit.clear()
+            idx = np.fromiter((s for s, _ in items), np.int32, len(items))
+            state = batch_ops.admit_decode_state(
+                state, jnp.asarray(idx),
+                jnp.asarray(np.fromiter((v[0] for _, v in items), np.int32,
+                                        len(items))),
+                jnp.asarray(np.fromiter((v[1] for _, v in items), np.int32,
+                                        len(items))),
+                jnp.asarray(np.fromiter((v[2] for _, v in items), np.int32,
+                                        len(items))),
+                jnp.asarray(np.fromiter((v[3] for _, v in items), np.int32,
+                                        len(items))),
+                jnp.asarray(self.temperature[idx]),
+                jnp.asarray(self.top_k[idx]),
+                jnp.asarray(self.top_p[idx]),
             )
-        if self._samp_dev is None:  # re-uploaded only when admission changed them
-            self._samp_dev = (
-                jnp.asarray(self.temperature.copy()),
-                jnp.asarray(self.top_k.copy()),
-                jnp.asarray(self.top_p.copy()),
-            )
-        temp_d, topk_d, topp_d = self._samp_dev
+        # NOTE: self._dec_state is NOT updated here — the scatter donated
+        # the old buffers, and the commit happens in one place after the
+        # block dispatch (a failed dispatch resets it via _fail_all)
+
         if self._mask_host is None or not np.array_equal(mask, self._mask_host):
             self._mask_dev = jnp.asarray(mask)
             self._mask_host = mask
         mask_d = self._mask_dev
 
         t0 = time.perf_counter()
-        if self.paged_cache is not None and T_paged > 1:
-            pc = self.paged_cache
-            # first chunk token's length: seq_lens already includes all T
-            seq_start = jnp.asarray(np.maximum(pc.seq_lens - (T_paged - 1), 1))
-            # unpack into LOCALS (and the pre-bound pc): a retired
-            # thread returning from a hung dispatch must not clobber the
-            # replacement engine's state at assignment time — self.*
-            # commits happen only after the retirement check
-            with self._cold_dispatch("decode", "paged", pc.quantized, T_paged):
+        # unpack into LOCALS (and the pre-bound pc, which a restart never
+        # mutates): a retired thread returning from a hung dispatch must
+        # not clobber the replacement engine's state at assignment time —
+        # self.* commits happen only after the retirement check
+        if pc is not None:
+            tables_d = pc.tables_device()
+            with self._cold_dispatch("decode", "paged", pc.quantized, N):
                 if pc.quantized:
-                    (tokens, last, pc.k_pool, pc.v_pool, pc.ks_pool,
-                     pc.vs_pool, new_rng) = batch_ops.decode_and_sample_paged_multi_q(
+                    (packed, pc.k_pool, pc.v_pool, pc.ks_pool, pc.vs_pool,
+                     new_state) = batch_ops.decode_block_paged_q(
                         cfg, self.params, pc.k_pool, pc.v_pool,
-                        pc.ks_pool, pc.vs_pool,
-                        pc.tables_device(), seq_start,
-                        self._last_tok_dev, mask_d,
-                        temp_d, topk_d, topp_d, self.rng, T_paged,
+                        pc.ks_pool, pc.vs_pool, state, tables_d, mask_d, N,
                     )
                 else:
-                    (tokens, last, pc.k_pool, pc.v_pool, new_rng) = (
-                        batch_ops.decode_and_sample_paged_multi(
-                            cfg, self.params, pc.k_pool, pc.v_pool,
-                            pc.tables_device(), seq_start,
-                            self._last_tok_dev, mask_d,
-                            temp_d, topk_d, topp_d, self.rng, T_paged,
+                    (packed, pc.k_pool, pc.v_pool, new_state) = (
+                        batch_ops.decode_block_paged(
+                            cfg, self.params, pc.k_pool, pc.v_pool, state,
+                            tables_d, mask_d, N,
                         )
                     )
-            self._check_retired()
-            self.rng = new_rng
-            self._last_tok_dev = last
-            self.cache_len = pc.seq_lens.copy()
-            for _, req in rows:
-                req.dispatched += T_paged
-            return _Inflight(tokens, rows, t0, steps=T_paged)
-        if self.paged_cache is not None:
-            pc = self.paged_cache
-            with self._cold_dispatch("decode", "paged", pc.quantized, 1):
-                if pc.quantized:
-                    (next_token, pc.k_pool, pc.v_pool, pc.ks_pool,
-                     pc.vs_pool, new_rng) = batch_ops.decode_and_sample_paged_q(
-                        cfg, self.params, pc.k_pool, pc.v_pool,
-                        pc.ks_pool, pc.vs_pool,
-                        pc.tables_device(), pc.seq_lens_device(),
-                        self._last_tok_dev, mask_d,
-                        temp_d, topk_d, topp_d, self.rng,
-                    )
-                else:
-                    (next_token, pc.k_pool, pc.v_pool, new_rng) = (
-                        batch_ops.decode_and_sample_paged(
-                            cfg, self.params, pc.k_pool, pc.v_pool,
-                            pc.tables_device(), pc.seq_lens_device(),
-                            self._last_tok_dev, mask_d,
-                            temp_d, topk_d, topp_d, self.rng,
-                        )
-                    )
-            self._check_retired()  # commit to self only as the loop's owner
-            self.rng = new_rng
-            self.cache_len = pc.seq_lens.copy()
+            new_cache = self.cache  # dense path untouched
         else:
-            # chunk size is ALL-or-one: the full multi_step chunk only when
-            # every dispatched row can absorb it without crossing its
-            # max_new/max_seq limits, else single steps. T is a static
-            # argnum — intermediate sizes would each compile their own
-            # executable (and did, on the clock, before this guard)
-            T = 1
-            if (self.config.multi_step > 1
-                    and self._chunk_absorb(rows) >= self.config.multi_step):
-                T = self.config.multi_step
-            if T > 1:
-                with self._cold_dispatch("decode", "dense",
-                                         self.cache.quantized, T):
-                    (tokens, last, new_cache, new_clen,
-                     new_rng) = batch_ops.decode_and_sample_multi(
-                        cfg, self.params, self.cache,
-                        self._last_tok_dev, self._cache_len_dev, mask_d,
-                        temp_d, topk_d, topp_d, self.rng, T,
-                    )
-                self._check_retired()  # commit only as the loop's owner
-                self.cache, self._cache_len_dev, self.rng = (
-                    new_cache, new_clen, new_rng,
-                )
-                self._last_tok_dev = last
-                for slot, req in rows:
-                    self.cache_len[slot] += T
-                    req.dispatched += T
-                return _Inflight(tokens, rows, t0, steps=T)
             with self._cold_dispatch("decode", "dense",
-                                     self.cache.quantized, 1):
-                next_token, new_cache, new_clen, new_rng = (
-                    batch_ops.decode_and_sample_pipelined(
-                        cfg, self.params, self.cache,
-                        self._last_tok_dev, self._cache_len_dev, mask_d,
-                        temp_d, topk_d, topp_d, self.rng,
-                    )
+                                     self.cache.quantized, N):
+                packed, new_cache, new_state = batch_ops.decode_block(
+                    cfg, self.params, self.cache, state, mask_d, N,
                 )
-            self._check_retired()  # commit only as the loop's owner
-            self.cache, self._cache_len_dev, self.rng = (
-                new_cache, new_clen, new_rng,
-            )
-            for slot, _ in rows:
-                self.cache_len[slot] += 1
-        self._last_tok_dev = next_token
+        self._check_retired()  # commit to self only as the loop's owner
+        self.cache = new_cache
+        self._dec_state = new_state
         for _, req in rows:
-            req.dispatched += 1
-        return _Inflight(next_token, rows, t0)
+            req.dispatched += N
+        return _Inflight(
+            packed, rows, t0, steps=N, host_s=t0 - host_t0
+        )
 
-    def _consume_decode(self, rec: _Inflight) -> None:
-        next_ids = np.asarray(rec.next_token)  # gofrlint: disable=host-sync -- the pipeline's only sync point
+    def _consume_block(self, rec: _Inflight) -> None:
+        packed = _block_sync(rec.packed)  # THE one sync for N device steps
         # the sync returned: a warm restart may have replaced this thread
         # while it waited — its tokens belong to requests already settled
         # or requeued, so commit nothing (and don't stamp a heartbeat that
@@ -1696,15 +1723,40 @@ class ServingEngine:
             if self.slots[slot] is not req:
                 continue  # retired (and possibly re-admitted) since dispatch
             n_active += 1
-            row_ids = (
-                next_ids[slot : slot + 1] if rec.steps == 1 else next_ids[slot]
-            )
-            for token_id in row_ids:
-                self._commit_token(slot, req, int(token_id))
+            n_valid = int(packed[slot, rec.steps + 1])
+            device_done = bool(packed[slot, rec.steps])
+            for i in range(n_valid):
+                self._commit_token(slot, req, int(packed[slot, i]))
                 if self.slots[slot] is not req:
-                    break  # retired mid-chunk: discard the tail tokens
+                    break  # retired mid-block: discard the tail tokens
+            if self.slots[slot] is not req:
+                continue
+            # committed residency advances by what the device actually
+            # emitted (the device carry already did)
+            self.cache_len[slot] += n_valid
+            if self.paged_cache is not None:
+                self.paged_cache.advance_slot(slot, n_valid)
+            if req.kv_exhausted:
+                # clamped at dispatch time: retire with the pool-pressure
+                # reason, but only once NO younger in-flight block still
+                # carries tokens for this row (decode_sync_every >= 2 can
+                # have several) — retiring earlier would discard tokens
+                # the client paid for via the consume identity check
+                if not self._slot_in_flight(slot, req):
+                    self._retire(slot, "kv_exhausted")
+            elif device_done:
+                # defensive: _commit_token's own stop/limit chain normally
+                # retired the row on its last committed token already —
+                # this catches a host/device divergence rather than
+                # leaving a device-frozen row parked in a slot forever
+                self._retire(
+                    slot,
+                    "stop" if req.tokens and req.tokens[-1] in req.stop_ids
+                    else "length",
+                )
 
         if self._metrics and n_active:
+            host_ms = (rec.host_s + (time.perf_counter() - now)) * 1e3
             self._metrics.record_histogram(
                 "app_tpot_seconds", step_time / rec.steps
             )
@@ -1715,6 +1767,16 @@ class ServingEngine:
                 "app_kv_cache_pages_used",
                 int(sum(int(self.cache_len[s]) for s, _ in rec.rows)),
             )
+            # the tentpole's success metric: host time per decode step
+            # (dispatch bookkeeping + this consume, excluding the sync
+            # wait) must stay a small fraction of decode_step_ms
+            self._metrics.set_gauge(
+                "app_decode_host_ms_per_step", host_ms / rec.steps
+            )
+            self._metrics.set_gauge("app_decode_block_size", rec.steps)
+            with self._detok_mu:
+                depth = self._detok_depth
+            self._metrics.set_gauge("app_detok_queue_depth", depth)
 
     # -- bookkeeping -----------------------------------------------------------
     def _commit_token(self, slot: int, req: _Request, token_id: int) -> None:
@@ -1744,11 +1806,53 @@ class ServingEngine:
     def _emit_token(self, req: _Request, token_id: int) -> None:
         req.tokens.append(token_id)
         if req.stream_cb is not None and token_id not in req.stop_ids:
-            piece = self.tokenizer.decode([token_id])
+            self._emit_async(req, token_id)
+
+    def _emit_async(self, req: _Request, token_id: int) -> None:
+        """Queue detokenization + stream emission on the single-worker
+        executor: a stream_cb is client code and can block for seconds —
+        the decode loop must overlap the device block, never wait on the
+        client (ROADMAP item 4). One worker keeps per-request frame order;
+        a callback failure cancels the request like the inline path did."""
+
+        def task() -> None:
             try:
-                req.stream_cb(token_id, piece, False)
+                req.stream_cb(token_id, self.tokenizer.decode([token_id]), False)
             except Exception:
                 req.canceled = True
+
+        # executor already shut down (stop() raced the emit): the token
+        # frame is dropped — nobody can read it from a stopped engine
+        self._submit_detok(task)
+
+    def _submit_detok(self, task: Callable[[], None]) -> bool:
+        """Queue work on the detok executor with depth accounting (the
+        backlog gauge + the idle event drain() waits on). Returns False
+        when the executor is already shut down — the caller decides
+        whether to run inline (terminal settlement) or drop (a stream
+        frame nobody can read anymore)."""
+        with self._detok_mu:
+            self._detok_depth += 1
+            self._detok_idle.clear()
+
+        def run() -> None:
+            try:
+                task()
+            finally:
+                self._detok_done()
+
+        try:
+            self._detok.submit(run)
+            return True
+        except RuntimeError:
+            self._detok_done()
+            return False
+
+    def _detok_done(self) -> None:
+        with self._detok_mu:
+            self._detok_depth -= 1
+            if self._detok_depth == 0:
+                self._detok_idle.set()
 
     def _retire(self, slot: int, reason: str) -> None:
         req = self.slots[slot]
@@ -1815,22 +1919,34 @@ class ServingEngine:
         if reason == "kv_exhausted" and self._metrics:
             self._metrics.increment_counter("app_requests_kv_exhausted_total")
         out_ids = [t for t in req.tokens if t not in req.stop_ids]
-        result = GenerationResult(
-            request_id=req.id,
-            text=self.tokenizer.decode(out_ids),
-            token_ids=out_ids,
-            prompt_tokens=len(req.prompt_ids),
-            completion_tokens=len(out_ids),
-            finish_reason=reason,
-            ttft_s=(req.first_token_at - req.created) if req.first_token_at else 0.0,
-            duration_s=now - req.created,
-        )
-        if req.stream_cb is not None:
-            try:
-                req.stream_cb(-1, "", True)
-            except Exception:
-                pass
-        self._try_resolve(req, value=result)
+        ttft = (req.first_token_at - req.created) if req.first_token_at else 0.0
+        duration = now - req.created
+
+        def settle() -> None:
+            # full-text detokenization + terminal frame + future settlement
+            # run behind any still-queued token frames (same single-worker
+            # executor: the done frame can never overtake a token frame)
+            result = GenerationResult(
+                request_id=req.id,
+                text=self.tokenizer.decode(out_ids),
+                token_ids=out_ids,
+                prompt_tokens=len(req.prompt_ids),
+                completion_tokens=len(out_ids),
+                finish_reason=reason,
+                ttft_s=ttft,
+                duration_s=duration,
+            )
+            if req.stream_cb is not None:
+                try:
+                    req.stream_cb(-1, "", True)
+                except Exception:
+                    pass
+            self._try_resolve(req, value=result)
+
+        if not self._submit_detok(settle):
+            # executor already shut down (stopping engine): settle inline —
+            # a terminal state must never be lost to a lifecycle race
+            settle()
 
     def _reset_prefix_cache(self) -> None:
         """A DEVICE-level failure may have poisoned cached prefill slabs
@@ -1950,11 +2066,14 @@ class ServingEngine:
         gofr_runtime.cc; Python fallback when no toolchain): priority +
         FIFO queue, free-slot assignment, per-step prefill token budget.
 
-        Pipelined-decode state (VERDICT r3 weak #2): the old loop synced
-        on np.asarray(next_token) before dispatching the next step,
-        strictly alternating host and device work — ~14× over raw decode.
-        Now step N+1 is dispatched from step N's DEVICE-side tokens and
-        the host consumes step N's copy while N+1 runs."""
+        CPU-free decode state (ROADMAP item 4, Blink arXiv:2604.07609):
+        the device owns the per-row carry (batch_ops.DecodeState — last
+        token, resident length, done flag, token budget, stop id, sampling
+        params, RNG), sampling AND stop evaluation run inside the N-step
+        block executable, and the host's single materialization per block
+        (_block_sync) overlaps the next block's compute. The numpy arrays
+        here are host MIRRORS: authoritative for admission/recovery
+        rebuilds, advanced at each consume."""
         B = self.config.max_slots
         if self.config.kv_layout == "paged":
             self.paged_cache = self._make_paged_cache()
@@ -1962,17 +2081,23 @@ class ServingEngine:
         else:
             self.paged_cache = None
             self.cache = self._make_dense_cache()
-        self.cache_len = np.zeros(B, np.int32)  # host copy (authoritative)
+        self.cache_len = np.zeros(B, np.int32)  # host mirror (committed tokens)
         self.last_token = np.zeros(B, np.int32)
         self.temperature = np.ones(B, np.float32)
         self.top_k = np.zeros(B, np.int32)
         self.top_p = np.ones(B, np.float32)
         self.slots: list[_Request | None] = [None] * B
-        self._inflight: _Inflight | None = None
-        self._last_tok_dev: Any = None  # device-resident last tokens [B]
-        self._cache_len_dev: Any = None  # device-resident lengths (dense path)
-        self._pending_tok: dict[int, tuple[int, int]] = {}  # slot → (token, len)
-        self._samp_dev: tuple | None = None  # cached device sampling params
+        # the pipelined-block queue: dispatched-but-unmaterialized blocks,
+        # oldest first; depth bounded by decode_sync_every
+        self._inflight_q: collections.deque[_Inflight] = collections.deque()
+        # device-resident DecodeState carry (batch_ops.DecodeState): the
+        # host never reads it; None = rebuild from the host mirrors at the
+        # next dispatch (cold start / post-failure)
+        self._dec_state: Any = None
+        # slots prefilled since the last dispatch, folded into the device
+        # state by ONE donated scatter: slot → (first token, resident len,
+        # remaining budget, stop id)
+        self._pending_admit: dict[int, tuple[int, int, int, int]] = {}
         self._mask_dev: Any = None  # cached device active mask
         self._mask_host: Any = None  # host copy the cache was built from
         self._last_consume_t: float | None = None
@@ -1999,11 +2124,9 @@ class ServingEngine:
     def _fail_all(self, exc: Exception, kv_unhealthy: bool | None = None) -> None:
         # pipeline state is unrecoverable mid-step: drop the in-flight
         # record and force re-upload of device-resident state
-        self._inflight = None
-        self._pending_tok.clear()
-        self._samp_dev = None
-        self._last_tok_dev = None
-        self._cache_len_dev = None
+        self._inflight_q.clear()
+        self._pending_admit.clear()
+        self._dec_state = None  # rebuilt from host mirrors at next dispatch
         self._mask_dev = None
         self._mask_host = None
         self._last_consume_t = None

@@ -181,25 +181,13 @@ class PagedKVCache:
             self.tables[slot, : len(table)] = table
         self.seq_lens[slot] = new_len
 
-    def try_extend_chunk(self, slots: list[int], tokens: int) -> bool:
-        """Account ``tokens`` appended positions for EVERY slot, or none:
-        chunked decode needs all-or-nothing page accounting (a partial
-        extend would desync the chunk's device-side lengths). Returns
-        False without touching state when the pool cannot cover the whole
-        chunk."""
-        if not self.try_reserve_chunk(slots, tokens):
-            return False
-        for slot in slots:
-            self.seq_lens[slot] = int(self.seq_lens[slot]) + tokens
-        return True
-
     def try_reserve_chunk(self, slots: list[int], tokens: int) -> bool:
         """Reserve page COVERAGE for up to ``tokens`` further positions on
         every slot, or none — WITHOUT advancing seq_lens (speculative
         verify writes up to ``tokens`` positions but commits only the
-        accepted prefix; lengths advance later via :meth:`advance_slot`,
-        while the chunked decode path layers its seq_lens advance on top
-        in :meth:`try_extend_chunk`). Per-slot targets clamp to
+        accepted prefix; lengths advance later via :meth:`advance_slot` —
+        the block-stepped decode path uses the per-row twin
+        :meth:`try_reserve_slot` the same way). Per-slot targets clamp to
         max_seq_len: a row one token short of the limit reserves exactly
         its last page rather than overflowing the block-table width —
         chunk positions past the clamp divert to the trash page via the
@@ -224,6 +212,34 @@ class PagedKVCache:
                 self.allocator.extend(seq_id, target)
                 table = self.allocator.block_table(seq_id)
                 self.tables[slot, : len(table)] = table
+        return True
+
+    def try_reserve_slot(self, slot: int, tokens: int) -> bool:
+        """Reserve page COVERAGE for up to ``tokens`` positions past the
+        slot's committed length, or nothing — the per-row twin of
+        :meth:`try_reserve_chunk`, used by the block-stepped decode loop
+        where each row's dispatched-ahead depth differs (the device runs
+        ahead of the committed host mirror by the in-flight blocks).
+        Clamps to max_seq_len like the chunk variant; lengths advance
+        later via :meth:`advance_slot` as blocks are consumed. Returns
+        False untouched when the pool cannot cover the target."""
+        seq_id = self._slot_seq[slot]
+        assert seq_id is not None
+        target = min(int(self.seq_lens[slot]) + tokens, self.max_seq_len)
+        owned = len(self.allocator.block_table(seq_id))
+        needed = max(0, self.pages_needed(target) - owned)
+        if needed > self.allocator.stats()["free_blocks"]:
+            return False
+        if target > self.allocator.seq_length(seq_id):
+            try:
+                chaos.maybe_fail("kv.alloc")
+                self.allocator.extend(seq_id, target)
+            except OutOfBlocks:
+                # free_blocks raced another consumer (or the chaos point
+                # fired): same contract as the capacity check above
+                return False
+            table = self.allocator.block_table(seq_id)
+            self.tables[slot, : len(table)] = table
         return True
 
     def advance_slot(self, slot: int, n_tokens: int) -> None:

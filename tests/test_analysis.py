@@ -130,6 +130,78 @@ def test_host_sync_block_until_ready_method(tmp_path):
     assert [f.rule for f in findings] == ["host-sync"]
 
 
+def test_host_sync_int_on_device_producer_result(tmp_path):
+    """int() on a value produced by a jnp/batch_ops call is a hidden sync
+    (jax __int__ blocks): flagged like an explicit np.asarray."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/serving/engine.py": (
+            "import jax.numpy as jnp\n\n"
+            "def _dispatch_decode(self):\n"
+            "    toks = jnp.argmax(self.logits, axis=-1)\n"
+            "    return int(toks[0])\n"
+        ),
+    })
+    assert [f.rule for f in findings] == ["host-sync"]
+    assert "hidden" in findings[0].message
+
+
+def test_host_sync_float_on_device_suffix_attr(tmp_path):
+    """Device-marker suffixes (_dev/_device) taint without an assignment
+    in scope — the engine's persistent device attributes."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/serving/engine.py": (
+            "def _consume_block(self):\n"
+            "    return float(self._last_tok_dev[0])\n"
+        ),
+    })
+    assert [f.rule for f in findings] == ["host-sync"]
+
+
+def test_host_sync_int_propagates_through_unpack_and_copy(tmp_path):
+    """Tuple-unpack from a batch_ops call taints every target, and a
+    plain local copy carries the taint one hop."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/serving/engine.py": (
+            "from gofr_tpu.serving import batch as batch_ops\n\n"
+            "def _dispatch_decode(self):\n"
+            "    packed, cache, state = batch_ops.decode_block(self.p)\n"
+            "    alias = packed\n"
+            "    return bool(alias[0, 0])\n"
+        ),
+    })
+    assert [f.rule for f in findings] == ["host-sync"]
+
+
+def test_host_sync_int_on_materialized_numpy_is_clean(tmp_path):
+    """np.asarray IS the sanctioned (suppressable) sync; int() on its
+    result is a host read, not a second sync."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/serving/engine.py": (
+            "import numpy as np\n\n"
+            "def _consume_block(self, rec):\n"
+            "    ids = np.asarray(rec.packed)"
+            "  # gofrlint: disable=host-sync -- fixture sync point\n"
+            "    return int(ids[0])\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_host_sync_metadata_reads_are_clean(tmp_path):
+    """.shape/.dtype inspection of a device value is static metadata —
+    no sync, no finding; host-side bookkeeping ints stay clean too."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/serving/engine.py": (
+            "import jax.numpy as jnp\n\n"
+            "def _dispatch_decode(self):\n"
+            "    toks = jnp.zeros(4, jnp.int32)\n"
+            "    n = int(toks.shape[0])\n"
+            "    return n + int(self.cache_len[0])\n"
+        ),
+    })
+    assert findings == []
+
+
 # ---------------------------------------------------------------- ctypes
 def test_ctypes_unchecked_positive(tmp_path):
     findings = lint_tree(tmp_path, {

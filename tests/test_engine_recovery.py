@@ -18,6 +18,7 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from gofr_tpu.models import llama
@@ -68,26 +69,17 @@ def test_decode_failure_after_donation_recovers(monkeypatch, kv_dtype):
     requests but leaves the engine servable: the recovery path detects the
     deleted KV storage and rebuilds it."""
     eng = make_engine(kv_dtype=kv_dtype, multi_step=2)
-    real_multi = batch_ops.decode_and_sample_multi
-    real_single = batch_ops.decode_and_sample_pipelined
+    real_block = batch_ops.decode_block
     boom = {"n": 0}
 
-    def fail_once(real):
-        def wrapper(cfg, params, cache, *args, **kw):
-            if boom["n"] == 0:
-                boom["n"] += 1
-                _delete_leaves(cache)
-                raise RuntimeError("transient transport failure post-donation")
-            return real(cfg, params, cache, *args, **kw)
+    def wrapper(cfg, params, cache, *args, **kw):
+        if boom["n"] == 0:
+            boom["n"] += 1
+            _delete_leaves(cache)
+            raise RuntimeError("transient transport failure post-donation")
+        return real_block(cfg, params, cache, *args, **kw)
 
-        return wrapper
-
-    monkeypatch.setattr(
-        batch_ops, "decode_and_sample_multi", fail_once(real_multi)
-    )
-    monkeypatch.setattr(
-        batch_ops, "decode_and_sample_pipelined", fail_once(real_single)
-    )
+    monkeypatch.setattr(batch_ops, "decode_block", wrapper)
     eng.start()
     try:
         fut = eng.submit("hello world", max_new_tokens=8, temperature=0.0)
@@ -144,7 +136,7 @@ def test_paged_pool_failure_recovers(monkeypatch):
     """Paged twin: a paged decode dispatch that deletes the donated pools
     and raises must trigger a pool rebuild (PagedKVCache.reset_pools)."""
     eng = make_engine(kv_layout="paged", kv_page_size=8)
-    real = batch_ops.decode_and_sample_paged
+    real = batch_ops.decode_block_paged
     boom = {"n": 0}
 
     def wrapper(cfg, params, k_pool, v_pool, *args, **kw):
@@ -155,7 +147,7 @@ def test_paged_pool_failure_recovers(monkeypatch):
             raise RuntimeError("transient transport failure post-donation")
         return real(cfg, params, k_pool, v_pool, *args, **kw)
 
-    monkeypatch.setattr(batch_ops, "decode_and_sample_paged", wrapper)
+    monkeypatch.setattr(batch_ops, "decode_block_paged", wrapper)
     eng.start()
     try:
         fut = eng.submit("doomed", max_new_tokens=8, temperature=0.0)
@@ -173,19 +165,36 @@ def test_paged_pool_failure_recovers(monkeypatch):
         eng.stop()
 
 
-def test_scatter_slot_state_not_donated():
-    """Regression pin for the round-4 crash shape: the per-slot int32[B]
-    decode state must NOT be donated — donation of 4·B-byte buffers saves
-    nothing and was the only donated buffer matching the crash signature
-    (int32[32])."""
-    last = jnp.zeros(4, jnp.int32)
-    clen = jnp.ones(4, jnp.int32)
-    batch_ops.scatter_slot_state(
-        last, clen, jnp.array([1], jnp.int32), jnp.array([7], jnp.int32),
-        jnp.array([3], jnp.int32),
+def test_block_output_survives_donated_carry_redispatch():
+    """Regression pin for the round-4 crash shape ("Array has been deleted
+    with shape=int32[32]"): the packed block output the host reads must be
+    a DISTINCT buffer from the donated DecodeState carries. Dispatching
+    block k+1 — which donates the carry that produced block k's output —
+    must leave block k's packed result readable. CPU jax enforces donation
+    (test_cpu_enforces_donation), so an aliasing regression raises here
+    without TPU hardware."""
+    cfg = tiny_cfg(32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    cache = llama.KVCache.create(cfg, 2, max_len=32)
+    state = batch_ops.make_decode_state(
+        np.array([5, 7], np.int32), np.array([4, 4], np.int32),
+        np.array([False, False]), np.array([8, 8], np.int32),
+        np.array([-1, -1], np.int32), np.ones(2, np.float32),
+        np.zeros(2, np.int32), np.ones(2, np.float32),
+        jax.random.PRNGKey(1),
     )
-    # both inputs remain readable after the call
-    assert int(last[0]) == 0 and int(clen[0]) == 1
+    active = jnp.ones(2, bool)
+    packed_k, cache, state = batch_ops.decode_block(
+        cfg, params, cache, state, active, 4
+    )
+    # block k+1 donates BOTH the cache and the state that produced packed_k
+    packed_k1, cache, state = batch_ops.decode_block(
+        cfg, params, cache, state, active, 4
+    )
+    got = np.asarray(packed_k)  # must not raise "Array has been deleted"
+    assert got.shape == (2, 6)
+    assert int(got[0, 5]) >= 1  # n_valid column populated
+    assert np.asarray(packed_k1).shape == (2, 6)
 
 
 @pytest.mark.parametrize("kv_dtype,multi_step", [("bf16", 1), ("int8", 4)])

@@ -248,6 +248,7 @@ def test_engine_config_reads_every_knob():
         "TPU_KV_NUM_PAGES": "123",
         "TPU_KV_DTYPE": "int8",
         "TPU_BATCH_MULTI_STEP": "4",
+        "TPU_DECODE_SYNC_EVERY": "2",
     }, use_env=False))
     assert cfg.max_slots == 16
     assert cfg.max_seq_len == 512
@@ -262,6 +263,11 @@ def test_engine_config_reads_every_knob():
     assert cfg.kv_num_pages == 123
     assert cfg.kv_dtype == "int8"
     assert cfg.multi_step == 4
+    assert cfg.decode_sync_every == 2
+    # unset → None → the engine resolves the CPU-free default block (4)
+    from gofr_tpu.config import MapConfig as _MC
+
+    assert EngineConfig.from_config(_MC({}, use_env=False)).multi_step is None
 
 
 def test_engine_int8_kv_dense_matches_bf16(engine_setup):
@@ -326,6 +332,61 @@ def test_engine_multi_step_concurrent_mixed_lengths(engine_setup):
             assert r.completion_tokens == n or r.finish_reason == "stop"
     finally:
         engine.stop()
+
+
+def test_decode_loop_syncs_once_per_block(engine_setup, monkeypatch):
+    """The CPU-free hot loop's core invariant (ROADMAP item 4): the host
+    materializes device results AT MOST once per N-step block — every
+    read goes through the one sanctioned _block_sync hook, counted here
+    via a patched materialization hook."""
+    import math
+
+    from gofr_tpu.serving import engine as engine_mod
+
+    cfg, params = engine_setup
+    N = 4
+    engine = make_engine(cfg, params, multi_step=N)
+    real = engine_mod._block_sync
+    calls = {"n": 0}
+
+    def counting(value):
+        calls["n"] += 1
+        return real(value)
+
+    monkeypatch.setattr(engine_mod, "_block_sync", counting)
+    engine.start()
+    try:
+        res = engine.submit(
+            "count my syncs", max_new_tokens=17, temperature=0.0
+        ).result(timeout=120)
+        assert res.finish_reason in ("stop", "length")
+        decode_tokens = max(len(res.token_ids) - 1, 1)
+        # one sync per consumed block, plus bounded pipeline slack: the
+        # depth-1 double buffer dispatches (and later drains) up to
+        # sync_every extra blocks after the row freezes on device
+        assert 1 <= calls["n"] <= math.ceil(decode_tokens / N) + 3, calls
+        # and strictly better than the per-token regime the old loop paid
+        if decode_tokens > N:
+            assert calls["n"] < decode_tokens
+    finally:
+        engine.stop()
+
+
+def test_decode_sync_every_depth_matches_depth_one(engine_setup):
+    """TPU_DECODE_SYNC_EVERY deepens the dispatch pipeline; it must change
+    scheduling only, never tokens."""
+    cfg, params = engine_setup
+    ref = make_engine(cfg, params, decode_sync_every=1)
+    deep = make_engine(cfg, params, decode_sync_every=3)
+    ref.start(), deep.start()
+    try:
+        for prompt, n in (("pipeline depth", 11), ("q", 5)):
+            a = ref.submit(prompt, max_new_tokens=n, temperature=0.0).result(timeout=120)
+            b = deep.submit(prompt, max_new_tokens=n, temperature=0.0).result(timeout=120)
+            assert b.token_ids == a.token_ids
+            assert b.finish_reason == a.finish_reason
+    finally:
+        ref.stop(), deep.stop()
 
 
 def test_prompt_longer_than_largest_bucket_truncates(engine_setup):

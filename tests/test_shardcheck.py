@@ -395,6 +395,102 @@ def test_donation_in_loop_with_rebind_clean(tmp_path):
     assert findings == []
 
 
+def test_donation_alias_captured_before_call_flagged(tmp_path):
+    """The dispatch shape that escaped the rule and crashed the round-4
+    TPU engine bench (int32[32]): a reference captured into another name
+    BEFORE the donating call — here a constructor capture, exactly the
+    engine's old ``_Inflight(last_tok, ...)`` — is read after the call
+    even though the donated name itself was rebound in the same
+    statement."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/serving/batch.py": DONATING,
+        "gofr_tpu/serving/engine.py": (
+            "from gofr_tpu.serving.batch import step\n"
+            "import numpy as np\n"
+            "class Inflight:\n"
+            "    def __init__(self, tok):\n"
+            "        self.next_token = tok\n"
+            "def drive(cache, tok):\n"
+            "    rec = Inflight(cache)\n"
+            "    cache, t = step(cache, tok)\n"  # rebind: the old rule passed
+            "    return np.sum(rec.next_token)\n"  # reads the deleted buffer
+        ),
+    })
+    assert rules_of(findings) == ["use-after-donation"]
+    assert "'rec'" in findings[0].message and "captured" in findings[0].message
+
+
+def test_donation_direct_alias_copy_flagged(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/serving/batch.py": DONATING,
+        "gofr_tpu/serving/engine.py": (
+            "from gofr_tpu.serving.batch import step\n"
+            "def drive(cache, tok):\n"
+            "    keep = cache\n"
+            "    cache, t = step(cache, tok)\n"
+            "    return keep + 1\n"
+        ),
+    })
+    assert rules_of(findings) == ["use-after-donation"]
+    assert "'keep'" in findings[0].message
+
+
+def test_donation_alias_rebound_before_read_clean(tmp_path):
+    """Rebinding the alias from the call's OUTPUT before any read sheds
+    the captured reference — the correct fix shape."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/serving/batch.py": DONATING,
+        "gofr_tpu/serving/engine.py": (
+            "from gofr_tpu.serving.batch import step\n"
+            "def drive(cache, tok):\n"
+            "    keep = cache\n"
+            "    cache, t = step(cache, tok)\n"
+            "    keep = cache\n"
+            "    return keep + 1\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_donation_alias_attribute_store_is_not_a_read(tmp_path):
+    """Setting an unrelated field ON the alias after the donating call
+    never reads the captured buffer — the inner Name's Load ctx inside an
+    Attribute store target must not masquerade as a use-after-donation
+    (code-review: this was a false lint failure)."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/serving/batch.py": DONATING,
+        "gofr_tpu/serving/engine.py": (
+            "from gofr_tpu.serving.batch import step\n"
+            "class Holder:\n"
+            "    def __init__(self, tok):\n"
+            "        self.next_token = tok\n"
+            "def drive(cache, tok):\n"
+            "    rec = Holder(cache)\n"
+            "    cache, t = step(cache, tok)\n"
+            "    rec.steps = 2\n"  # attribute STORE: no buffer read
+            "    return t\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_donation_alias_shed_before_call_clean(tmp_path):
+    """A capture re-bound to something else BEFORE the donating call no
+    longer references the donated buffer."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/serving/batch.py": DONATING,
+        "gofr_tpu/serving/engine.py": (
+            "from gofr_tpu.serving.batch import step\n"
+            "def drive(cache, tok, other):\n"
+            "    keep = cache\n"
+            "    keep = other\n"
+            "    cache, t = step(cache, tok)\n"
+            "    return keep + 1\n"
+        ),
+    })
+    assert findings == []
+
+
 # ----------------------------------------------------------- retrace hazards
 def test_retrace_branch_on_traced_param(tmp_path):
     findings = lint_tree(tmp_path, {

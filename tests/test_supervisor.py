@@ -140,24 +140,35 @@ def test_warm_restart_requeues_queued_requests():
         eng.stop()
 
 
-def test_warm_restart_quarantines_hung_thread_and_fails_inflight():
+def test_warm_restart_quarantines_hung_thread_and_fails_inflight(monkeypatch):
     """An engine thread that cannot join: the in-flight stream fails
     RETRIABLE, the native scheduler/pool are quarantine-leaked (never
     destroyed under a live thread), and the thawed old thread retires
-    itself via the identity guard instead of racing the replacement."""
+    itself via the identity guard instead of racing the replacement.
+
+    The pin lives in the DECODE DISPATCH — the realistic hang shape (a
+    device call that never returns). A blocking stream_cb no longer pins
+    the engine thread at all: emission runs on the detok executor
+    (docs/performance.md), which is exactly why the old version of this
+    test stopped hanging anything."""
+    from gofr_tpu.serving import batch as batch_ops
+
     eng = make_engine(kv_layout="paged", kv_page_size=8)
     hold = threading.Event()
-    first_token = threading.Event()
+    pinned = threading.Event()
+    real_block = batch_ops.decode_block_paged
 
-    def cb(token_id, piece, done):
-        if not done:
-            first_token.set()
-            hold.wait(30)  # pins the ENGINE THREAD mid-request
+    def hanging_block(*args, **kw):
+        if not pinned.is_set():
+            pinned.set()
+            hold.wait(30)  # pins the ENGINE THREAD mid-dispatch
+        return real_block(*args, **kw)
 
+    monkeypatch.setattr(batch_ops, "decode_block_paged", hanging_block)
     eng.start()
     try:
-        fut = eng.submit("held in flight", max_new_tokens=40, stream_cb=cb)
-        assert first_token.wait(60)
+        fut = eng.submit("held in flight", max_new_tokens=40)
+        assert pinned.wait(60)
         old_thread = eng._thread
         old_sched = eng._sched
         assert eng.warm_restart(join_timeout=0.2) is True
