@@ -35,10 +35,13 @@ ci: lint bench-check
 # never lost, never looping) over the memory + kafka-wire drivers.
 # Deterministic: a red run reproduces with the same seed every time (seeds
 # live in tests/test_chaos.py::CHAOS_SEEDS,
-# tests/test_supervisor.py::CHAOS_SEEDS and
-# tests/test_pubsub_chaos.py::CHAOS_SEEDS).
+# tests/test_supervisor.py::CHAOS_SEEDS,
+# tests/test_pubsub_chaos.py::CHAOS_SEEDS and
+# tests/test_router_chaos.py::CHAOS_SEEDS), plus the router-plane replica
+# tier (kill / wedge / heartbeat-partition over ≥2 in-process replicas,
+# asserting exactly-one-terminal-state-on-exactly-one-replica).
 chaos:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py tests/test_supervisor.py tests/test_pubsub_chaos.py -q -m chaos
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py tests/test_supervisor.py tests/test_pubsub_chaos.py tests/test_router_chaos.py -q -m chaos
 
 # gofrlint (docs/static-analysis.md): framework-invariant AST lints over
 # the whole package + the extern-C vs ctypes FFI signature cross-check.

@@ -41,6 +41,14 @@ Rules
     no longer be nacked into the retry/DLQ ladder). Cross-file: handler
     registrations are collected everywhere, settle calls inside those
     functions are flagged.
+``router-retry-untyped``
+    The router's retry/failover paths (serving/router.py ``submit`` /
+    ``_failover`` / ``_hedge``) may catch ONLY the typed-retriable error
+    set (``RETRIABLE_ERRORS``: 503 warm-restart, 429 shed, breaker-open,
+    chaos transient, transport reset) plus the terminal
+    ``ErrorDeadlineExceeded``. A broad ``except Exception`` there would
+    re-route requests that failed for non-retriable reasons — silently
+    duplicating work, or worse, a non-idempotent stream.
 
 Blocking/host-sync checks skip nested (closure) functions: closures in
 these zones are deferred work — thread targets and
@@ -68,6 +76,7 @@ DISPATCH_ZONES: dict[str, set[str] | str] = {
     "gofr_tpu/serving/engine.py": "*",
     "gofr_tpu/serving/batch.py": "*",
     "gofr_tpu/serving/native_embed.py": "*",
+    "gofr_tpu/serving/router.py": "*",
 }
 
 # retry/backoff paths reachable from handlers: uninterruptible sleeps only
@@ -75,6 +84,21 @@ BACKOFF_ZONES: dict[str, set[str] | str] = {
     "gofr_tpu/service/options.py": "*",
     "gofr_tpu/datasource/pubsub/mqtt.py": "*",
     "gofr_tpu/datasource/sql/pool.py": "*",
+}
+
+# router failover/hedge paths: except clauses here may name ONLY the
+# typed-retriable set (plus the terminal deadline error) — a broad catch
+# would re-route non-retriable failures (serving/router.py)
+ROUTER_RETRY_ZONES: dict[str, set[str] | str] = {
+    "gofr_tpu/serving/router.py": {
+        "submit", "_submit_attempt", "_failover", "_hedge",
+    },
+}
+ROUTER_RETRIABLE_NAMES = {
+    "RETRIABLE_ERRORS",        # the canonical tuple (serving/router.py)
+    "ErrorServiceUnavailable", "ErrorTooManyRequests",
+    "CircuitBreakerError", "ChaosFault", "ConnectionError",
+    "ErrorDeadlineExceeded",   # terminal: settles the request, never retried
 }
 
 # decode hot path: ONE annotated sync point per N-step block (engine.py
@@ -720,11 +744,64 @@ class PubSubManualSettleRule(Rule):
         ]
 
 
+class RouterRetryTypedRule(Rule):
+    """``router-retry-untyped``: except clauses inside the router's
+    retry-zone functions (ROUTER_RETRY_ZONES) must name only the typed
+    retriable error set. ``except Exception``, a bare ``except``, or any
+    unlisted type is a finding — the failover path re-submitting a
+    request that failed a 400-class or programming error would duplicate
+    work (and a non-idempotent stream) silently."""
+
+    name = "router-retry-untyped"
+
+    def _bad_names(self, handler: ast.ExceptHandler) -> list[str]:
+        t = handler.type
+        if t is None:
+            return ["<bare except>"]
+        exprs = list(t.elts) if isinstance(t, ast.Tuple) else [t]
+        bad: list[str] = []
+        for expr in exprs:
+            dotted = _dotted(expr)
+            if dotted is None:
+                bad.append("<computed>")
+                continue
+            if dotted.rsplit(".", 1)[-1] not in ROUTER_RETRIABLE_NAMES:
+                bad.append(dotted)
+        return bad
+
+    def visit_file(self, sf: SourceFile) -> list[Finding]:
+        funcs = _zone_functions(ROUTER_RETRY_ZONES, sf.rel_path)
+        if funcs is None:
+            return []
+        out: list[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if funcs != "*" and node.name not in funcs:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.ExceptHandler):
+                    continue
+                bad = self._bad_names(sub)
+                if bad and not sf.is_suppressed(self.name, sub.lineno):
+                    out.append(
+                        Finding(
+                            self.name, sf.rel_path, sub.lineno,
+                            f"retry path '{node.name}' catches "
+                            f"{', '.join(bad)} — only the typed-retriable "
+                            "set (RETRIABLE_ERRORS, or its members / "
+                            "ErrorDeadlineExceeded) may be handled here",
+                        )
+                    )
+        return out
+
+
 def default_rules() -> list[Rule]:
     from gofr_tpu.analysis.shardcheck import shardcheck_rules
 
     return [
         BlockingCallRule(), HostSyncRule(), CtypesCheckedRule(), MetricsRule(),
         DaemonLoopHeartbeatRule(), PubSubManualSettleRule(),
+        RouterRetryTypedRule(),
         *shardcheck_rules(),
     ]

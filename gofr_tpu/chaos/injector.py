@@ -42,6 +42,8 @@ POINTS = (
     "pubsub.subscribe",  # consumer-loop poll (broker fetch)
     "pubsub.ack",       # message settlement (commit / nack)
     "pubsub.handler",   # subscriber handler invocation
+    "router.route",     # router submission to a replica (transport seam)
+    "router.heartbeat",  # replica heartbeat publish (partition: beat drops)
 )
 
 

@@ -201,6 +201,29 @@ class Container:
             "Circuit-breaker state per downstream service address: "
             "0 closed, 1 open",
         )
+        # router tier (serving/router.py, docs/robustness.md "The router
+        # plane"): per-replica state, failover/hedge counters, and the
+        # tier-level queue-wait autoscaling signal
+        m.new_gauge(
+            "app_router_replica_state",
+            "Router's view of each replica: 0 UP, 1 SUSPECT, 2 RESTARTING, "
+            "3 DRAINING, 4 WEDGED, 5 DOWN",
+        )
+        m.new_counter(
+            "app_router_failovers_total",
+            "Requests re-routed to another replica after a retriable "
+            "pre-first-token failure",
+        )
+        m.new_counter(
+            "app_router_hedges_total",
+            "Prefill admissions hedged on a second replica after the "
+            "p99-based delay",
+        )
+        m.new_gauge(
+            "app_router_queue_wait_seconds",
+            "Mean reported queue-wait EWMA across live replicas (the "
+            "tier-level autoscaling signal)",
+        )
 
     # -- accessors mirroring the reference's API ------------------------------
     @property

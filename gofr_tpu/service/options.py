@@ -81,6 +81,11 @@ class CircuitBreaker(_Wrapper):
         self._lock = threading.Lock()
         self._probe_thread: threading.Thread | None = None
         self._stop = threading.Event()
+        # optional hook fired on every open/close transition (bool: open).
+        # The router tier wires it into replica membership — the breaker
+        # opening marks the replica DOWN ahead of the heartbeat timers
+        # (serving/router.py HTTPReplica).
+        self.on_state_change: Any = None
         self._set_state_gauge(False)  # the closed state is visible from t=0
 
     @property
@@ -102,6 +107,15 @@ class CircuitBreaker(_Wrapper):
             )
         except Exception:
             pass  # a metrics backend hiccup must never affect the breaker
+
+    def _notify_state(self, open_: bool) -> None:
+        hook = self.__dict__.get("on_state_change")
+        if hook is None:
+            return
+        try:
+            hook(open_)
+        except Exception:
+            pass  # a listener failure must never affect the breaker
 
     def request(self, method: str, path: str, **kw: Any) -> ServiceResponse:
         with self._lock:
@@ -128,6 +142,7 @@ class CircuitBreaker(_Wrapper):
                 self._start_probe()
         if opened:
             self._set_state_gauge(True)
+            self._notify_state(True)
 
     def _start_probe(self) -> None:
         """Async recovery loop (circuit_breaker.go:100-119)."""
@@ -143,6 +158,7 @@ class CircuitBreaker(_Wrapper):
                     self._open = False
                     self._failures = 0
                 self._set_state_gauge(False)
+                self._notify_state(False)
                 self._stop.set()
                 return
 
@@ -181,6 +197,20 @@ class RetryConfig:
 _RETRIABLE_STATUS = {429, 500, 502, 503, 504}
 
 
+def retry_after_from_headers(headers: dict[str, str]) -> float | None:
+    """Seconds-form ``Retry-After``, or None. RFC 7231 also allows an
+    HTTP-date form — an unparseable value must degrade to "no hint",
+    never to a raise that demotes a retriable 429/503. Shared by the
+    Retry option and the router tier's HTTPReplica."""
+    for key, value in headers.items():
+        if key.lower() == "retry-after":
+            try:
+                return float(value)
+            except ValueError:
+                return None
+    return None
+
+
 class Retry(_Wrapper):
     def __init__(self, cfg: RetryConfig, inner: Any) -> None:
         super().__init__(inner)
@@ -211,13 +241,7 @@ class Retry(_Wrapper):
     def _retry_after_of(resp: ServiceResponse | None) -> float | None:
         if resp is None:
             return None
-        for key, value in resp.headers.items():
-            if key.lower() == "retry-after":
-                try:
-                    return float(value)
-                except ValueError:
-                    return None
-        return None
+        return retry_after_from_headers(resp.headers)
 
     def request(self, method: str, path: str, **kw: Any) -> ServiceResponse:
         last_exc: Exception | None = None

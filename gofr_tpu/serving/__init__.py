@@ -12,9 +12,23 @@ the unit of concurrency is the *slot*, not the thread. Components:
   stack runs with zero external assets).
 - handlers.py: ready-made HTTP handlers (/generate JSON + SSE stream,
   /embed) that plug the engine into the App router.
+- router.py / membership.py: the multi-replica router tier — pubsub
+  heartbeat membership, prefix-affinity routing with failover, hedged
+  prefill admission (docs/robustness.md "The router plane").
 """
 
 from gofr_tpu.serving.engine import EngineConfig, GenerationResult, ServingEngine
+from gofr_tpu.serving.membership import (
+    Heartbeat,
+    MembershipTable,
+    ReplicaAnnouncer,
+)
+from gofr_tpu.serving.router import (
+    HTTPReplica,
+    LocalReplica,
+    Router,
+    RouterConfig,
+)
 from gofr_tpu.serving.supervisor import EngineSupervisor
 from gofr_tpu.serving.tokenizer import ByteTokenizer, Tokenizer
 
@@ -25,4 +39,11 @@ __all__ = [
     "GenerationResult",
     "Tokenizer",
     "ByteTokenizer",
+    "Router",
+    "RouterConfig",
+    "LocalReplica",
+    "HTTPReplica",
+    "MembershipTable",
+    "ReplicaAnnouncer",
+    "Heartbeat",
 ]

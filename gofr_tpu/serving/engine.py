@@ -301,6 +301,17 @@ class ServingEngine:
         # EXACTLY this, never a hand-copied drift of it
         self._init_runtime_state()
         self.rng = jax.random.PRNGKey(seed)
+        # per-REQUEST key root for prefill first-token sampling. The
+        # shared self.rng stream is split by decode/spec dispatches too,
+        # so a request's draw would depend on how many device steps
+        # interleaved before its admission — which depends on jit-cache
+        # warmth and thread timing (the test_spec_concurrent flake: warm
+        # caches shift the interleave and a sampled row draws EOS as its
+        # first prefill token). fold_in(root, rid) pins each request's
+        # first token to its id alone: same submit order → same tokens,
+        # standalone or mid-suite, and a requeued/warm-restarted request
+        # re-prefills to the identical first token.
+        self._rng_root = jax.random.PRNGKey(seed)
         # detokenization + stream emission run OFF the engine thread on
         # this single-worker executor, so a slow tokenizer or a blocking
         # stream_cb overlaps the device block instead of stalling it. ONE
@@ -1272,8 +1283,9 @@ class ServingEngine:
                 dense.k, dense.v = batch_ops.insert_slot(
                     dense.k, dense.v, k_slab, v_slab, jnp.int32(slot)
                 )
-            # sample the first token with this request's params
-            self.rng, key = jax.random.split(self.rng)
+            # sample the first token with this request's params, keyed by
+            # request id (NOT the shared stream — see _rng_root above)
+            key = jax.random.fold_in(self._rng_root, req.id)
             from gofr_tpu.ops.sampling import sample_logits
 
             first = sample_logits(

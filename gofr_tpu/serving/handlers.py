@@ -237,6 +237,24 @@ def register_generation_ws(app: Any, engine: Any, path: str = "/ws/generate",
     app.websocket(path, ws_generate)
 
 
+def register_router_routes(app: Any, router: Any,
+                           path: str = "/routerz") -> None:
+    """The router tier's health view (docs/robustness.md "The router
+    plane"): GET ``/routerz`` returns membership (per-replica state,
+    heartbeat age, queue-wait, KV headroom), routing counters and the
+    live knob values. Also hands the router to the container so
+    ``/.well-known/health`` aggregates it, and wires start/stop into the
+    app lifecycle."""
+    app.container.register_datasource("router", router)
+
+    async def routerz_handler(ctx: Any):
+        return router.routerz()
+
+    app.get(path, routerz_handler)
+    app.on_start(lambda ctx: router.start())
+    app.on_shutdown(router.stop)
+
+
 def register_admin_drain(app: Any, path: str = "/.well-known/drain") -> None:
     """The admin drain trigger: POST flips the app to DRAINING (same path
     SIGTERM takes — new work rejected with a retriable 503, in-flight

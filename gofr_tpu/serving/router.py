@@ -1,0 +1,947 @@
+"""The multi-replica router tier: prefix-affinity routing with failover.
+
+One engine process serves one accelerator; millions of users need N of
+them (ROADMAP item 3). This router fronts N replicas and makes the tier
+survive the death, drain, or wedge of any individual replica with no
+lost requests and bounded failover latency (AIBrix, arXiv:2504.03648 —
+cache-aware routing + health-driven replica management is what turns a
+fast engine into a serving *system*).
+
+Four planes, each reusing a robustness layer built in PRs 3-5:
+
+- **Membership** (serving/membership.py): replicas heartbeat over the
+  pubsub layer; missed beats → SUSPECT → DOWN, breaker-open → DOWN now.
+  DRAINING/WEDGED replicas receive zero new routes.
+- **Prefix affinity**: requests hash by prompt prefix onto a consistent
+  ring (vnodes per replica), so repeated prefixes land on the replica
+  whose ``prefix_cache.py`` already holds their prefill — falling back
+  to least-estimated-wait when the affine replica is unhealthy or its
+  shed queue-wait EWMA exceeds the spill bound. Affinity is a hint, not
+  an invariant: spilling costs one prefill, routing to a dead replica
+  costs the request.
+- **Failover & hedging**: a replica failing a request *before its first
+  token* (503/UNAVAILABLE + Retry-After — the PR 5 warm-restart
+  contract) re-routes to the next candidate with the original absolute
+  deadline preserved. A request that already streamed tokens is NEVER
+  silently re-run (the stream is not idempotent): the client gets the
+  typed retriable error and decides. Optionally the *prefill admission*
+  is hedged on a second replica after a p99-based delay; first token
+  wins, the loser is canceled before it streams.
+- **Observability**: per-replica ``app_router_replica_state``,
+  ``app_router_failovers_total``, ``app_router_hedges_total``, aggregate
+  queue-wait, and the ``/routerz`` view (serving/handlers.py).
+
+The invariant the chaos tier (tests/test_router_chaos.py) enforces:
+*every accepted request reaches exactly one terminal state on exactly
+one replica, within its deadline or with a retriable error.*
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import hashlib
+import threading
+import time
+from typing import Any, Callable
+
+from gofr_tpu import chaos
+from gofr_tpu.chaos.injector import ChaosFault
+from gofr_tpu.http.errors import (
+    ErrorDeadlineExceeded,
+    ErrorServiceUnavailable,
+    ErrorTooManyRequests,
+)
+from gofr_tpu.serving import membership as ms
+from gofr_tpu.service.options import (
+    CircuitBreakerError,
+    retry_after_from_headers,
+)
+
+# The typed-retriable error set: ONLY these may trigger a failover
+# re-route or be swallowed while a better attempt lives. Everything else
+# (400s, 413, deadline, programming errors) propagates to the client
+# untouched — retrying a non-retriable error duplicates work at best and
+# output at worst. gofrlint's ``router-retry-untyped`` rule pins this:
+# except clauses in the retry-zone functions must name only this set.
+RETRIABLE_ERRORS = (
+    ErrorServiceUnavailable,   # 503 + Retry-After: warm restart / drain
+    ErrorTooManyRequests,      # 429 shed: another replica may have room
+    CircuitBreakerError,       # breaker open: the replica is gone
+    ChaosFault,                # injected transient (chaos tier)
+    ConnectionError,           # transport reset to a remote replica
+)
+
+
+@dataclasses.dataclass
+class RouterConfig:
+    """Every knob env-tunable, like EngineConfig (docs/robustness.md has
+    the full table)."""
+
+    heartbeat_s: float = 1.0
+    suspect_after_s: float | None = None   # default: 3 × heartbeat_s
+    down_after_s: float | None = None      # default: 10 × heartbeat_s
+    # affine replica's queue-wait EWMA above this → spill to the
+    # least-loaded healthy replica (0 disables spilling)
+    spill_wait_s: float = 1.0
+    # prompt units (tokens for id lists, utf-8 bytes for strings) that
+    # define the affinity prefix — long enough to separate system
+    # prompts, short enough that a trailing user turn doesn't break
+    # affinity
+    affinity_prefix_tokens: int = 32
+    vnodes: int = 64               # ring positions per replica
+    max_failovers: int = 3         # re-routes per request
+    # hedge the prefill admission on a second replica when the first
+    # token hasn't arrived after this many seconds (0 disables hedging)
+    hedge_delay_s: float = 0.0
+    # with enough TTFT observations, the hedge delay floors at the
+    # observed p99 — hedging inside normal latency doubles prefill load
+    # for nothing
+    hedge_from_p99: bool = True
+    heartbeat_topic: str = ms.HEARTBEAT_TOPIC
+
+    def __post_init__(self) -> None:
+        if self.suspect_after_s is None:
+            self.suspect_after_s = 3.0 * self.heartbeat_s
+        if self.down_after_s is None:
+            self.down_after_s = 10.0 * self.heartbeat_s
+
+    @classmethod
+    def from_config(cls, config: Any) -> "RouterConfig":
+        hb = float(config.get_or_default("TPU_ROUTER_HEARTBEAT_S", "1.0"))
+        suspect = config.get("TPU_ROUTER_SUSPECT_AFTER_S")
+        down = config.get("TPU_ROUTER_DOWN_AFTER_S")
+        return cls(
+            heartbeat_s=hb,
+            suspect_after_s=float(suspect) if suspect else None,
+            down_after_s=float(down) if down else None,
+            spill_wait_s=float(
+                config.get_or_default("TPU_ROUTER_SPILL_WAIT_S", "1.0")
+            ),
+            affinity_prefix_tokens=int(
+                config.get_or_default("TPU_ROUTER_AFFINITY_TOKENS", "32")
+            ),
+            vnodes=int(config.get_or_default("TPU_ROUTER_VNODES", "64")),
+            max_failovers=int(
+                config.get_or_default("TPU_ROUTER_MAX_FAILOVERS", "3")
+            ),
+            hedge_delay_s=float(
+                config.get_or_default("TPU_ROUTER_HEDGE_DELAY_S", "0")
+            ),
+            hedge_from_p99=config.get_or_default(
+                "TPU_ROUTER_HEDGE_P99", "true"
+            ).lower() in ("1", "true", "yes"),
+            heartbeat_topic=config.get_or_default(
+                "TPU_ROUTER_HEARTBEAT_TOPIC", ms.HEARTBEAT_TOPIC
+            ),
+        )
+
+
+def prefix_affinity_key(prompt: str | list[int], prefix_units: int) -> bytes:
+    """The affinity key: a digest of the prompt's leading units (token
+    ids for pre-tokenized prompts, utf-8 bytes for strings). Mirrors the
+    keying of serving/prefix_cache.py — two requests sharing a system
+    prompt share a key, so the ring sends them to the replica whose
+    prefix cache already holds that prefill."""
+    if isinstance(prompt, str):
+        head = prompt.encode("utf-8")[:prefix_units]
+    else:
+        import numpy as np
+
+        head = np.asarray(list(prompt[:prefix_units]), np.int32).tobytes()
+    return hashlib.blake2b(head, digest_size=8).digest()
+
+
+class _HashRing:
+    """Consistent hash over replica ids with ``vnodes`` positions each:
+    adding/removing one replica remaps ~1/N of the key space instead of
+    all of it (affinity survives membership churn)."""
+
+    def __init__(self, replica_ids: list[str], vnodes: int) -> None:
+        points: list[tuple[int, str]] = []
+        for rid in replica_ids:
+            for v in range(vnodes):
+                digest = hashlib.blake2b(
+                    f"{rid}#{v}".encode(), digest_size=8
+                ).digest()
+                points.append((int.from_bytes(digest, "big"), rid))
+        points.sort()
+        self._points = points
+
+    def lookup(self, key: bytes) -> str | None:
+        if not self._points:
+            return None
+        import bisect
+
+        h = int.from_bytes(
+            hashlib.blake2b(key, digest_size=8).digest(), "big"
+        )
+        idx = bisect.bisect_left(self._points, (h, ""))
+        if idx == len(self._points):
+            idx = 0
+        return self._points[idx][1]
+
+
+class LocalReplica:
+    """An in-process engine replica handle (the chaos tier runs ≥2 of
+    these side by side; production wraps one per process). The handle
+    contract: ``submit(prompt, **kw) -> Future``, ``cancel(request_id)``,
+    ``health_check()``."""
+
+    def __init__(self, replica_id: str, engine: Any) -> None:
+        self.replica_id = replica_id
+        self.engine = engine
+
+    def submit(self, prompt: str | list[int], **kw: Any) -> Any:
+        return self.engine.submit(prompt, **kw)
+
+    def cancel(self, request_id: int) -> None:
+        self.engine.cancel(request_id)
+
+    def health_check(self) -> dict[str, Any]:
+        return self.engine.health_check()
+
+
+class HTTPReplica:
+    """A remote engine replica behind its HTTP surface, through the
+    service-client stack (PR 3 retry semantics stay with the ROUTER —
+    the client here is breaker-only, because the router's failover IS
+    the retry policy; stacking both would retry twice).
+
+    The breaker's open/close transitions feed the membership table
+    directly via ``on_state_change`` — the data path detecting a dead
+    replica must not wait for the heartbeat timers."""
+
+    def __init__(self, replica_id: str, address: str, *, logger: Any = None,
+                 metrics: Any = None, breaker_threshold: int = 3,
+                 breaker_interval: float = 5.0,
+                 on_breaker_open: Callable[[str], None] | None = None) -> None:
+        from gofr_tpu.service.client import new_http_service
+        from gofr_tpu.service.options import CircuitBreakerConfig
+
+        self.replica_id = replica_id
+        self.address = address
+        self._svc = new_http_service(
+            address, logger, metrics, None,
+            CircuitBreakerConfig(breaker_threshold, breaker_interval),
+        )
+        if on_breaker_open is not None:
+            self._svc.on_state_change = (
+                lambda open_: on_breaker_open(replica_id) if open_ else None
+            )
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix=f"replica-{replica_id}"
+        )
+        self._rid_mu = threading.Lock()
+        self._next_rid = 0
+
+    def submit(self, prompt: str | list[int], *, deadline: float | None = None,
+               stream_cb: Any = None, **kw: Any) -> Any:
+        with self._rid_mu:
+            self._next_rid += 1
+            rid = self._next_rid
+        future: Any = concurrent.futures.Future()
+        future.request_id = rid
+        payload: dict[str, Any] = {"prompt": prompt}
+        if kw.get("max_new_tokens"):
+            payload["max_tokens"] = kw["max_new_tokens"]
+        for key in ("temperature", "top_k", "top_p"):
+            if kw.get(key):
+                payload[key] = kw[key]
+        headers = (
+            {"X-Request-Timeout": f"{deadline:.3f}"} if deadline else None
+        )
+
+        def run() -> None:
+            try:
+                resp = self._svc.post(
+                    "/generate", json=payload, headers=headers,
+                    timeout=deadline,
+                )
+                if resp.status_code in (503, 429):
+                    err_cls = (
+                        ErrorServiceUnavailable if resp.status_code == 503
+                        else ErrorTooManyRequests
+                    )
+                    raise err_cls(
+                        f"replica {self.replica_id}: {resp.status_code}",
+                        retry_after=retry_after_from_headers(resp.headers),
+                    )
+                if resp.status_code == 504:
+                    raise ErrorDeadlineExceeded()
+                if not resp.ok:
+                    raise RuntimeError(
+                        f"replica {self.replica_id}: HTTP {resp.status_code}"
+                    )
+                body = resp.json()
+                data = body.get("data") or body
+                if stream_cb is not None:
+                    stream_cb(0, data.get("text", ""), False)
+                    stream_cb(0, "", True)
+                future.set_result(_RemoteResult(rid, data))
+            # gofrlint: disable=router-retry-untyped -- settles the future
+            # with the error (no retry happens here); a narrow catch would
+            # strand the client future forever on an unexpected failure
+            except BaseException as exc:
+                if isinstance(exc, OSError) and not isinstance(
+                    exc, ConnectionError
+                ):
+                    exc = ConnectionError(str(exc))
+                future.set_exception(exc)
+
+        self._pool.submit(run)
+        return future
+
+    def cancel(self, request_id: int) -> None:
+        pass  # no remote cancel wire yet; the deadline bounds the work
+
+    def health_check(self) -> dict[str, Any]:
+        return self._svc.health_check()
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+
+
+class _RemoteResult:
+    """GenerationResult-shaped view of a remote /generate response."""
+
+    def __init__(self, rid: int, data: dict[str, Any]) -> None:
+        usage = data.get("usage") or {}
+        self.request_id = rid
+        self.text = data.get("text", "")
+        self.token_ids: list[int] = data.get("token_ids") or []
+        self.finish_reason = data.get("finish_reason", "stop")
+        self.prompt_tokens = usage.get("prompt_tokens", 0)
+        self.completion_tokens = usage.get("completion_tokens", 0)
+        self.ttft_s = usage.get("ttft_ms", 0.0) / 1000.0
+        self.duration_s = usage.get("duration_ms", 0.0) / 1000.0
+
+
+class _RouterRequest:
+    """Per-request routing state: which replicas were tried, which
+    attempt owns the client-visible stream, how many tokens crossed."""
+
+    def __init__(self, rid: int, prompt: Any, kw: dict[str, Any],
+                 stream_cb: Any, deadline_abs: float | None) -> None:
+        self.rid = rid
+        self.prompt = prompt
+        self.kw = kw
+        self.stream_cb = stream_cb
+        self.deadline_abs = deadline_abs
+        self.future: Any = concurrent.futures.Future()
+        self.future.request_id = rid
+        self.mu = threading.Lock()
+        self.tried: list[str] = []
+        self.live: dict[str, Any] = {}   # replica_id -> replica future
+        self.winner: str | None = None
+        self.first_token_at: float | None = None
+        self.submitted_at = time.monotonic()
+        self.failovers = 0
+        self.hedge_timer: threading.Timer | None = None
+        self.canceled = False
+
+    def remaining(self) -> float | None:
+        if self.deadline_abs is None:
+            return None
+        return self.deadline_abs - time.monotonic()
+
+
+class Router:
+    """Fronts N replicas: membership-aware, prefix-affine, failover- and
+    hedge-capable submit surface mirroring ``ServingEngine.submit``."""
+
+    def __init__(
+        self,
+        config: RouterConfig | None = None,
+        *,
+        broker: Any = None,
+        metrics: Any = None,
+        logger: Any = None,
+    ) -> None:
+        self.config = config or RouterConfig()
+        self.broker = broker
+        self._metrics = metrics
+        self._logger = logger
+        self.membership = ms.MembershipTable(
+            suspect_after_s=self.config.suspect_after_s or 3.0,
+            down_after_s=self.config.down_after_s or 10.0,
+        )
+        self._handles: dict[str, Any] = {}
+        self._handles_mu = threading.Lock()
+        self._ring: _HashRing | None = None
+        self._ring_ids: tuple[str, ...] = ()
+        self._requests: dict[int, _RouterRequest] = {}
+        self._req_mu = threading.Lock()
+        self._next_rid = 0
+        self._failover_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="router-failover"
+        )
+        self._stop = threading.Event()
+        self._consumer: threading.Thread | None = None
+        self._ttft_mu = threading.Lock()
+        self._ttfts: list[float] = []  # bounded ring, newest appended
+        # counters mirrored into /routerz (metrics keep the canonical
+        # series; these make the health view self-contained). Guarded by
+        # _stats_mu: they are bumped from caller threads, the failover
+        # pool AND replica settlement threads at once — exactly during
+        # the failover storms an operator reads them to understand.
+        self._stats_mu = threading.Lock()
+        self.routed_total = 0
+        self.failovers_total = 0
+        self.hedges_total = 0
+        self.spills_total = 0
+        self.no_replica_total = 0
+        self.routes_by_replica: dict[str, int] = {}
+
+    # -- provider pattern (lets the container own the router) ------------------
+    def use_logger(self, logger: Any) -> None:
+        self._logger = logger
+
+    def use_metrics(self, metrics: Any) -> None:
+        self._metrics = metrics
+
+    def use_tracer(self, tracer: Any) -> None:
+        pass
+
+    def connect(self) -> None:
+        pass
+
+    # -- replica management ----------------------------------------------------
+    def add_replica(self, handle: Any) -> None:
+        """Register a replica handle (LocalReplica / HTTPReplica). The
+        replica stays SUSPECT until its first heartbeat lands."""
+        with self._handles_mu:
+            self._handles[handle.replica_id] = handle
+            self._ring = None  # rebuilt lazily against the new set
+        self.membership.register(handle.replica_id)
+
+    def remove_replica(self, replica_id: str) -> None:
+        with self._handles_mu:
+            self._handles.pop(replica_id, None)
+            self._ring = None
+        self.membership.forget(replica_id)
+
+    def mark_replica_down(self, replica_id: str,
+                          reason: str = "breaker-open") -> None:
+        """The breaker's fast path into membership."""
+        self.membership.mark_down(replica_id, reason)
+        self._export_states()
+
+    def _ring_for(self, ids: list[str]) -> _HashRing:
+        key = tuple(sorted(ids))
+        with self._handles_mu:
+            if self._ring is None or self._ring_ids != key:
+                self._ring = _HashRing(list(key), self.config.vnodes)
+                self._ring_ids = key
+            return self._ring
+
+    # -- lifecycle -------------------------------------------------------------
+    def start(self) -> None:
+        """Start the membership consumer (needs a broker; without one,
+        feed ``membership.observe`` directly — unit tests do)."""
+        if self._consumer is not None and self._consumer.is_alive():
+            return
+        self._stop.clear()
+        self._consumer = threading.Thread(
+            target=self._membership_loop, daemon=True,
+            name="router-membership",
+        )
+        self._consumer.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        consumer = self._consumer
+        if consumer is not None:
+            consumer.join(timeout=2.0)
+        self._consumer = None
+        self._failover_pool.shutdown(wait=False)
+
+    def close(self) -> None:
+        self.stop()
+
+    def _membership_loop(self) -> None:
+        """Poll heartbeats, commit each (observe is idempotent under the
+        at-least-once contract: stale seqs are dropped), sweep timers and
+        export gauges at most once per heartbeat interval."""
+        topic = self.config.heartbeat_topic
+        last_export = 0.0
+        while not self._stop.is_set():
+            msg = None
+            if self.broker is not None:
+                try:
+                    msg = self.broker.subscribe(topic)
+                except Exception as exc:
+                    if self._logger is not None:
+                        self._logger.debug(f"heartbeat poll failed: {exc}")
+                    self._stop.wait(self.config.heartbeat_s)
+            if msg is not None:
+                try:
+                    self.membership.observe(ms.Heartbeat.from_json(msg.value))
+                except (ValueError, KeyError, TypeError):
+                    pass  # malformed beat: drop, never crash the loop
+                try:
+                    msg.commit()
+                except Exception:
+                    pass  # redelivery is harmless (seq-idempotent)
+            else:
+                # a driver whose subscribe() returns None without blocking
+                # on its own poll timeout must not spin this thread at
+                # 100% (the subscriber.py IDLE_SLEEP lesson); bounded so
+                # beat-observation latency stays well inside suspect_after
+                self._stop.wait(min(self.config.heartbeat_s, 0.05))
+            now = time.monotonic()
+            if now - last_export >= min(self.config.heartbeat_s, 0.5):
+                last_export = now
+                self._export_states()
+
+    def _export_states(self) -> None:
+        if self._metrics is None:
+            return
+        snapshot = self.membership.snapshot()
+        for rid, view in snapshot.items():
+            self._metrics.set_gauge(
+                "app_router_replica_state",
+                float(ms.STATE_VALUES.get(view["state"], ms.STATE_VALUES[ms.DOWN])),
+                replica=rid,
+            )
+        self._metrics.set_gauge(
+            "app_router_queue_wait_seconds",
+            self.membership.aggregate_queue_wait(),
+        )
+
+    # -- routing ---------------------------------------------------------------
+    def _candidates_for(self, prompt: Any) -> tuple[list[str], bool]:
+        """Ordered candidate replicas for a new request: the prefix-
+        affine replica first (when healthy and under the spill bound),
+        then every other routable replica by least estimated wait.
+        Returns (candidates, spilled)."""
+        routable = self.membership.candidates()
+        if not routable:
+            return [], False
+        key = prefix_affinity_key(prompt, self.config.affinity_prefix_tokens)
+        affine = self._ring_for(routable).lookup(key)
+        spilled = False
+        if affine in routable:
+            wait, _depth = self.membership.load_of(affine)
+            cap = self.config.spill_wait_s
+            if cap > 0 and wait > cap:
+                # load-aware spill: the affine replica is healthy but
+                # queued past the bound — one cold prefill elsewhere
+                # beats queueing behind its backlog
+                routable = [r for r in routable if r != affine] + [affine]
+                spilled = True
+            else:
+                routable = [affine] + [r for r in routable if r != affine]
+        return routable, spilled
+
+    def submit(
+        self,
+        prompt: str | list[int],
+        *,
+        deadline: float | None = None,
+        stream_cb: Callable[[int, str, bool], None] | None = None,
+        **kw: Any,
+    ) -> Any:
+        """Route a request to a replica; returns a Future resolving to
+        that replica's GenerationResult (annotated with ``replica_id``).
+        Mirrors ``ServingEngine.submit`` so transports can front a
+        router exactly like an engine. The deadline is the caller's
+        remaining budget in seconds; across failovers the ORIGINAL
+        absolute deadline is preserved — a re-route never resets the
+        clock."""
+        with self._req_mu:
+            self._next_rid += 1
+            rid = self._next_rid
+        deadline_abs = (
+            time.monotonic() + deadline
+            if deadline is not None and deadline > 0 else None
+        )
+        req = _RouterRequest(rid, prompt, dict(kw), stream_cb, deadline_abs)
+        candidates, spilled = self._candidates_for(prompt)
+        if not candidates:
+            with self._stats_mu:
+                self.no_replica_total += 1
+            raise ErrorServiceUnavailable(
+                "no routable replica (all draining, wedged, or down)",
+                retry_after=self.config.heartbeat_s,
+            )
+        if spilled:
+            with self._stats_mu:
+                self.spills_total += 1
+        # register BEFORE dispatching: a microsecond-fast settlement runs
+        # _settle (which pops this rid) from the attempt's done-callback —
+        # inserting afterwards would re-add a finished request that no
+        # path ever removes (a permanent leak per occurrence)
+        with self._req_mu:
+            self._requests[rid] = req
+        last_error: Exception | None = None
+        dispatched = False
+        try:
+            for replica_id in candidates:
+                try:
+                    self._submit_attempt(req, replica_id)
+                except RETRIABLE_ERRORS as exc:
+                    last_error = exc
+                    continue
+                dispatched = True
+                self._arm_hedge(req)
+                return req.future
+            # every candidate refused at admission: clean retriable
+            # error — the client (or the LB above us) decides when to
+            # come back
+            assert last_error is not None
+            raise last_error
+        finally:
+            if not dispatched:
+                # nothing owns this request: unregister it (any raise —
+                # retriable walk exhausted, deadline, non-retriable —
+                # lands here; a dispatched request is removed by _settle)
+                with self._req_mu:
+                    self._requests.pop(rid, None)
+
+    def _submit_attempt(self, req: _RouterRequest, replica_id: str) -> Any:
+        """One submission to one replica. Raises the replica's admission
+        error; the callers decide whether it is retriable (submit's
+        candidate loop / the failover path)."""
+        remaining = req.remaining()
+        if remaining is not None and remaining <= 0:
+            raise ErrorDeadlineExceeded(
+                f"request {req.rid}: deadline passed before reaching a replica"
+            )
+        with self._handles_mu:
+            handle = self._handles.get(replica_id)
+        if handle is None:
+            raise ErrorServiceUnavailable(
+                f"replica {replica_id} has no handle", retry_after=1.0
+            )
+        chaos.maybe_fail("router.route")
+        cb = self._attempt_cb(req, replica_id)
+        replica_future = handle.submit(
+            req.prompt, deadline=remaining, stream_cb=cb, **req.kw
+        )
+        with req.mu:
+            req.tried.append(replica_id)
+            req.live[replica_id] = replica_future
+        with self._stats_mu:
+            self.routed_total += 1
+            self.routes_by_replica[replica_id] = (
+                self.routes_by_replica.get(replica_id, 0) + 1
+            )
+        replica_future.add_done_callback(
+            lambda f: self._on_attempt_done(req, replica_id, f)
+        )
+        return replica_future
+
+    def _attempt_cb(self, req: _RouterRequest,
+                    replica_id: str) -> Callable[[int, str, bool], None]:
+        """Per-attempt stream wrapper: the first token claims the stream
+        for this attempt (canceling any hedge twin before IT streams);
+        only the claimed winner's tokens reach the client — exactly-once
+        on the wire, whatever the replicas do."""
+
+        def cb(token_id: int, piece: str, done: bool) -> None:
+            losers: list[tuple[str, Any]] = []
+            with req.mu:
+                if done and req.winner is None:
+                    # terminal frame of an attempt that never streamed.
+                    # The engine's failure contract settles the future
+                    # FIRST and fires the done frame after — by now the
+                    # attempt is out of req.live and the failover path
+                    # owns this request: the dead attempt's frame must
+                    # neither claim the stream nor cancel the re-route.
+                    # (Same guard covers a frame racing registration.)
+                    fut = req.live.get(replica_id)
+                    if fut is None or (
+                        fut.done() and fut.exception() is not None
+                    ):
+                        return
+                if req.winner is None:
+                    # first stream event claims the client-visible stream
+                    # for this attempt
+                    req.winner = replica_id
+                    if not done and req.first_token_at is None:
+                        req.first_token_at = time.monotonic()
+                        self._observe_ttft(
+                            req.first_token_at - req.submitted_at
+                        )
+                    losers = [
+                        (lrid, lfut) for lrid, lfut in req.live.items()
+                        if lrid != replica_id
+                    ]
+                is_winner = req.winner == replica_id
+            for lrid, lfut in losers:
+                self._cancel_attempt(lrid, lfut)
+            if is_winner and req.stream_cb is not None:
+                req.stream_cb(token_id, piece, done)
+
+        return cb
+
+    def _cancel_attempt(self, replica_id: str, replica_future: Any) -> None:
+        with self._handles_mu:
+            handle = self._handles.get(replica_id)
+        if handle is None:
+            return
+        try:
+            handle.cancel(replica_future.request_id)
+        except Exception:
+            pass  # the loser may have terminated on its own already
+
+    def _on_attempt_done(self, req: _RouterRequest, replica_id: str,
+                         replica_future: Any) -> None:
+        """Terminal event from one replica attempt. Runs on the
+        replica's settlement thread: decide, then hand any re-route to
+        the failover pool — never re-enter a (possibly wedged) replica
+        from here."""
+        with req.mu:
+            req.live.pop(replica_id, None)
+            live_others = bool(req.live)
+            winner = req.winner
+        exc = replica_future.exception()
+        if exc is None:
+            result = replica_future.result()
+            with req.mu:
+                claimed = req.winner is None or req.winner == replica_id
+                if claimed and req.winner is None:
+                    req.winner = replica_id
+            if not claimed:
+                return  # a canceled hedge loser completing: drop it
+            self._settle(req, result=result, replica_id=replica_id)
+            return
+        # failed attempt —
+        if winner == replica_id:
+            # the client-visible stream died mid-flight: this attempt
+            # claimed the stream (tokens crossed the wire), so a silent
+            # re-run would duplicate a non-idempotent stream. Clean typed
+            # error; the client holds the partial output and the retry
+            # decision. NOTE: winner identity, not a token count — a
+            # LOSING hedge twin failing while the winner streams must
+            # fall through to the live_others check below, never settle.
+            self._settle(req, error=exc, replica_id=replica_id)
+            return
+        if live_others:
+            return  # the hedge twin is still running: it IS the failover
+        if not isinstance(exc, RETRIABLE_ERRORS):
+            self._settle(req, error=exc, replica_id=replica_id)
+            return
+        remaining = req.remaining()
+        if remaining is not None and remaining <= 0:
+            self._settle(
+                req,
+                error=ErrorDeadlineExceeded(
+                    f"request {req.rid}: deadline passed during failover"
+                ),
+                replica_id=replica_id,
+            )
+            return
+        if req.failovers >= self.config.max_failovers or req.canceled:
+            self._settle(req, error=exc, replica_id=replica_id)
+            return
+        req.failovers += 1
+        with self._stats_mu:
+            self.failovers_total += 1
+        if self._metrics is not None:
+            self._metrics.increment_counter("app_router_failovers_total")
+        try:
+            self._failover_pool.submit(self._failover, req, exc)
+        except RuntimeError:
+            # router stopped between the failure and the re-route: the
+            # client must still get its terminal — never a stranded future
+            self._settle(req, error=exc, replica_id=replica_id)
+
+    def _failover(self, req: _RouterRequest, cause: Exception) -> None:
+        """Re-route after a pre-first-token replica failure: next
+        candidate, original absolute deadline, tried replicas excluded
+        (a replica that just failed this request does not get it back
+        before the untried ones)."""
+        try:
+            candidates, _ = self._candidates_for(req.prompt)
+            with req.mu:
+                tried = set(req.tried)
+            ordered = [c for c in candidates if c not in tried] or candidates
+            last_error: Exception = cause
+            for replica_id in ordered:
+                try:
+                    self._submit_attempt(req, replica_id)
+                    return
+                except RETRIABLE_ERRORS as exc:
+                    last_error = exc
+                    continue
+                except ErrorDeadlineExceeded as exc:
+                    self._settle(req, error=exc, replica_id=None)
+                    return
+            self._settle(req, error=last_error, replica_id=None)
+        # gofrlint: disable=router-retry-untyped -- no retry happens here:
+        # an unexpected raise (a handle whose pool was closed mid-shutdown
+        # raises RuntimeError) would vanish into the failover pool and
+        # strand the client future forever; settle it instead
+        except BaseException as exc:
+            self._settle(req, error=exc, replica_id=None)
+
+    # -- hedging ---------------------------------------------------------------
+    def hedge_delay(self) -> float:
+        """The armed hedge delay: the configured floor, raised to the
+        observed TTFT p99 once enough samples exist (hedging inside
+        normal first-token latency doubles prefill load for nothing)."""
+        base = self.config.hedge_delay_s
+        if base <= 0:
+            return 0.0
+        if not self.config.hedge_from_p99:
+            return base
+        with self._ttft_mu:
+            n = len(self._ttfts)
+            if n < 20:
+                return base
+            ordered = sorted(self._ttfts)
+        return max(base, ordered[min(int(0.99 * n), n - 1)])
+
+    def _observe_ttft(self, seconds: float) -> None:
+        with self._ttft_mu:
+            self._ttfts.append(seconds)
+            if len(self._ttfts) > 256:
+                del self._ttfts[: len(self._ttfts) - 256]
+
+    def _arm_hedge(self, req: _RouterRequest) -> None:
+        delay = self.hedge_delay()
+        if delay <= 0:
+            return
+
+        def fire() -> None:
+            try:
+                self._failover_pool.submit(self._hedge, req)
+            except RuntimeError:
+                pass  # router stopped: the primary attempt stands alone
+
+        timer = threading.Timer(delay, fire)
+        timer.daemon = True
+        req.hedge_timer = timer
+        timer.start()
+
+    def _hedge(self, req: _RouterRequest) -> None:
+        """Hedge the prefill admission: when the first token still
+        hasn't arrived, admit the same request on the next-best replica.
+        First token wins; the loser is canceled before it streams. The
+        hedge twin inherits the ORIGINAL deadline, like any re-route."""
+        with req.mu:
+            if (
+                req.first_token_at is not None
+                or req.future.done()
+                or req.canceled
+                or not req.live
+            ):
+                return
+            tried = set(req.tried)
+        candidates, _ = self._candidates_for(req.prompt)
+        for replica_id in candidates:
+            if replica_id in tried:
+                continue
+            try:
+                self._submit_attempt(req, replica_id)
+            except RETRIABLE_ERRORS:
+                continue
+            except ErrorDeadlineExceeded:
+                return
+            with self._stats_mu:
+                self.hedges_total += 1
+            if self._metrics is not None:
+                self._metrics.increment_counter("app_router_hedges_total")
+            return
+
+    # -- settlement ------------------------------------------------------------
+    def _settle(self, req: _RouterRequest, *, result: Any = None,
+                error: Exception | None = None,
+                replica_id: str | None = None) -> None:
+        """Resolve the client future exactly once; cancel the hedge
+        timer and any still-live twin attempts."""
+        with req.mu:
+            if req.future.done():
+                return
+            timer = req.hedge_timer
+            req.hedge_timer = None
+            leftovers = list(req.live.items())
+            req.live = {}
+        if timer is not None:
+            timer.cancel()
+        for lrid, lfut in leftovers:
+            self._cancel_attempt(lrid, lfut)
+        with self._req_mu:
+            self._requests.pop(req.rid, None)
+        if error is not None:
+            req.future.set_exception(error)
+            return
+        if result is not None and replica_id is not None:
+            try:
+                result.replica_id = replica_id  # terminal attribution
+            except Exception:
+                pass  # frozen/slotted result types keep working unlabeled
+        req.future.set_result(result)
+
+    def cancel(self, request_id: int) -> None:
+        """Cancel a routed request on every replica it is live on."""
+        with self._req_mu:
+            req = self._requests.get(request_id)
+        if req is None:
+            return
+        with req.mu:
+            req.canceled = True
+            live = list(req.live.items())
+        for replica_id, replica_future in live:
+            self._cancel_attempt(replica_id, replica_future)
+
+    # -- observability ---------------------------------------------------------
+    def health_check(self) -> dict[str, Any]:
+        routable = self.membership.candidates()
+        if not routable:
+            status = "DOWN"
+        elif any(
+            self.membership.state_of(rid) == ms.UP for rid in routable
+        ):
+            status = "UP"
+        else:
+            # only SUSPECT (last-resort) candidates: routing is
+            # best-effort — loud in health, not a soothing UP
+            status = "DEGRADED"
+        return {
+            "status": status,
+            "details": {
+                "replicas": self.membership.snapshot(),
+                "routable": routable,
+                "routed_total": self.routed_total,
+                "failovers_total": self.failovers_total,
+                "hedges_total": self.hedges_total,
+            },
+        }
+
+    def _counters(self) -> dict[str, Any]:
+        with self._stats_mu:
+            return {
+                "routed_total": self.routed_total,
+                "failovers_total": self.failovers_total,
+                "hedges_total": self.hedges_total,
+                "spills_total": self.spills_total,
+                "no_replica_total": self.no_replica_total,
+                "routes_by_replica": dict(self.routes_by_replica),
+            }
+
+    def routerz(self) -> dict[str, Any]:
+        """The ``/routerz`` health view: membership, routing counters,
+        and the live knob values — everything an operator needs to see
+        why a request went where it went."""
+        return {
+            "replicas": self.membership.snapshot(),
+            "routable": self.membership.candidates(),
+            "aggregate_queue_wait_s": round(
+                self.membership.aggregate_queue_wait(), 4
+            ),
+            "counters": self._counters(),
+            "config": {
+                "heartbeat_s": self.config.heartbeat_s,
+                "suspect_after_s": self.config.suspect_after_s,
+                "down_after_s": self.config.down_after_s,
+                "spill_wait_s": self.config.spill_wait_s,
+                "affinity_prefix_tokens": self.config.affinity_prefix_tokens,
+                "vnodes": self.config.vnodes,
+                "max_failovers": self.config.max_failovers,
+                "hedge_delay_s": self.config.hedge_delay_s,
+                "hedge_delay_armed_s": round(self.hedge_delay(), 4),
+            },
+        }

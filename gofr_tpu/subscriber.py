@@ -280,8 +280,15 @@ class SubscriptionManager:
                     )
                     await self._settle_failure(consumer, msg, record)
                     return
-                if not await self._commit(consumer, msg, record,
-                                          success_metric=True):
+                # the settle is ATOMIC w.r.t. cancellation: stop() racing
+                # this commit used to sever the broker ack (which completes
+                # in the executor regardless) from its bookkeeping — the
+                # attempt record leaked and success metrics went uncounted
+                # for an acked message (the test_transient_failure flake:
+                # drain_until returns the instant the handler appends, so
+                # stop() lands exactly inside this await)
+                if not await self._run_to_settlement(self._commit(
+                        consumer, msg, record, success_metric=True)):
                     # the broker will redeliver and the handler will run
                     # again — pace it like any failed attempt, never a
                     # zero-backoff hot loop
@@ -290,6 +297,24 @@ class SubscriptionManager:
             raise
         except Exception as exc:
             container.logger.error(f"subscriber loop error for {topic}: {exc}")
+
+    @staticmethod
+    async def _run_to_settlement(coro: Any) -> Any:
+        """Run a settlement step (commit + its bookkeeping) to completion
+        even when the awaiting consumer task is cancelled mid-flight.
+
+        The broker ack runs in the executor and completes whether or not
+        the await survives; honoring the cancel immediately would sever
+        the ack from the prune/metric bookkeeping that must land with it.
+        ``shield`` keeps the inner step alive; on cancellation we ride it
+        out (settlement is bounded: one broker ack, no backoff waits)
+        and THEN re-raise so the loop still unwinds promptly."""
+        task = asyncio.ensure_future(coro)
+        try:
+            return await asyncio.shield(task)
+        except asyncio.CancelledError:
+            await task
+            raise
 
     @staticmethod
     def _key_of(topic: str, msg: Any) -> tuple:

@@ -9,6 +9,7 @@ central fake backend for handler tests.
 from gofr_tpu.testutil.ports import get_free_port, new_server_configs
 from gofr_tpu.testutil.capture import stdout_output_for_func, stderr_output_for_func
 from gofr_tpu.testutil.mock_container import MockContainer, new_mock_container
+from gofr_tpu.testutil.replica import StubReplicaEngine, StubResult
 
 __all__ = [
     "get_free_port",
@@ -17,4 +18,6 @@ __all__ = [
     "stderr_output_for_func",
     "MockContainer",
     "new_mock_container",
+    "StubReplicaEngine",
+    "StubResult",
 ]

@@ -396,6 +396,91 @@ def test_method_reference_handler_flagged(tmp_path):
     assert [f.rule for f in findings] == ["pubsub-manual-settle"]
 
 
+# -------------------------------------------------- router retry typing
+def test_router_retry_broad_except_flagged(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/serving/router.py": (
+            "def _failover(self, req):\n"
+            "    try:\n"
+            "        self._submit_attempt(req, 'r2')\n"
+            "    except Exception:\n"
+            "        pass\n"
+        ),
+    })
+    assert [f.rule for f in findings] == ["router-retry-untyped"]
+    assert "Exception" in findings[0].message
+
+
+def test_router_retry_bare_except_flagged(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/serving/router.py": (
+            "def submit(self, prompt):\n"
+            "    for rid in ('a', 'b'):\n"
+            "        try:\n"
+            "            return self._submit_attempt(prompt, rid)\n"
+            "        except:\n"
+            "            continue\n"
+        ),
+    })
+    assert [f.rule for f in findings] == ["router-retry-untyped"]
+
+
+def test_router_retry_unlisted_type_in_tuple_flagged(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/serving/router.py": (
+            "def _hedge(self, req):\n"
+            "    try:\n"
+            "        self._submit_attempt(req, 'r2')\n"
+            "    except (ErrorServiceUnavailable, ValueError):\n"
+            "        pass\n"
+        ),
+    })
+    assert [f.rule for f in findings] == ["router-retry-untyped"]
+    assert "ValueError" in findings[0].message
+
+
+def test_router_retry_typed_set_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/serving/router.py": (
+            "def submit(self, prompt):\n"
+            "    try:\n"
+            "        return self._submit_attempt(prompt, 'a')\n"
+            "    except RETRIABLE_ERRORS as exc:\n"
+            "        raise exc\n"
+            "def _failover(self, req):\n"
+            "    try:\n"
+            "        self._submit_attempt(req, 'b')\n"
+            "    except (ErrorServiceUnavailable, ChaosFault):\n"
+            "        pass\n"
+            "    except ErrorDeadlineExceeded:\n"
+            "        pass\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_router_retry_rule_scopes_to_zone_functions(tmp_path):
+    """A broad catch OUTSIDE the retry-zone functions (settlement,
+    membership loops) is legitimate defensive code — not flagged."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/serving/router.py": (
+            "def _settle(self, req):\n"
+            "    try:\n"
+            "        req.future.set_result(1)\n"
+            "    except Exception:\n"
+            "        pass\n"
+        ),
+        "gofr_tpu/serving/other.py": (
+            "def submit(self, prompt):\n"
+            "    try:\n"
+            "        return 1\n"
+            "    except Exception:\n"
+            "        pass\n"
+        ),
+    })
+    assert findings == []
+
+
 # ------------------------------------------------- daemon loop heartbeat
 def test_daemon_while_true_without_check_flagged(tmp_path):
     findings = lint_tree(tmp_path, {

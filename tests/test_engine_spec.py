@@ -65,11 +65,14 @@ def test_spec_sampled_rows_take_plain_steps():
     """temperature > 0 rows are not drafted for (greedy verification
     would bias sampling); they still decode correctly through the chunk
     executable, taking PLAIN single-token steps (one committed token per
-    verify dispatch) under the packed-step contract. seed=1: at seed 0
-    the very first prefill-sampled token is EOS, so the row retires
-    before ever reaching a spec step and the test exercises nothing."""
+    verify dispatch) under the packed-step contract.
+
+    Prefill first-token sampling is keyed fold_in(PRNGKey(seed), rid) —
+    independent of admission/decode interleave (the in-suite flake fix,
+    engine._rng_root) — and at seed 0 / rid 1 the draw is NOT EOS, so
+    the row reaches its spec steps."""
     res, stats = run_engine(6, "dense", "bf16", REPETITIVE, 12,
-                            temperature=0.8, seed=1)
+                            temperature=0.8, seed=0)
     assert res.completion_tokens == len(res.token_ids)
     assert res.completion_tokens >= 1
     assert stats["accepted"] == 0  # no drafts for sampled rows

@@ -10,6 +10,7 @@ describing the service.
 
 import asyncio
 import importlib.util
+import shutil
 import sys
 
 import grpc
@@ -19,6 +20,15 @@ from gofr_tpu.config import MapConfig
 from gofr_tpu.grpcx import GRPCServer
 from gofr_tpu.grpcx.codegen import generate, load_input
 from gofr_tpu.testutil import get_free_port, new_mock_container
+
+# codegen shells out to the system protoc (descriptor-set compile); in
+# images without it the whole module is a clean environment-capability
+# skip at collection, not four fixture errors — mirrors the
+# `cryptography` gating in tests/test_sftp.py
+pytestmark = pytest.mark.skipif(
+    shutil.which("protoc") is None,
+    reason="needs the system protoc binary for gRPC codegen",
+)
 
 CHAT_PROTO = """
 syntax = "proto3";

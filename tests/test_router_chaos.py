@@ -1,0 +1,367 @@
+"""The router-plane chaos tier (``make chaos``, docs/robustness.md "The
+router plane").
+
+Fixed-seed fault schedules over a ≥2-replica in-process tier — stub
+replicas (gofr_tpu/testutil/replica.py) fronted by the real Router,
+real ReplicaAnnouncers and the real InMemoryBroker heartbeat path —
+driving three failure archetypes per seed:
+
+- **replica-kill**: a replica dies abruptly mid-workload (in-flight
+  requests fail with the PR 5 warm-restart 503 contract, its announcer
+  goes silent like a dead process does);
+- **replica-wedge**: a replica stops making progress but keeps
+  heartbeating its WEDGED supervisor state;
+- **heartbeat-partition**: the ``router.heartbeat`` chaos point drops
+  beats tier-wide while every replica keeps serving.
+
+The invariant asserted after every scenario:
+
+    every accepted request reaches exactly ONE terminal state on exactly
+    one replica, within its deadline or with a typed retriable error —
+    zero lost requests, zero double-settlements, zero new routes to
+    DRAINING/WEDGED replicas.
+
+Seeds are FIXED (101/202/303, the chaos-tier convention): a red run
+reproduces with ``pytest tests/test_router_chaos.py -k <seed>``. Add
+seeds, never rotate them.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from gofr_tpu import chaos
+from gofr_tpu.datasource.pubsub import InMemoryBroker
+from gofr_tpu.http.errors import (
+    ErrorDeadlineExceeded,
+    ErrorServiceUnavailable,
+    ErrorTooManyRequests,
+)
+from gofr_tpu.serving.membership import (
+    DRAINING,
+    UP,
+    WEDGED,
+    ReplicaAnnouncer,
+)
+from gofr_tpu.serving.router import (
+    RETRIABLE_ERRORS,
+    LocalReplica,
+    Router,
+    RouterConfig,
+)
+from gofr_tpu.testutil.replica import StubReplicaEngine
+
+CHAOS_SEEDS = (101, 202, 303)
+N_REQUESTS = 24
+N_PREFIXES = 6
+DEADLINE_S = 8.0
+HEARTBEAT_S = 0.03
+
+
+class _Tier:
+    """≥2 stub replicas + announcers + broker + router, wired the way
+    production is: heartbeats over pubsub, handles registered up front."""
+
+    def __init__(self, n_replicas: int = 3, *, seed: int = 0,
+                 down_after_beats: int = 15, **stub_kw) -> None:
+        self.broker = InMemoryBroker(consumer_group="router")
+        self.stubs = [
+            StubReplicaEngine(
+                f"rep-{i}",
+                tokens=stub_kw.get("tokens", 5),
+                token_interval_s=stub_kw.get("token_interval_s", 0.01),
+                first_token_delay_s=stub_kw.get("first_token_delay_s", 0.01),
+                supervisor_detect_s=stub_kw.get("supervisor_detect_s", 0.08),
+            )
+            for i in range(n_replicas)
+        ]
+        self.announcers = [
+            ReplicaAnnouncer(s.replica_id, s, self.broker,
+                             interval_s=HEARTBEAT_S)
+            for s in self.stubs
+        ]
+        self.router = Router(
+            RouterConfig(
+                heartbeat_s=HEARTBEAT_S,
+                suspect_after_s=6 * HEARTBEAT_S,
+                down_after_s=down_after_beats * HEARTBEAT_S,
+                max_failovers=3,
+            ),
+            broker=self.broker,
+        )
+        for stub in self.stubs:
+            self.router.add_replica(LocalReplica(stub.replica_id, stub))
+
+    def start(self) -> None:
+        self.router.start()
+        for announcer in self.announcers:
+            announcer.start()
+        # wait until every replica is routable (first beats landed)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if len(self.router.membership.candidates()) == len(self.stubs):
+                return
+            time.sleep(0.005)
+        raise AssertionError("tier never became fully routable")
+
+    def stop(self) -> None:
+        for announcer in self.announcers:
+            announcer.stop(final_beat=False)
+        self.router.stop()
+
+    def stub(self, replica_id: str) -> StubReplicaEngine:
+        return next(s for s in self.stubs if s.replica_id == replica_id)
+
+    def announcer(self, replica_id: str) -> ReplicaAnnouncer:
+        return next(
+            a for a in self.announcers if a.replica_id == replica_id
+        )
+
+
+def _submit_workload(tier: _Tier, n: int, start_idx: int = 0):
+    """Submit ``n`` requests across the prefix set; returns
+    [(prompt, future-or-admission-error)]. An admission-time rejection
+    must itself be a typed retriable error — anything else violates the
+    accepted-or-clean-error contract."""
+    out = []
+    for i in range(start_idx, start_idx + n):
+        prompt = f"prefix-{i % N_PREFIXES} | request {i}"
+        try:
+            fut = tier.router.submit(prompt, deadline=DEADLINE_S)
+        except Exception as exc:  # noqa: BLE001 - the assertion IS the contract
+            assert isinstance(exc, RETRIABLE_ERRORS), (
+                f"admission rejection must be typed-retriable, got {exc!r}"
+            )
+            out.append((prompt, exc))
+            continue
+        out.append((prompt, fut))
+    return out
+
+
+def _assert_invariant(tier: _Tier, accepted) -> dict[str, int]:
+    """The router-plane lifecycle invariant over every accepted request:
+    exactly one terminal state, on exactly one replica, within the
+    deadline or with a typed retriable error."""
+    outcomes = {"ok": 0, "retriable": 0, "deadline": 0}
+    for prompt, fut in accepted:
+        if isinstance(fut, Exception):
+            outcomes["retriable"] += 1  # already checked typed-retriable
+            continue
+        # zero lost requests: every accepted future terminates promptly
+        try:
+            result = fut.result(timeout=DEADLINE_S + 5.0)
+        except ErrorDeadlineExceeded:
+            outcomes["deadline"] += 1
+            continue
+        except Exception as exc:  # noqa: BLE001 - the assertion IS the contract
+            assert isinstance(exc, RETRIABLE_ERRORS), (
+                f"{prompt}: terminal error must be typed-retriable, "
+                f"got {exc!r}"
+            )
+            outcomes["retriable"] += 1
+            continue
+        # terminal on exactly one replica, attributed
+        assert getattr(result, "replica_id", None), (
+            f"{prompt}: result lacks replica attribution"
+        )
+        serving_stub = tier.stub(result.replica_id)
+        assert serving_stub.terminals.get(result.request_id) is not None, (
+            f"{prompt}: winning replica has no terminal record"
+        )
+        if result.finish_reason == "deadline_exceeded":
+            outcomes["deadline"] += 1
+        else:
+            outcomes["ok"] += 1
+    # exactly-one terminal state per stub-side request, tier-wide
+    for stub in tier.stubs:
+        assert stub.double_terminals == [], (
+            f"{stub.replica_id}: double settlement {stub.double_terminals}"
+        )
+    return outcomes
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_replica_kill_mid_workload(seed):
+    """Kill one replica (announcer silenced like a dead process) while
+    requests are in flight and keep submitting: nothing is lost, the
+    dead replica's share re-routes or fails retriable, and once the
+    down timer fires the victim receives zero new routes."""
+    tier = _Tier(n_replicas=3, seed=seed)
+    tier.start()
+    try:
+        accepted = _submit_workload(tier, N_REQUESTS // 2)
+        victim = tier.router.membership.candidates()[0]
+        victim_stub = tier.stub(victim)
+        tier.announcer(victim).stop(final_beat=False)  # dies silent
+        victim_stub.kill()
+        accepted += _submit_workload(
+            tier, N_REQUESTS // 2, start_idx=N_REQUESTS // 2
+        )
+        outcomes = _assert_invariant(tier, accepted)
+        assert outcomes["ok"] > 0  # the tier kept serving
+        # the victim goes DOWN on silence; zero new routes once DOWN
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if victim not in tier.router.membership.candidates():
+                break
+            time.sleep(0.01)
+        assert victim not in tier.router.membership.candidates()
+        before = len(victim_stub.submissions)
+        _assert_invariant(tier, _submit_workload(tier, 6, start_idx=100))
+        assert len(victim_stub.submissions) == before, (
+            "a DOWN replica received new routes"
+        )
+    finally:
+        tier.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_replica_wedge_mid_workload(seed):
+    """Wedge one replica mid-workload: it keeps heartbeating (now
+    WEDGED), its in-flight requests fail retriable once the simulated
+    supervisor detects the wedge, and the router sends it ZERO new
+    routes from the moment the WEDGED beat lands."""
+    tier = _Tier(n_replicas=3, seed=seed)
+    tier.start()
+    try:
+        accepted = _submit_workload(tier, N_REQUESTS // 2)
+        victim = tier.router.membership.candidates()[0]
+        victim_stub = tier.stub(victim)
+        victim_stub.wedge()
+        # wait for the WEDGED beat to reach the router
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if tier.router.membership.state_of(victim) == WEDGED:
+                break
+            time.sleep(0.005)
+        assert tier.router.membership.state_of(victim) == WEDGED
+        routed_before = len(victim_stub.submissions)
+        accepted += _submit_workload(
+            tier, N_REQUESTS // 2, start_idx=N_REQUESTS // 2
+        )
+        outcomes = _assert_invariant(tier, accepted)
+        assert outcomes["ok"] > 0
+        assert len(victim_stub.submissions) == routed_before, (
+            "a WEDGED replica received new routes"
+        )
+        assert victim not in tier.router.membership.candidates()
+    finally:
+        tier.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_heartbeat_partition_mid_workload(seed):
+    """Drop heartbeats tier-wide at the ``router.heartbeat`` chaos point
+    while every replica keeps serving: replicas drift to SUSPECT, the
+    router degrades to best-effort routing (SUSPECT as last resort — a
+    control-plane partition must NOT become a data-plane outage), and
+    when the injector budget runs out the beats resume and the tier
+    heals back to UP."""
+    # down_after far past the partition span: a CONTROL-plane blip must
+    # park replicas at SUSPECT (still routable as last resort), not DOWN
+    tier = _Tier(n_replicas=2, seed=seed, down_after_beats=120)
+    tier.start()
+    try:
+        with chaos.active(chaos.ChaosInjector(
+            seed, {"router.heartbeat": 1.0}, max_faults=30,
+        )):
+            accepted = _submit_workload(tier, N_REQUESTS // 2)
+            # the partition starves membership into SUSPECT
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                states = {
+                    rid: tier.router.membership.state_of(rid)
+                    for rid in ("rep-0", "rep-1")
+                }
+                if all(s != UP for s in states.values()):
+                    break
+                time.sleep(0.01)
+            # data plane unaffected: requests still route (last resort)
+            accepted += _submit_workload(
+                tier, N_REQUESTS // 2, start_idx=N_REQUESTS // 2
+            )
+            outcomes = _assert_invariant(tier, accepted)
+            assert outcomes["ok"] == len(accepted), (
+                "a heartbeat partition must not fail data-plane requests"
+            )
+        # budget spent: beats resume, the tier heals to UP
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if (tier.router.membership.state_of("rep-0") == UP
+                    and tier.router.membership.state_of("rep-1") == UP):
+                break
+            time.sleep(0.01)
+        assert tier.router.membership.state_of("rep-0") == UP
+        assert tier.router.membership.state_of("rep-1") == UP
+    finally:
+        tier.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_route_faults_force_failovers_under_schedule(seed):
+    """The ``router.route`` chaos point fails submissions at the
+    transport seam under a seeded schedule: every fault either walks to
+    the next candidate in-line or fails over — the invariant holds with
+    zero lost requests and the failover counter matches the router's
+    own accounting."""
+    tier = _Tier(n_replicas=3, seed=seed)
+    tier.start()
+    try:
+        with chaos.active(chaos.ChaosInjector(
+            seed, {"router.route": 0.25}, max_faults=8,
+        )):
+            accepted = _submit_workload(tier, N_REQUESTS)
+            outcomes = _assert_invariant(tier, accepted)
+        assert outcomes["ok"] > 0
+        assert outcomes["ok"] + outcomes["retriable"] + outcomes["deadline"] \
+            == len(accepted)
+    finally:
+        tier.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_draining_replica_quiesces_cleanly():
+    """DRAINING is the graceful twin of kill: announced over the
+    heartbeat path, in-flight streams finish on the draining replica,
+    zero new routes reach it."""
+    tier = _Tier(n_replicas=2, tokens=20, token_interval_s=0.02)
+    tier.start()
+    try:
+        victim = tier.router.membership.candidates()[0]
+        victim_stub = tier.stub(victim)
+        # park a long stream on the victim then drain it
+        prompts = [f"prefix-{i} | drain" for i in range(8)]
+        futs = [
+            tier.router.submit(p, deadline=DEADLINE_S) for p in prompts
+        ]
+        time.sleep(0.05)
+        victim_stub.drain()
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if tier.router.membership.state_of(victim) == DRAINING:
+                break
+            time.sleep(0.005)
+        assert tier.router.membership.state_of(victim) == DRAINING
+        routed_before = len(victim_stub.submissions)
+        post = _submit_workload(tier, 8, start_idx=50)
+        # in-flight streams on the draining replica run to completion
+        for fut in futs:
+            result = fut.result(timeout=DEADLINE_S + 5.0)
+            assert result.finish_reason in ("length", "stop")
+        _assert_invariant(tier, post)
+        assert len(victim_stub.submissions) == routed_before, (
+            "a DRAINING replica received new routes"
+        )
+    finally:
+        tier.stop()
