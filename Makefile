@@ -17,7 +17,9 @@ check: import-check lint test native-asan bench-smoke
 # fail-fast — a broken analyzer surfaces in ~30 s, not after the ~15 min
 # full suite.
 ci: lint bench-check
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_analysis.py tests/test_shardcheck.py -q
+	$(PY) -m gofr_tpu.analysis --chaos-coverage
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_analysis.py tests/test_shardcheck.py tests/test_lockcheck.py -q -m 'not slow' \
+	  --deselect tests/test_lockcheck.py::test_runtime_graph_is_subgraph_of_static
 	$(MAKE) chaos
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
 	@echo "CI OK"
@@ -44,16 +46,25 @@ chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py tests/test_supervisor.py tests/test_pubsub_chaos.py tests/test_router_chaos.py -q -m chaos
 
 # gofrlint (docs/static-analysis.md): framework-invariant AST lints over
-# the whole package + the extern-C vs ctypes FFI signature cross-check.
-# Exits non-zero on any unsuppressed finding.
+# the whole package (incl. the lockcheck concurrency families) + the
+# extern-C vs ctypes FFI signature cross-check, then the
+# stale-suppression audit (a suppression matching no raw finding fails —
+# rules drift, code moves). Exits non-zero on any unsuppressed finding.
 lint:
 	$(PY) -m gofr_tpu.analysis gofr_tpu/
+	$(PY) -m gofr_tpu.analysis --check-suppressions
 
 # lock-order tier: run the concurrency tests with every Python lock
 # instrumented; any cyclic acquisition order (potential deadlock) fails.
+# The observed acquisition graph is exported for the static cross-check
+# (docs/static-analysis.md "Static ↔ runtime cross-check"): every
+# runtime edge must already be in `python -m gofr_tpu.analysis
+# --lock-graph`'s static graph.
 lock-order:
-	GOFR_LOCK_ORDER=1 JAX_PLATFORMS=cpu \
+	GOFR_LOCK_ORDER=1 GOFR_LOCK_ORDER_EXPORT=$(CURDIR)/.gofr_lock_graph.json \
+	JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/test_native_concurrency.py tests/test_engine_recovery.py -q -x
+	$(PY) -m gofr_tpu.analysis --check-lock-graph $(CURDIR)/.gofr_lock_graph.json
 
 import-check:
 	$(PY) -c "import compileall,sys; sys.exit(0 if compileall.compile_dir('gofr_tpu', quiet=2) else 1)"

@@ -24,10 +24,24 @@ C++ TUs already run under ASan/UBSan/TSan (``make native-asan`` /
   symbol in ``native/`` against the ctypes ``argtypes``/``restype``
   declarations (drift here is a memory-corruption bug ASan only catches
   at runtime).
+- :mod:`gofr_tpu.analysis.lockcheck` — whole-program concurrency
+  analysis over the threaded control plane: the static lock-acquisition
+  graph with cycle detection (``lock-order-static``), blocking ops under
+  a held lock (``hold-and-block``), and guarded-by inference for
+  cross-thread attribute writes (``guarded-by``); exports the static
+  graph (``--lock-graph``) that the runtime tier's observed graph is
+  asserted a subgraph of.
+- :mod:`gofr_tpu.analysis.audit` — the stale-suppression audit
+  (``--check-suppressions``): inline suppressions that match no raw
+  finding fail CI instead of silently swallowing the next real one.
+- :mod:`gofr_tpu.analysis.chaoscov` — chaos-coverage check
+  (``--chaos-coverage``): every injection point registered in
+  ``gofr_tpu/chaos/injector.py`` must be exercised by a ``make chaos``
+  test file.
 - :mod:`gofr_tpu.analysis.lockorder` — a runtime shim that records
   Python-side lock-acquisition ordering during the concurrency tests and
   fails on cycles (``make lock-order``), complementing the C++-only TSan
-  tier.
+  tier; exports the observed graph for the static cross-check.
 
 Run ``python -m gofr_tpu.analysis`` (or ``make lint``); it exits non-zero
 on any unsuppressed finding. Suppress with

@@ -46,6 +46,10 @@ def _list_rules() -> None:
     print("collective-unmapped  literal-axis collective outside shard_map/pmap")
     print("use-after-donation   donated jit buffer read before rebinding")
     print("retrace-hazard       per-request recompiles in the decode hot path")
+    print("lock-order-static    cycle in the whole-program lock graph")
+    print("hold-and-block       blocking op executed while a lock is held")
+    print("guarded-by           write skips the attribute's inferred guard")
+    print("stale-suppression    suppression matching no current finding")
     print("bad-suppression      gofrlint suppression without a reason")
     print()
     print("dispatch zones:", ", ".join(sorted(rules_mod.DISPATCH_ZONES)))
@@ -96,6 +100,27 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true", help="list rules and exit"
     )
+    parser.add_argument(
+        "--check-suppressions", action="store_true",
+        help="stale-suppression audit: fail on any inline suppression "
+        "that matches no raw finding (rules drift, code moves)",
+    )
+    parser.add_argument(
+        "--chaos-coverage", action="store_true",
+        help="assert every registered chaos injection point is exercised "
+        "by a test file in the make-chaos tier (JSON output)",
+    )
+    parser.add_argument(
+        "--lock-graph", action="store_true",
+        help="emit lockcheck's static lock-acquisition graph as JSON (the "
+        "runtime GOFR_LOCK_ORDER tier's observed graph must be a subgraph)",
+    )
+    parser.add_argument(
+        "--check-lock-graph", metavar="PATH", default=None,
+        help="verify a runtime graph exported by the GOFR_LOCK_ORDER tier "
+        "(GOFR_LOCK_ORDER_EXPORT) is a subgraph of the static graph; "
+        "`make lock-order` runs this on its export",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -103,6 +128,101 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     repo_root = args.repo_root or _default_repo_root()
+
+    if args.chaos_coverage:
+        import json as _json
+
+        from gofr_tpu.analysis.chaoscov import check_chaos_coverage
+
+        report = check_chaos_coverage(repo_root)
+        print(_json.dumps(report, indent=2))
+        if report["missing"]:
+            print(
+                f"chaoscov: {len(report['missing'])} chaos point(s) not "
+                f"exercised by any make-chaos test: {report['missing']} — "
+                "add a fault schedule or remove the dead injection point",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+
+    if args.lock_graph or args.check_lock_graph:
+        # same path validation as the lint modes: a typo'd directory must
+        # be a usage error, not an empty graph that vacuously verifies
+        paths = args.paths or [os.path.join(repo_root, "gofr_tpu")]
+        for p in paths:
+            if not os.path.exists(p):
+                print(f"error: no such path: {p}", file=sys.stderr)
+                return 2
+
+    if args.lock_graph:
+        from gofr_tpu.analysis.lockcheck import (
+            build_static_graph,
+            render_graph_json,
+        )
+
+        print(render_graph_json(build_static_graph(paths)))
+        return 0
+
+    if args.check_lock_graph:
+        import json as _json
+
+        from gofr_tpu.analysis.lockcheck import (
+            build_static_graph,
+            check_subgraph,
+        )
+
+        try:
+            with open(args.check_lock_graph, encoding="utf-8") as fp:
+                runtime = _json.load(fp)
+        except (OSError, ValueError) as exc:
+            print(
+                f"error: cannot read runtime lock graph "
+                f"{args.check_lock_graph}: {exc}",
+                file=sys.stderr,
+            )
+            return 2
+        divergences = check_subgraph(runtime, build_static_graph(paths))
+        for d in divergences:
+            print(d)
+        if divergences:
+            print(
+                f"lockcheck: {len(divergences)} runtime edge(s) missing "
+                "from the static graph — analyzer blind spot "
+                "(docs/static-analysis.md#static--runtime-cross-check)",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"lockcheck: runtime graph is a subgraph of the static graph "
+            f"({len(runtime.get('edges', []))} observed edge(s) checked)"
+        )
+        return 0
+
+    if args.check_suppressions:
+        from gofr_tpu.analysis import baseline_io as bio
+        from gofr_tpu.analysis.audit import stale_suppressions
+
+        paths = args.paths or [os.path.join(repo_root, "gofr_tpu")]
+        for p in paths:
+            if not os.path.exists(p):
+                print(f"error: no such path: {p}", file=sys.stderr)
+                return 2
+        stale = stale_suppressions(paths)
+        if args.format == "json":
+            print(bio.render_json(stale))
+            return 1 if stale else 0
+        for f in stale:
+            print(f.render())
+        if stale:
+            print(
+                f"\ngofrlint: {len(stale)} stale suppression(s) — delete "
+                "them (docs/static-analysis.md#stale-suppressions).",
+                file=sys.stderr,
+            )
+            return 1
+        print("gofrlint: suppressions all live")
+        return 0
     findings = []
     paths: list[str] = []
     if not args.ffi_only:

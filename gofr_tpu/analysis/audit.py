@@ -1,0 +1,72 @@
+"""Stale-suppression audit (``--check-suppressions``).
+
+A suppression is a claim: "this line triggers rule R, and here is why
+that is safe." Rules drift, code moves, fixes land — and the claim goes
+stale: the comment suppresses nothing but still reads like an active,
+justified exemption. Worse, a stale suppression on a line that later
+REGAINS the finding silently swallows the new, unreviewed instance.
+
+The audit runs every AST rule with inline suppressions ignored (the raw
+finding set) and then checks each well-formed suppression comment
+against it: a suppression none of whose covered lines carries a raw
+finding for any of its named rules is reported as ``stale-suppression``
+and fails CI. Delete it (or fix the rule drift it exposes).
+
+Scope: AST-rule suppressions only — the FFI cross-checker and the
+ratchet baseline have their own lifecycles (`--update-baseline` ratchets
+the baseline; FFI findings have no inline-suppression form).
+"""
+
+from __future__ import annotations
+
+from gofr_tpu.analysis.core import (
+    Finding,
+    iter_python_files,
+    iter_suppression_records,
+    run_rules,
+)
+
+
+def stale_suppressions(paths: list[str]) -> list[Finding]:
+    """Return a ``stale-suppression`` finding for every inline
+    suppression under ``paths`` that matches no raw finding."""
+    import os
+
+    from gofr_tpu.analysis.rules import default_rules
+
+    raw = run_rules(paths, default_rules(), honor_suppressions=False)
+    hits: dict[str, dict[int, set[str]]] = {}
+    for f in raw:
+        hits.setdefault(f.path, {}).setdefault(f.line, set()).add(f.rule)
+    # on a file-only subset run_rules skips finalize(), so cross-file
+    # rules produced no raw findings — their suppressions were not
+    # re-observed and must not be called stale (same reasoning as the
+    # baseline updater's partial-run preservation)
+    full_tree = any(os.path.isdir(p) for p in paths)
+    cross_file_rules = {r.name for r in default_rules() if r.cross_file}
+    out: list[Finding] = []
+    for full, rel in iter_python_files(paths):
+        with open(full, encoding="utf-8") as fp:
+            source = fp.read()
+        records, _bad = iter_suppression_records(source, rel)
+        for rec in records:
+            if not full_tree and rec.rules & cross_file_rules:
+                continue
+            file_hits = hits.get(rel, {})
+            used = any(
+                rule in file_hits.get(line, ())
+                for line in rec.covered
+                for rule in rec.rules
+            )
+            if not used:
+                out.append(
+                    Finding(
+                        "stale-suppression", rel, rec.line,
+                        f"suppression for {sorted(rec.rules)} matches no "
+                        "current finding — the rule drifted or the code "
+                        "moved; delete the comment (a stale suppression "
+                        "would silently swallow the NEXT real finding)",
+                    )
+                )
+    out.sort(key=lambda f: (f.path, f.line))
+    return out

@@ -1,0 +1,65 @@
+"""Chaos-coverage checker (``--chaos-coverage``).
+
+Every chaos point registered in ``gofr_tpu/chaos/injector.py`` exists
+because some production seam can fail there — and an injection point no
+test ever schedules a fault at is exactly as good as no injection point.
+This pass cross-checks the registered ``POINTS`` tuple against the test
+files the ``make chaos`` tier runs (parsed out of the Makefile recipe so
+the list cannot drift) at grep level: a point name that appears in no
+chaos test file has shipped untested and fails CI.
+
+JSON output: ``{"points": {point: [files]}, "missing": [...],
+"test_files": [...]}`` — wired into ``make ci``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_CHAOS_RECIPE_RE = re.compile(r"tests/\S+\.py")
+
+
+def chaos_test_files(repo_root: str) -> list[str]:
+    """The test files the ``make chaos`` target runs, parsed from the
+    Makefile's ``chaos:`` recipe."""
+    makefile = os.path.join(repo_root, "Makefile")
+    with open(makefile, encoding="utf-8") as fp:
+        lines = fp.readlines()
+    out: list[str] = []
+    in_target = False
+    for line in lines:
+        if re.match(r"^chaos\s*:", line):
+            in_target = True
+            continue
+        if in_target:
+            if line.startswith(("\t", " ")):
+                out.extend(_CHAOS_RECIPE_RE.findall(line))
+            elif line.strip() and not line.startswith("#"):
+                in_target = False
+    return sorted(set(out))
+
+
+def check_chaos_coverage(repo_root: str) -> dict:
+    """Cross-check every registered chaos point against the make-chaos
+    test files. ``missing`` non-empty = a point ships untested."""
+    from gofr_tpu.chaos.injector import POINTS
+
+    test_files = chaos_test_files(repo_root)
+    coverage: dict[str, list[str]] = {p: [] for p in POINTS}
+    for rel in test_files:
+        full = os.path.join(repo_root, rel)
+        try:
+            with open(full, encoding="utf-8") as fp:
+                source = fp.read()
+        except OSError:
+            continue
+        for point in POINTS:
+            if point in source:
+                coverage[point].append(rel)
+    return {
+        "version": 1,
+        "test_files": test_files,
+        "points": coverage,
+        "missing": sorted(p for p, files in coverage.items() if not files),
+    }

@@ -37,11 +37,26 @@ class Finding:
         return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
 
 
-def parse_suppressions(
+@dataclasses.dataclass(frozen=True)
+class SuppressionRecord:
+    """One well-formed inline suppression comment: the comment's own line,
+    the rule names it disables, its (mandatory) reason, and every source
+    line it covers. The stale-suppression audit
+    (:mod:`gofr_tpu.analysis.audit`) checks each record against the raw
+    finding set."""
+
+    line: int
+    rules: frozenset[str]
+    reason: str
+    covered: frozenset[int]
+
+
+def iter_suppression_records(
     source: str, path: str
-) -> tuple[dict[int, set[str]], list[Finding]]:
-    """Return ``{line: {rules}}`` plus findings for malformed suppressions."""
-    suppressed: dict[int, set[str]] = {}
+) -> tuple[list[SuppressionRecord], list[Finding]]:
+    """Parse every gofrlint suppression comment in ``source`` into
+    records, plus findings for malformed ones."""
+    records: list[SuppressionRecord] = []
     bad: list[Finding] = []
     src_lines = source.splitlines()
     try:
@@ -52,7 +67,7 @@ def parse_suppressions(
             if t.type == tokenize.COMMENT
         ]
     except (tokenize.TokenError, SyntaxError, IndentationError):
-        return {}, []
+        return [], []
     for line, col, text in comments:
         m = _SUPPRESS_RE.search(text)
         if m is None:
@@ -74,8 +89,10 @@ def parse_suppressions(
                 )
             )
             continue
-        rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
-        suppressed.setdefault(line, set()).update(rules)
+        rules = frozenset(
+            r.strip() for r in m.group("rules").split(",") if r.strip()
+        )
+        covered = {line}
         if not src_lines[line - 1][:col].strip():
             # comment alone on its line: cover the next CODE line (skip
             # continuation comment lines and blanks)
@@ -85,7 +102,22 @@ def parse_suppressions(
                 or src_lines[target - 1].lstrip().startswith("#")
             ):
                 target += 1
-            suppressed.setdefault(target, set()).update(rules)
+            covered.add(target)
+        records.append(
+            SuppressionRecord(line, rules, reason, frozenset(covered))
+        )
+    return records, bad
+
+
+def parse_suppressions(
+    source: str, path: str
+) -> tuple[dict[int, set[str]], list[Finding]]:
+    """Return ``{line: {rules}}`` plus findings for malformed suppressions."""
+    records, bad = iter_suppression_records(source, path)
+    suppressed: dict[int, set[str]] = {}
+    for rec in records:
+        for line in rec.covered:
+            suppressed.setdefault(line, set()).update(rec.rules)
     return suppressed, bad
 
 
@@ -150,12 +182,17 @@ class Rule:
         return []
 
 
-def run_rules(paths: list[str], rules: list[Rule]) -> list[Finding]:
+def run_rules(
+    paths: list[str], rules: list[Rule], honor_suppressions: bool = True
+) -> list[Finding]:
     """Run rules over every Python file under ``paths``, honoring
     suppressions. Findings from ``finalize`` are matched against the
     suppression table of the file they landed in. Cross-file rules only
     finalize when at least one *directory* was walked — on a file subset
-    they would see uses without their (elsewhere) registrations."""
+    they would see uses without their (elsewhere) registrations.
+    ``honor_suppressions=False`` reports the RAW finding set (every
+    inline suppression ignored) — the stale-suppression audit compares
+    the suppression comments against exactly this set."""
     full_tree = any(os.path.isdir(p) for p in paths)
     findings: list[Finding] = []
     tables: dict[str, dict[int, set[str]]] = {}
@@ -167,6 +204,11 @@ def run_rules(paths: list[str], rules: list[Rule]) -> list[Finding]:
         except SyntaxError as exc:
             findings.append(Finding("syntax-error", rel, exc.lineno or 0, str(exc.msg)))
             continue
+        if not honor_suppressions:
+            # empty the live table: rules that consult sf.is_suppressed
+            # internally (metrics, pubsub-settle) go raw through the same
+            # object the finalize pass reads
+            sf.suppressions.clear()
         tables[rel] = sf.suppressions
         findings.extend(sf.bad_suppressions)
         for rule in rules:

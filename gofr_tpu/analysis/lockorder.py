@@ -168,6 +168,35 @@ class LockOrderMonitor:
         if cycles:
             raise LockOrderError(format_cycles(cycles))
 
+    def export_graph(self) -> dict:
+        """The observed acquisition graph, with lock instances collapsed
+        to their creation sites (``rel_path:line``, package-anchored like
+        the static analyzer's) so it can be checked as a subgraph of
+        lockcheck's static graph (``--lock-graph``): every runtime edge
+        between two statically-known locks must exist statically, or the
+        analyzer has a blind spot. Site-level self-edges are kept (two
+        instances of one class can nest the "same" creation site); the
+        subgraph checker ignores them."""
+        from gofr_tpu.analysis.core import _package_rel
+
+        with self._mu:
+            edges = {a: set(bs) for a, bs in self._edges.items()}
+            sites = dict(self._sites)
+
+        def norm(token: int) -> str:
+            site = sites.get(token, f"<lock {token}>")
+            path, _, line = site.rpartition(":")
+            return f"{_package_rel(path, path)}:{line}"
+
+        edge_set = {
+            (norm(a), norm(b)) for a, bs in edges.items() for b in bs
+        }
+        return {
+            "version": 1,
+            "nodes": sorted({s for e in edge_set for s in e}),
+            "edges": [list(e) for e in sorted(edge_set)],
+        }
+
 
 def format_cycles(cycles: list[list[str]]) -> str:
     lines = [f"lock-order cycle(s) detected ({len(cycles)}):"]

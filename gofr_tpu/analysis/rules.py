@@ -797,6 +797,7 @@ class RouterRetryTypedRule(Rule):
 
 
 def default_rules() -> list[Rule]:
+    from gofr_tpu.analysis.lockcheck import lockcheck_rules
     from gofr_tpu.analysis.shardcheck import shardcheck_rules
 
     return [
@@ -804,4 +805,5 @@ def default_rules() -> list[Rule]:
         DaemonLoopHeartbeatRule(), PubSubManualSettleRule(),
         RouterRetryTypedRule(),
         *shardcheck_rules(),
+        *lockcheck_rules(),
     ]

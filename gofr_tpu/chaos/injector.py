@@ -77,7 +77,8 @@ def hang_factory(seconds: float) -> Callable[[str, int], None]:
     exception will ever surface."""
 
     def factory(point: str, nth_call: int) -> None:
-        # gofrlint: disable=blocking-call -- the hang IS the injected fault
+        # the hang IS the injected fault (chaos code is outside every
+        # blocking-call lint zone, so no suppression is needed here)
         time.sleep(seconds)
         return None
 

@@ -232,6 +232,8 @@ class MongoClient:
             "<iiii", 16 + len(payload), req_id, 0, OP_MSG
         )
         with self._lock:
+            # gofrlint: disable=hold-and-block -- request/response pairing on
+            # the shared wire: the lock MUST span send+recv or replies cross
             self._sock.sendall(header + payload)
             (length,) = struct.unpack("<i", self._recv_exact(4))
             rest = self._recv_exact(length - 4)

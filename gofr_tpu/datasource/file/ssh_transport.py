@@ -245,6 +245,9 @@ class SSHTransport:
                     self._mac_out, u32(self._send_seq) + packet, hashlib.sha256
                 ).digest()
                 packet = self._encryptor.update(packet) + mac
+            # gofrlint: disable=hold-and-block -- _send_lock pairs the
+            # packet bytes with their MAC sequence number; an interleaved
+            # send would desync the SSH transport MAC stream
             self.sock.sendall(packet)
             self._send_seq = (self._send_seq + 1) & 0xFFFFFFFF
 

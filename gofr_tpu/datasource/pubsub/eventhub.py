@@ -115,11 +115,12 @@ class EventHubClient:
         self._rbuf = b""
         self._wlock = threading.Lock()
         self._lock = threading.Lock()
-        # registry lock: guards _receivers ONLY and is never held across a
-        # blocking wait — the reader thread takes it on DETACH, and taking
-        # self._lock there instead could deadlock-by-timeout (the attach
-        # path holds self._lock while waiting for echoes only the reader
-        # can deliver)
+        # registry lock: guards the _receivers AND _senders registries and
+        # is never held across a blocking wait — the reader thread takes it
+        # on DETACH, and taking self._lock there instead could
+        # deadlock-by-timeout (the attach path holds self._lock while
+        # waiting for echoes only the reader can deliver). Always acquired
+        # INSIDE self._lock when both are held (lock-order-static pins it).
         self._reg_lock = threading.Lock()
         self._handles = itertools.count(0)
         self._delivery_ids = itertools.count(0)
@@ -192,6 +193,9 @@ class EventHubClient:
     def _send_raw(self, data: bytes) -> None:
         with self._wlock:
             assert self._sock is not None
+            # gofrlint: disable=hold-and-block -- AMQP frame-write
+            # serialization: _wlock exists to keep concurrent frames from
+            # interleaving on the shared socket; it guards nothing else
             self._sock.sendall(data)
 
     def _recv_exact(self, n: int) -> bytes:
@@ -273,8 +277,9 @@ class EventHubClient:
                     self._sock = None
                     self._links.clear()
                     self._links_by_remote.clear()
-                    self._senders.clear()
-                    self._receivers.clear()
+                    with self._reg_lock:
+                        self._senders.clear()
+                        self._receivers.clear()
                     self._connected.clear()
             if self._logger and not self._closed:
                 self._logger.warn("eventhub connection lost; will reconnect on next use")
@@ -318,13 +323,15 @@ class EventHubClient:
             handle = int(fields[0]) if fields else -1
             link = self._links_by_remote.pop(handle, None)
             if link is not None:
-                # a detached receiver must leave the topic's poll set, or
-                # subscribe() burns its per-link timeout on a dead queue
+                # a detached link must leave the registries, or publish/
+                # subscribe() burns its per-link timeout on a dead link
                 # forever. The REGISTRY lock serializes this against
-                # subscribe()'s snapshot; dict pops are GIL-atomic.
+                # _sender()'s get-or-attach and subscribe()'s snapshot —
+                # an unguarded pop here could race _sender() caching a
+                # fresh link for the same address and evict the NEW one.
                 self._links.pop(link.handle, None)
-                self._senders.pop(link.address, None)
                 with self._reg_lock:
+                    self._senders.pop(link.address, None)
                     for topic, links in list(self._receivers.items()):
                         if link in links:
                             links.remove(link)
@@ -369,10 +376,12 @@ class EventHubClient:
     def _sender(self, address: str) -> _Link:
         with self._lock:
             self._ensure_connected()
-            link = self._senders.get(address)
+            with self._reg_lock:
+                link = self._senders.get(address)
             if link is None:
                 link = self._attach("sender", address)
-                self._senders[address] = link
+                with self._reg_lock:
+                    self._senders[address] = link
             return link
 
     def _partition_addresses(self, topic: str) -> list[str]:

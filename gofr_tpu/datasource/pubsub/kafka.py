@@ -107,6 +107,9 @@ class KafkaClient:
                 self._ensure_connected()
                 self._correlation += 1
                 cid = self._correlation
+                # gofrlint: disable=hold-and-block -- Kafka correlation-id
+                # pairing: the lock must span send+recv so responses match
+                # their request on the shared connection
                 self._sock.sendall(
                     wire.encode_request(api_key, api_version, cid, self.client_id, body)
                 )

@@ -242,6 +242,9 @@ class MQTTClient:
         with self._lock:
             if self._sock is None:
                 raise MQTTError("not connected")
+            # gofrlint: disable=hold-and-block -- MQTT packet-write
+            # serialization on the shared socket; the lock guards the wire,
+            # so I/O under it IS the serialization contract
             self._sock.sendall(data)
 
     def _send_ping(self) -> int:

@@ -67,6 +67,8 @@ class _Conn:
 
     def send(self, data: bytes) -> None:
         with self._wlock:
+            # gofrlint: disable=hold-and-block -- NATS protocol-line write
+            # serialization: _wlock keeps PUB/SUB frames from interleaving
             self.sock.sendall(data)
 
     def read_line(self) -> bytes:

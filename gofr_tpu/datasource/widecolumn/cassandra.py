@@ -111,6 +111,8 @@ class CassandraClient:
         if self._sock is None:
             raise CQLError(0, "not connected (call connect())")
         with self._lock:
+            # gofrlint: disable=hold-and-block -- CQL request/response
+            # pairing on one stream id: the lock must span send+recv
             self._sock.sendall(frame)
             head = self._recv_exact(9)
             _, stream, opcode, length = wire.parse_frame_header(head)

@@ -68,7 +68,9 @@ requires_modern_shard_map = pytest.mark.skipif(
 # GOFR_LOCK_ORDER=1 (set by `make lock-order`) instruments every
 # threading.Lock/RLock created during the session and fails the run on any
 # lock-order cycle — Python-side deadlock detection complementing the
-# C++-only `make native-tsan` tier.
+# C++-only `make native-tsan` tier. GOFR_LOCK_ORDER_EXPORT=<path> also
+# dumps the observed acquisition graph as JSON for the static-vs-runtime
+# cross-check (lockcheck.check_subgraph; `make lock-order` sets it).
 @pytest.fixture(autouse=True, scope="session")
 def _lock_order_tier():
     if os.environ.get("GOFR_LOCK_ORDER") != "1":
@@ -81,4 +83,10 @@ def _lock_order_tier():
         yield
     finally:
         lockorder.uninstall()
+        export = os.environ.get("GOFR_LOCK_ORDER_EXPORT")
+        if export:
+            import json as _json
+
+            with open(export, "w", encoding="utf-8") as fp:
+                _json.dump(mon.export_graph(), fp, indent=2)
     mon.check()  # raises LockOrderError on any cycle

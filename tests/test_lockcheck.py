@@ -1,0 +1,811 @@
+"""lockcheck (gofr_tpu/analysis/lockcheck.py): the whole-program
+concurrency analyzer — lock-order-static / hold-and-block / guarded-by
+rule fixtures, the static graph export, the runtime-subgraph cross-check
+against the GOFR_LOCK_ORDER tier, the stale-suppression audit, and the
+chaos-coverage checker. docs/static-analysis.md documents the catalog
+these pin down."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from gofr_tpu.analysis import baseline_io
+from gofr_tpu.analysis.audit import stale_suppressions
+from gofr_tpu.analysis.chaoscov import chaos_test_files, check_chaos_coverage
+from gofr_tpu.analysis.core import run_rules
+from gofr_tpu.analysis.lockcheck import (
+    build_static_graph,
+    check_subgraph,
+    lockcheck_rules,
+)
+from gofr_tpu.analysis.rules import default_rules
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_tree(tmp_path, files: dict[str, str]):
+    """Materialize {relpath: source} under tmp_path and lint the top dir."""
+    for rel, source in files.items():
+        full = tmp_path / rel
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_text(source)
+    top = tmp_path / sorted(files)[0].split("/")[0]
+    return run_rules([str(top)], default_rules())
+
+
+def rules_of(findings):
+    return sorted(f.rule for f in findings)
+
+
+# ---------------------------------------------------------- lock-order-static
+def test_lock_order_cycle_same_class(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "    def fwd(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n"
+            "    def rev(self):\n"
+            "        with self._b:\n"
+            "            with self._a:\n"
+            "                pass\n"
+        ),
+    })
+    assert "lock-order-static" in rules_of(findings)
+    assert any("cycle" in f.message for f in findings)
+
+
+def test_lock_order_cycle_across_objects_and_files(tmp_path):
+    """A holds its lock while calling into B; B holds its lock while
+    calling back into A — the AB/BA cycle only exists cross-file."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "import threading\n"
+            "from gofr_tpu.svc.b import Sched\n"
+            "class Engine:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self._sched = Sched()\n"
+            "    def submit(self):\n"
+            "        with self._mu:\n"
+            "            self._sched.admit()\n"
+            "    def poke(self):\n"
+            "        with self._mu:\n"
+            "            pass\n"
+        ),
+        "gofr_tpu/svc/b.py": (
+            "import threading\n"
+            "class Sched:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self.engine = None\n"
+            "    def admit(self):\n"
+            "        with self._mu:\n"
+            "            pass\n"
+            "    def drain(self, engine):\n"
+            "        with self._mu:\n"
+            "            engine.poke()\n"
+        ),
+    })
+    # Engine._mu -> Sched._mu via submit; the reverse edge needs the
+    # engine param resolved, which the analyzer cannot do from a bare
+    # name — so wire it through an annotated attribute instead
+    findings2 = lint_tree(tmp_path / "x", {
+        "gofr_tpu/svc/a.py": (
+            "import threading\n"
+            "from gofr_tpu.svc.b import Sched\n"
+            "class Engine:\n"
+            "    def __init__(self, sched: Sched):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self._sched = sched\n"
+            "    def submit(self):\n"
+            "        with self._mu:\n"
+            "            self._sched.admit()\n"
+            "    def poke(self):\n"
+            "        with self._mu:\n"
+            "            pass\n"
+        ),
+        "gofr_tpu/svc/b.py": (
+            "import threading\n"
+            "from gofr_tpu.svc.c import Engine\n"
+            "class Sched:\n"
+            "    def __init__(self, engine: Engine):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self._engine = engine\n"
+            "    def admit(self):\n"
+            "        with self._mu:\n"
+            "            pass\n"
+            "    def drain(self):\n"
+            "        with self._mu:\n"
+            "            self._engine.poke()\n"
+        ),
+    })
+    assert "lock-order-static" in rules_of(findings2)
+    assert findings == []  # unresolvable param: no reverse edge, no cycle
+
+
+def test_lock_order_consistent_nesting_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "    def one(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n"
+            "    def two(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_lock_order_reentrant_rlock_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.RLock()\n"
+            "    def outer(self):\n"
+            "        with self._mu:\n"
+            "            self.inner()\n"
+            "    def inner(self):\n"
+            "        with self._mu:\n"
+            "            pass\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_lock_order_acquire_release_form_builds_edges(tmp_path):
+    """The engine's bounded-acquire idiom (acquire(timeout=...) +
+    try/finally release) contributes the same order edges as `with`."""
+    files = {
+        "gofr_tpu/svc/a.py": (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "    def one(self):\n"
+            "        ok = self._a.acquire(timeout=5.0)\n"
+            "        try:\n"
+            "            with self._b:\n"
+            "                pass\n"
+            "        finally:\n"
+            "            self._a.release()\n"
+            "    def two(self):\n"
+            "        with self._b:\n"
+            "            with self._a:\n"
+            "                pass\n"
+        ),
+    }
+    findings = lint_tree(tmp_path, files)
+    assert "lock-order-static" in rules_of(findings)
+    graph = build_static_graph([str(tmp_path / "gofr_tpu")])
+    pairs = {(e["from"], e["to"]) for e in graph["edges"]}
+    assert any(a.endswith("S._a") and b.endswith("S._b") for a, b in pairs)
+    assert any(a.endswith("S._b") and b.endswith("S._a") for a, b in pairs)
+
+
+def test_lock_order_suppression_honored(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "    def fwd(self):\n"
+            "        with self._a:\n"
+            "            # gofrlint: disable=lock-order-static -- probe-only\n"
+            "            with self._b:\n"
+            "                pass\n"
+            "    def rev(self):\n"
+            "        with self._b:\n"
+            "            with self._a:\n"
+            "                pass\n"
+        ),
+    })
+    # the cycle finding lands on the first acquisition site of the
+    # normalized (min-label-first) cycle — the S._a -> S._b edge in fwd,
+    # which is exactly the line the standalone comment covers
+    assert findings == []
+
+
+# -------------------------------------------------------------- hold-and-block
+def test_hold_and_block_sleep_under_lock(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "import threading, time\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.Lock()\n"
+            "    def work(self):\n"
+            "        with self._mu:\n"
+            "            time.sleep(1.0)\n"
+        ),
+    })
+    assert rules_of(findings) == ["hold-and-block"]
+    assert "time.sleep" in findings[0].message
+
+
+def test_hold_and_block_unbounded_wait_and_result(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self._done = threading.Event()\n"
+            "    def work(self, fut):\n"
+            "        with self._mu:\n"
+            "            self._done.wait()\n"
+            "            out = fut.result()\n"
+            "        return out\n"
+        ),
+    })
+    assert rules_of(findings) == ["hold-and-block", "hold-and-block"]
+    assert "without timeout" in findings[0].message
+
+
+def test_hold_and_block_explicit_none_timeout_is_unbounded(tmp_path):
+    # fut.result(None) / ev.wait(timeout=None) are the no-timeout forms
+    # spelled out — exactly as unbounded as the bare calls
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self._done = threading.Event()\n"
+            "    def work(self, fut):\n"
+            "        with self._mu:\n"
+            "            self._done.wait(timeout=None)\n"
+            "            return fut.result(None)\n"
+        ),
+    })
+    assert rules_of(findings) == ["hold-and-block", "hold-and-block"]
+
+
+def test_hold_and_block_bounded_forms_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self._done = threading.Event()\n"
+            "    def work(self, fut, thread):\n"
+            "        with self._mu:\n"
+            "            self._done.wait(0.05)\n"
+            "            out = fut.result(timeout=2.0)\n"
+            "            thread.join(timeout=1.0)\n"
+            "        return out\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_hold_and_block_outside_critical_section_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "import threading, time\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.Lock()\n"
+            "    def work(self, fut):\n"
+            "        with self._mu:\n"
+            "            snapshot = 1\n"
+            "        time.sleep(0.1)\n"
+            "        return fut.result()\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_hold_and_block_closure_is_deferred_work(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "import threading, time\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.Lock()\n"
+            "    def work(self, pool):\n"
+            "        with self._mu:\n"
+            "            def task():\n"
+            "                time.sleep(1.0)\n"
+            "            pool.submit(task)\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_hold_and_block_dispatch_and_io(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self._sock = None\n"
+            "    def work(self, arr):\n"
+            "        with self._mu:\n"
+            "            self._sock.sendall(b'x')\n"
+            "            arr.block_until_ready()\n"
+        ),
+    })
+    assert rules_of(findings) == ["hold-and-block", "hold-and-block"]
+    assert "transport I/O" in findings[0].message
+    assert "device dispatch" in findings[1].message
+
+
+def test_hold_and_block_suppression_honored(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "import threading, time\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.Lock()\n"
+            "    def work(self):\n"
+            "        with self._mu:\n"
+            "            # gofrlint: disable=hold-and-block -- probe, bounded\n"
+            "            time.sleep(0.01)\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_hold_and_block_module_level_lock(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "import threading, time\n"
+            "_install_mu = threading.Lock()\n"
+            "def install():\n"
+            "    with _install_mu:\n"
+            "        time.sleep(0.5)\n"
+        ),
+    })
+    assert rules_of(findings) == ["hold-and-block"]
+
+
+# ------------------------------------------------------------------ guarded-by
+GUARDED_CLS = (
+    "import threading\n"
+    "class S:\n"
+    "    def __init__(self):\n"
+    "        self._mu = threading.Lock()\n"
+    "        self.count = 0\n"
+    "        self._t = threading.Thread(target=self._loop, daemon=True)\n"
+    "    def incr(self):\n"
+    "        with self._mu:\n"
+    "            self.count += 1\n"
+    "    def reset(self):\n"
+    "        with self._mu:\n"
+    "            self.count = 0\n"
+)
+
+
+def test_guarded_by_unguarded_write_in_thread_root(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": GUARDED_CLS + (
+            "    def _loop(self):\n"
+            "        self.count += 1\n"
+        ),
+    })
+    assert rules_of(findings) == ["guarded-by"]
+    assert "S.count" in findings[0].message and "_loop" in findings[0].message
+
+
+def test_guarded_by_reachable_through_self_call(tmp_path):
+    """The write skips the guard in a helper the thread root calls."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": GUARDED_CLS + (
+            "    def _loop(self):\n"
+            "        self._step()\n"
+            "    def _step(self):\n"
+            "        self.count += 1\n"
+        ),
+    })
+    assert rules_of(findings) == ["guarded-by"]
+    assert "_step" in findings[0].message
+
+
+def test_guarded_by_executor_submit_root_and_mutator(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self, pool):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self.items = []\n"
+            "        pool.submit(self._work)\n"
+            "    def put(self, x):\n"
+            "        with self._mu:\n"
+            "            self.items.append(x)\n"
+            "    def clear(self):\n"
+            "        with self._mu:\n"
+            "            self.items.clear()\n"
+            "    def _work(self):\n"
+            "        self.items.append(1)\n"
+        ),
+    })
+    assert rules_of(findings) == ["guarded-by"]
+
+
+def test_guarded_by_all_writes_guarded_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": GUARDED_CLS + (
+            "    def _loop(self):\n"
+            "        with self._mu:\n"
+            "            self.count += 1\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_guarded_by_no_thread_root_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self.count = 0\n"
+            "    def incr(self):\n"
+            "        with self._mu:\n"
+            "            self.count += 1\n"
+            "    def reset(self):\n"
+            "        with self._mu:\n"
+            "            self.count = 0\n"
+            "    def racy(self):\n"
+            "        self.count += 1\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_guarded_by_no_dominant_pattern_clean(tmp_path):
+    # one guarded write is not a pattern — no inference, no finding
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self.count = 0\n"
+            "        self._t = threading.Thread(target=self._loop, daemon=True)\n"
+            "    def incr(self):\n"
+            "        with self._mu:\n"
+            "            self.count += 1\n"
+            "    def _loop(self):\n"
+            "        self.count += 1\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_guarded_by_init_writes_exempt(tmp_path):
+    # __init__ runs before the thread exists: its unguarded writes are
+    # construction, not racing
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": GUARDED_CLS + (
+            "    def _loop(self):\n"
+            "        with self._mu:\n"
+            "            self.count = 2\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_guarded_by_suppression_honored(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": GUARDED_CLS + (
+            "    def _loop(self):\n"
+            "        # gofrlint: disable=guarded-by -- loop-exclusive phase\n"
+            "        self.count += 1\n"
+        ),
+    })
+    assert findings == []
+
+
+# ------------------------------------------------- graph export + cross-check
+def test_static_graph_nodes_carry_creation_sites(tmp_path):
+    (tmp_path / "gofr_tpu").mkdir()
+    (tmp_path / "gofr_tpu" / "m.py").write_text(
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def one(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+    )
+    g = build_static_graph([str(tmp_path / "gofr_tpu")])
+    assert "gofr_tpu/m.py:S._a" in g["nodes"]
+    assert g["nodes"]["gofr_tpu/m.py:S._a"]["sites"] == ["gofr_tpu/m.py:4"]
+    assert {(e["from"], e["to"]) for e in g["edges"]} == {
+        ("gofr_tpu/m.py:S._a", "gofr_tpu/m.py:S._b")
+    }
+
+
+def test_check_subgraph_semantics():
+    static = {
+        "nodes": {
+            "A": {"sites": ["gofr_tpu/a.py:1"]},
+            "B": {"sites": ["gofr_tpu/a.py:2", "gofr_tpu/a.py:9"]},
+        },
+        "edges": [{"from": "A", "to": "B", "sites": ["gofr_tpu/a.py:5"]}],
+    }
+    ok = {"edges": [["gofr_tpu/a.py:1", "gofr_tpu/a.py:9"]]}
+    assert check_subgraph(ok, static) == []
+    # reversed edge: a divergence
+    bad = {"edges": [["gofr_tpu/a.py:2", "gofr_tpu/a.py:1"]]}
+    assert len(check_subgraph(bad, static)) == 1
+    # unknown runtime site (test/stdlib lock): ignored
+    unknown = {"edges": [["tests/t.py:3", "gofr_tpu/a.py:1"]]}
+    assert check_subgraph(unknown, static) == []
+    # site-level self-edge (two instances of one class): ignored
+    twin = {"edges": [["gofr_tpu/a.py:2", "gofr_tpu/a.py:9"]]}
+    assert check_subgraph(twin, static) == []
+    # testutil scaffolding excluded
+    tu = {"edges": [["gofr_tpu/testutil/r.py:1", "gofr_tpu/a.py:1"]]}
+    assert check_subgraph(tu, static) == []
+
+
+def test_lockorder_monitor_exports_site_graph():
+    from gofr_tpu.analysis import lockorder
+
+    mon = lockorder.LockOrderMonitor()
+    a = mon.make_lock()
+    b = mon.make_lock()  # distinct line: distinct creation site
+    with a:
+        with b:
+            pass
+    g = mon.export_graph()
+    assert len(g["edges"]) == 1 and len(g["nodes"]) == 2
+    (edge,) = g["edges"]
+    assert edge[0] != edge[1]
+    assert all(":" in site for site in g["nodes"])
+
+
+def test_runtime_graph_is_subgraph_of_static():
+    """The tentpole invariant: everything the runtime GOFR_LOCK_ORDER
+    tier can observe on a real engine workload must already be in
+    lockcheck's static graph — a divergence is an analyzer blind spot
+    (or a lock site the analyzer maps wrong)."""
+    import jax
+
+    from gofr_tpu.analysis import lockorder
+    from gofr_tpu.models import llama
+    from gofr_tpu.serving import (
+        ByteTokenizer,
+        EngineConfig,
+        ServingEngine,
+    )
+
+    try:
+        mon = lockorder.install()
+    except lockorder.LockOrderError:
+        pytest.skip("session lock-order tier already installed")
+    try:
+        cfg = llama.LlamaConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=64, max_seq_len=64,
+        )
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        eng = ServingEngine(
+            cfg, params,
+            EngineConfig(max_slots=2, max_seq_len=64, prefill_buckets=(16,),
+                         admission_per_step=2, max_queue=16),
+            ByteTokenizer(cfg.vocab_size),
+        )
+        eng.start()
+        try:
+            fut = eng.submit("hi", max_new_tokens=4)
+            fut.result(timeout=120)
+        finally:
+            eng.stop()
+    finally:
+        lockorder.uninstall()
+    runtime = mon.export_graph()
+    assert runtime["edges"], "engine workload observed no lock nesting"
+    static = build_static_graph([os.path.join(REPO_ROOT, "gofr_tpu")])
+    divergences = check_subgraph(runtime, static)
+    assert divergences == [], "\n".join(divergences)
+
+
+def test_check_lock_graph_cli(tmp_path, capsys):
+    """`make lock-order` enforcement: the exported runtime graph is
+    verified a subgraph of the static one via --check-lock-graph."""
+    from gofr_tpu.analysis.__main__ import main
+
+    pkg = tmp_path / "gofr_tpu"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def one(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+    )
+    ok = tmp_path / "rt_ok.json"
+    ok.write_text(json.dumps(
+        {"edges": [["gofr_tpu/m.py:4", "gofr_tpu/m.py:5"]]}
+    ))
+    assert main(["--check-lock-graph", str(ok), str(pkg)]) == 0
+    bad = tmp_path / "rt_bad.json"
+    bad.write_text(json.dumps(
+        {"edges": [["gofr_tpu/m.py:5", "gofr_tpu/m.py:4"]]}
+    ))
+    assert main(["--check-lock-graph", str(bad), str(pkg)]) == 1
+    out = capsys.readouterr()
+    assert "missing from the static graph" in out.out
+    assert main(["--check-lock-graph", str(tmp_path / "absent.json")]) == 2
+    # a typo'd package path must be a usage error, not an empty static
+    # graph that vacuously verifies every runtime edge
+    assert main(
+        ["--check-lock-graph", str(ok), str(tmp_path / "gofr_tpue")]
+    ) == 2
+
+
+# ------------------------------------------------------- stale suppressions
+def test_stale_suppression_flagged(tmp_path):
+    (tmp_path / "gofr_tpu").mkdir()
+    (tmp_path / "gofr_tpu" / "m.py").write_text(
+        "import threading, time\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._mu = threading.Lock()\n"
+        "    def live(self):\n"
+        "        with self._mu:\n"
+        "            # gofrlint: disable=hold-and-block -- startup only\n"
+        "            time.sleep(0.01)\n"
+        "    def stale(self):\n"
+        "        # gofrlint: disable=hold-and-block -- nothing blocks now\n"
+        "        return 1\n"
+    )
+    stale = stale_suppressions([str(tmp_path / "gofr_tpu")])
+    assert [f.line for f in stale] == [10]
+    assert "matches no current finding" in stale[0].message
+
+
+def test_stale_suppression_clean_when_all_live(tmp_path):
+    (tmp_path / "gofr_tpu").mkdir()
+    (tmp_path / "gofr_tpu" / "m.py").write_text(
+        "import threading, time\n"
+        "_mu = threading.Lock()\n"
+        "def live():\n"
+        "    with _mu:\n"
+        "        time.sleep(0.01)  # gofrlint: disable=hold-and-block -- probe\n"
+    )
+    assert stale_suppressions([str(tmp_path / "gofr_tpu")]) == []
+
+
+def test_stale_suppression_cross_file_rules_spared_on_file_subset(tmp_path):
+    """A file-only run skips finalize(), so cross-file-rule suppressions
+    cannot be re-observed — the audit must not call them stale there,
+    but a directory run still does."""
+    pkg = tmp_path / "gofr_tpu"
+    pkg.mkdir()
+    f = pkg / "m.py"
+    f.write_text(
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "    def one(self):\n"
+        "        # gofrlint: disable=lock-order-static -- no cycle here\n"
+        "        with self._a:\n"
+        "            pass\n"
+    )
+    assert stale_suppressions([str(f)]) == []  # file subset: spared
+    stale = stale_suppressions([str(pkg)])    # full tree: genuinely stale
+    assert [s.line for s in stale] == [6]
+
+
+def test_stale_suppression_real_tree_clean():
+    """Every inline suppression in the shipped tree matches a live raw
+    finding — the --check-suppressions CI gate."""
+    assert stale_suppressions([os.path.join(REPO_ROOT, "gofr_tpu")]) == []
+
+
+# --------------------------------------------------------- chaos coverage
+def test_chaos_coverage_real_tree_complete():
+    report = check_chaos_coverage(REPO_ROOT)
+    assert report["missing"] == [], (
+        f"chaos points with no make-chaos test: {report['missing']}"
+    )
+    assert report["test_files"], "Makefile chaos target parsed no test files"
+    for files in report["points"].values():
+        assert all(f.startswith("tests/") for f in files)
+
+
+def test_chaos_coverage_detects_missing_point(tmp_path):
+    (tmp_path / "tests").mkdir()
+    (tmp_path / "tests" / "test_c.py").write_text(
+        'RATES = {"sched.submit": 1.0}\n'
+    )
+    (tmp_path / "Makefile").write_text(
+        "chaos:\n\tpytest tests/test_c.py -q -m chaos\n"
+    )
+    report = check_chaos_coverage(str(tmp_path))
+    assert report["test_files"] == ["tests/test_c.py"]
+    assert report["points"]["sched.submit"] == ["tests/test_c.py"]
+    assert "kv.alloc" in report["missing"]
+
+
+def test_chaos_makefile_parse_matches_tier():
+    files = chaos_test_files(REPO_ROOT)
+    assert "tests/test_chaos.py" in files
+    assert "tests/test_router_chaos.py" in files
+
+
+# ----------------------------------------------------- json / baseline / tree
+def test_lockcheck_findings_have_stable_json_ids(tmp_path):
+    for rel in ("a", "b"):
+        d = tmp_path / rel / "gofr_tpu"
+        d.mkdir(parents=True)
+        (d / "m.py").write_text(
+            "import threading, time\n"
+            "_mu = threading.Lock()\n"
+            "def f():\n"
+            "    with _mu:\n"
+            "        time.sleep(1)\n"
+        )
+    f1 = run_rules([str(tmp_path / "a" / "gofr_tpu")], default_rules())
+    f2 = run_rules([str(tmp_path / "b" / "gofr_tpu")], default_rules())
+    (j1,), (j2,) = (
+        json.loads(baseline_io.render_json(f))["findings"] for f in (f1, f2)
+    )
+    assert j1["id"] == j2["id"] and j1["id"].startswith("hold-and-block-")
+    assert j1["rule"] == "hold-and-block" and j1["line"] == 5
+
+
+def test_lockcheck_baseline_round_trip(tmp_path):
+    (tmp_path / "gofr_tpu").mkdir()
+    (tmp_path / "gofr_tpu" / "m.py").write_text(
+        "import threading, time\n"
+        "_mu = threading.Lock()\n"
+        "def f():\n"
+        "    with _mu:\n"
+        "        time.sleep(1)\n"
+    )
+    findings = run_rules([str(tmp_path / "gofr_tpu")], default_rules())
+    assert rules_of(findings) == ["hold-and-block"]
+    path = str(tmp_path / "baseline.json")
+    baseline_io.write_baseline(path, findings)
+    blocking, baselined = baseline_io.apply_baseline(
+        findings, baseline_io.load_baseline(path)
+    )
+    assert blocking == [] and baselined == 1
+
+
+def test_real_tree_clean():
+    """lockcheck over the shipped tree: zero unsuppressed findings —
+    every hold-and-block/guarded-by true positive is fixed or carries a
+    reasoned suppression, and the lock graph is acyclic."""
+    findings = run_rules(
+        [os.path.join(REPO_ROOT, "gofr_tpu")], lockcheck_rules()
+    )
+    assert findings == [], "\n".join(f.render() for f in findings)
