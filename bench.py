@@ -417,9 +417,30 @@ def _engine_sustained(cfg: Any, params: Any, on_tpu: bool) -> tuple[dict, Any]:
         "req_per_s": round(len(results) / elapsed, 2),
         "gen_tok_per_s": round(gen_tokens / elapsed, 2),
         "ttft": _percentiles([r.ttft_s for r in results]),
+        **_timeline_stats(engine),
         **err,
     }
     return stats, engine
+
+
+def _timeline_stats(engine: Any) -> dict:
+    """Timeline-derived phase latencies for the JSONL record: submit→
+    first-token p50 and submit→admission queue wait, read from the
+    engine's /requestz flight recorder via the SAME latency_summary the
+    health check embeds (serving/timeline.py) — one median
+    implementation, so the bench record and an operator's live view can
+    never drift, and future ratchet floors can cover these fields
+    (docs/observability.md)."""
+    recorder = getattr(engine, "timeline", None)
+    if recorder is None:
+        return {}
+    summary = recorder.latency_summary()
+    out: dict = {}
+    if "ttft_ms_p50" in summary:
+        out["ttft_ms_p50"] = summary["ttft_ms_p50"]
+    if "queue_wait_ms_p50" in summary:
+        out["queue_wait_ms"] = summary["queue_wait_ms_p50"]
+    return out
 
 
 def _http_generate_load(engine: Any, on_tpu: bool) -> dict:
@@ -1157,6 +1178,10 @@ def _engine_metrics() -> Any:
         buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
     )
     m.new_histogram("app_tpot_seconds", "Time per output token")
+    m.new_histogram("app_request_ttft_seconds", "Time to first token (phase)")
+    m.new_histogram("app_request_queue_wait_seconds", "Queue wait")
+    m.new_histogram("app_request_e2e_seconds", "End-to-end latency")
+    m.new_histogram("app_decode_block_seconds", "Decode block wall time")
     m.new_gauge("app_batch_queue_depth", "queue depth")
     m.new_gauge("app_batch_occupancy", "occupancy")
     m.new_gauge("app_kv_cache_pages_used", "pages")

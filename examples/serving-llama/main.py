@@ -13,7 +13,12 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 import jax
 import gofr_tpu
 from gofr_tpu.models import llama
-from gofr_tpu.serving import ByteTokenizer, EngineConfig, ServingEngine
+from gofr_tpu.serving import (
+    ByteTokenizer,
+    DeviceTelemetry,
+    EngineConfig,
+    ServingEngine,
+)
 from gofr_tpu.serving.handlers import register_generation_routes
 
 
@@ -30,8 +35,17 @@ def build_app(config=None) -> gofr_tpu.App:
         ByteTokenizer(cfg.vocab_size),
         metrics=app.container.metrics_manager,
         logger=app.container.logger,
+        tracer=app.container.tracer,
     )
-    register_generation_routes(app, engine)
+    register_generation_routes(app, engine)  # + /v1/models + /requestz
+    # HBM + duty-cycle gauges, health embed, heartbeat headroom
+    # (docs/observability.md "TPU device telemetry")
+    telemetry = DeviceTelemetry(
+        engine, metrics=app.container.metrics_manager,
+        logger=app.container.logger,
+    )
+    app.on_start(lambda ctx: telemetry.start())
+    app.on_shutdown(telemetry.stop)
     return app
 
 

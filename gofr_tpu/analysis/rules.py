@@ -409,16 +409,32 @@ class CtypesCheckedRule(Rule):
 
 class MetricsRule(Rule):
     """Cross-file: registrations collected everywhere, usages checked in
-    finalize. Dynamic names / unbounded labels are flagged in place."""
+    finalize. Dynamic names / unbounded labels are flagged in place.
+
+    Beyond never-registered names (``metric-unregistered`` — the Manager
+    silently drops them), full-tree runs enforce the REGISTRATION SITE
+    (``metric-register-site``): a name used anywhere in ``gofr_tpu/``
+    must be registered in ``container/container.py`` (the framework
+    metric catalog every deployment gets) or in the using file's own
+    directory (self-registering subsystems: datasource drivers, the gRPC
+    server). Registration at an arbitrary distance means the series
+    silently vanishes in any process that never imports the registering
+    module — the PR 1 ``app_spec_accept_rate`` bug class. Only enforced
+    when ``container/container.py`` is part of the scanned tree, so
+    file-subset runs and fixture trees are unaffected."""
 
     name = "metric-unregistered"
     cross_file = True
 
     def __init__(self) -> None:
         self._registered: set[str] = set()
+        self._register_sites: dict[str, set[str]] = {}  # name -> rel paths
+        self._container_seen = False
         self._usages: list[tuple[str, str, int]] = []  # (name, path, line)
 
     def visit_file(self, sf: SourceFile) -> list[Finding]:
+        if sf.rel_path.endswith("container/container.py"):
+            self._container_seen = True
         inline: list[Finding] = []
         for node in ast.walk(sf.tree):
             if not isinstance(node, ast.Call) or not isinstance(
@@ -430,11 +446,29 @@ class MetricsRule(Rule):
                 first = node.args[0]
                 if isinstance(first, ast.Constant) and isinstance(first.value, str):
                     self._registered.add(first.value)
+                    self._register_sites.setdefault(first.value, set()).add(
+                        sf.rel_path
+                    )
             elif method in METRIC_USE_METHODS:
                 inline.extend(
                     self._check_usage(sf, node, METRIC_USE_METHODS[method])
                 )
         return [f for f in inline if not sf.is_suppressed(f.rule, f.line)]
+
+    @staticmethod
+    def _unbounded_value(expr: ast.expr) -> bool:
+        """True for label-value expressions that smell unbounded: any
+        string-building form — f-strings, ``+``/``%`` concatenation,
+        ``.format()``/``.join()`` calls. A bare Name may be a bounded
+        enum, so it stays clean; building a string at the call site is
+        the per-request-id pattern that explodes series cardinality."""
+        if isinstance(expr, (ast.JoinedStr, ast.BinOp)):
+            return True
+        return (
+            isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Attribute)
+            and expr.func.attr in ("format", "join")
+        )
 
     def _check_usage(
         self, sf: SourceFile, node: ast.Call, label_start: int
@@ -465,7 +499,7 @@ class MetricsRule(Rule):
                             "label KEY must be a string literal",
                         )
                     )
-            elif isinstance(arg, (ast.JoinedStr, ast.BinOp)):
+            elif self._unbounded_value(arg):
                 out.append(
                     Finding(
                         "metric-label-cardinality", sf.rel_path, arg.lineno,
@@ -474,7 +508,7 @@ class MetricsRule(Rule):
                     )
                 )
         for kw in node.keywords:
-            if kw.arg is not None and isinstance(kw.value, (ast.JoinedStr, ast.BinOp)):
+            if kw.arg is not None and self._unbounded_value(kw.value):
                 out.append(
                     Finding(
                         "metric-label-cardinality", sf.rel_path, kw.value.lineno,
@@ -485,6 +519,8 @@ class MetricsRule(Rule):
         return out
 
     def finalize(self) -> list[Finding]:
+        import posixpath
+
         out: list[Finding] = []
         for name, path, line in self._usages:
             if name not in self._registered:
@@ -493,6 +529,26 @@ class MetricsRule(Rule):
                         "metric-unregistered", path, line,
                         f"metric '{name}' is never registered — the Manager "
                         "silently drops it (typo loses the series)",
+                    )
+                )
+                continue
+            if not self._container_seen:
+                continue  # file-subset / fixture run: site check is moot
+            sites = self._register_sites.get(name, set())
+            use_dir = posixpath.dirname(path)
+            if not any(
+                site.endswith("container/container.py")
+                or posixpath.dirname(site) == use_dir
+                for site in sites
+            ):
+                out.append(
+                    Finding(
+                        "metric-register-site", path, line,
+                        f"metric '{name}' is registered only in "
+                        f"{sorted(sites)} — register it in container/"
+                        "container.py (the framework catalog) or in this "
+                        "file's own subsystem: a process that never imports "
+                        "the registering module silently loses the series",
                     )
                 )
         return out

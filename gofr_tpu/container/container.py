@@ -51,6 +51,19 @@ class Container:
 
         self.metrics_manager: Manager = new_metrics_manager(self.logger)
         self.tracer: Tracer = self._build_tracer()
+        # live trace sample-ratio adjustment: the sibling of the remote
+        # log-level poller (logging/remote.py) — an incident responder
+        # raises sampling on a live fleet without a restart
+        ratio_url = self.config.get("REMOTE_TRACE_RATIO_URL")
+        if ratio_url:
+            from gofr_tpu.logging.remote import start_remote_trace_ratio_poller
+
+            interval = float(
+                self.config.get_or_default("REMOTE_TRACE_RATIO_INTERVAL", "15")
+            )
+            self._remote_trace_thread = start_remote_trace_ratio_poller(
+                self.tracer, ratio_url, interval, logger=self.logger
+            )
 
         # datasources (nil until wired by App.add_* / configure)
         self.tpu: Any = None
@@ -224,6 +237,51 @@ class Container:
             "Mean reported queue-wait EWMA across live replicas (the "
             "tier-level autoscaling signal)",
         )
+        # request-lifecycle phase histograms (docs/observability.md): the
+        # standard serving evaluation lens — TTFT, queue wait, end-to-end,
+        # and the decode-block cadence the CPU-free hot loop ticks at.
+        # TTFT carries source=engine (admission→first token) and
+        # source=router (client submit→first token; the hedge p99 floor).
+        ttft_buckets = (
+            0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30,
+        )
+        m.new_histogram(
+            "app_request_ttft_seconds",
+            "Time to first token per request (label source=engine|router)",
+            buckets=ttft_buckets,
+        )
+        m.new_histogram(
+            "app_request_queue_wait_seconds",
+            "Submit-to-admission queue wait per request",
+            buckets=ttft_buckets,
+        )
+        m.new_histogram(
+            "app_request_e2e_seconds",
+            "Submit-to-terminal end-to-end latency per request",
+        )
+        m.new_histogram(
+            "app_decode_block_seconds",
+            "Wall time of one fused N-step decode block (dispatch to sync)",
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1, 2.5),
+        )
+        # TPU device telemetry (serving/device_telemetry.py): HBM
+        # occupancy per device + the engine loop's duty cycle — the
+        # instrument panel the membership heartbeat's headroom fields and
+        # the router's HBM-pressure spill read from
+        m.new_gauge(
+            "app_tpu_hbm_bytes",
+            "Device HBM bytes (labels: device, kind=used|limit)",
+        )
+        m.new_gauge(
+            "app_tpu_hbm_util",
+            "Fraction of device HBM in use, per device",
+        )
+        m.new_gauge(
+            "app_engine_duty_cycle",
+            "Fraction of wall time the engine loop spent doing work "
+            "(heartbeat-derived, over the telemetry poll interval)",
+        )
 
     # -- accessors mirroring the reference's API ------------------------------
     @property
@@ -287,9 +345,10 @@ class Container:
             except Exception:
                 pass
         self.tracer.shutdown()
-        thread = getattr(self, "_remote_log_thread", None)
-        if thread is not None:
-            thread._gofr_stop.set()
+        for attr in ("_remote_log_thread", "_remote_trace_thread"):
+            thread = getattr(self, attr, None)
+            if thread is not None:
+                thread._gofr_stop.set()
 
 
 def _rss_bytes() -> int:

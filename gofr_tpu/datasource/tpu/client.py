@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import contextlib
 import math
+import os
 import threading
 import time
 from typing import Any
@@ -570,7 +571,16 @@ class TPUClient:
         confirms the plugin .so is loadable outside the JAX process model
         and records its negotiated API version for health reporting. Only
         probes REAL plugins ($TPU_PJRT_PLUGIN / libtpu) — never compiles
-        the test stub on the connect path; loads are memoized process-wide."""
+        the test stub on the connect path; loads are memoized process-wide
+        (failures included — native/pjrt.py)."""
+        platforms = os.environ.get("JAX_PLATFORMS", "")
+        if platforms and "tpu" not in platforms.lower():
+            # the operator explicitly forced a non-TPU backend: probing
+            # real TPU hardware is pointless AND expensive — libtpu's
+            # init can spin minutes of retries on a host without a TPU
+            # (the CPU test tiers run under JAX_PLATFORMS=cpu)
+            self._native_info = {"skipped": f"JAX_PLATFORMS={platforms}"}
+            return
         try:
             from gofr_tpu.native.pjrt import PjrtPlugin, probe_plugin_path
 

@@ -26,6 +26,7 @@ from gofr_tpu.http.errors import (
     ErrorServiceUnavailable,
     ErrorTooManyRequests,
 )
+from gofr_tpu.tracing.trace import current_span, format_traceparent
 
 # the engine's typed lifecycle errors every generation RPC converts to a
 # gRPC status instead of letting them surface as INTERNAL
@@ -73,6 +74,15 @@ async def _abort_lifecycle(context: Any, exc: Exception) -> None:
     raise exc
 
 _identity = lambda b: b  # noqa: E731
+
+
+def _trace_metadata() -> tuple | None:
+    """Outbound W3C propagation: the caller's active span rides gRPC
+    metadata as ``traceparent``, mirroring the HTTP header path."""
+    span = current_span()
+    if span is None:
+        return None
+    return (("traceparent", format_traceparent(span)),)
 
 
 def _json_bytes(obj: Any) -> bytes:
@@ -143,8 +153,12 @@ class InferenceService:
         if not prompt:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "prompt required")
         try:
+            # the interceptor's server span (which continued any inbound
+            # traceparent metadata) is active here: hang the engine's
+            # lifecycle spans off it
             result = await self.engine.generate(
-                prompt, deadline=_deadline_of(context), **self._gen_kwargs(body)
+                prompt, deadline=_deadline_of(context),
+                trace_ctx=current_span(), **self._gen_kwargs(body)
             )
         except LIFECYCLE_ERRORS as exc:
             await _abort_lifecycle(context, exc)
@@ -174,6 +188,7 @@ class InferenceService:
             async for token_id, piece in self.engine.stream(
                 prompt, deadline=_deadline_of(context),
                 on_result=lambda r: final.setdefault("result", r),
+                trace_ctx=current_span(),
                 **self._gen_kwargs(body),
             ):
                 yield _json_bytes({"token": token_id, "text": piece})
@@ -244,7 +259,9 @@ class InferenceClient:
         return json.loads(resp)
 
     async def generate(self, prompt: str, **kw: Any) -> dict:
-        resp = await self._unary("Generate")(_json_bytes({"prompt": prompt, **kw}))
+        resp = await self._unary("Generate")(
+            _json_bytes({"prompt": prompt, **kw}), metadata=_trace_metadata()
+        )
         return json.loads(resp)
 
     async def generate_stream(self, prompt: str, **kw: Any):
@@ -252,7 +269,7 @@ class InferenceClient:
             f"/{SERVICE_NAME}/GenerateStream",
             request_serializer=_identity,
             response_deserializer=_identity,
-        )(_json_bytes({"prompt": prompt, **kw}))
+        )(_json_bytes({"prompt": prompt, **kw}), metadata=_trace_metadata())
         async for frame in stream:
             yield json.loads(frame)
 

@@ -23,7 +23,21 @@ from typing import Any, Callable
 import grpc
 import grpc.aio
 
+from gofr_tpu.tracing.trace import extract_traceparent
+
 GRPC_STATUS_LABELS = {True: "OK", False: "ERROR"}
+
+
+def _remote_trace(context: Any) -> tuple[str, str] | None:
+    """Inbound W3C tracecontext from gRPC metadata: the ``traceparent``
+    key carries the same header value HTTP does (grpc/log.go:179-202) —
+    the server span continues the caller's trace instead of rooting a
+    disconnected one."""
+    try:
+        metadata = dict(context.invocation_metadata() or ())
+    except Exception:
+        return None
+    return extract_traceparent(metadata.get("traceparent"))
 
 
 def _is_probe(method: str) -> bool:
@@ -91,7 +105,12 @@ class _ObservabilityInterceptor(grpc.aio.ServerInterceptor):
                         "server draining; retry on another replica",
                     )
                 start = time.perf_counter()
-                span = container.tracer.start_span(f"grpc {method}", kind="server")
+                remote = _remote_trace(context)
+                span = container.tracer.start_span(
+                    f"grpc {method}", kind="server",
+                    remote_trace_id=remote[0] if remote else None,
+                    remote_span_id=remote[1] if remote else None,
+                )
                 ok = True
                 try:
                     with span:
@@ -126,7 +145,12 @@ class _ObservabilityInterceptor(grpc.aio.ServerInterceptor):
                         "server draining; retry on another replica",
                     )
                 start = time.perf_counter()
-                span = container.tracer.start_span(f"grpc {method}", kind="server")
+                remote = _remote_trace(context)
+                span = container.tracer.start_span(
+                    f"grpc {method}", kind="server",
+                    remote_trace_id=remote[0] if remote else None,
+                    remote_span_id=remote[1] if remote else None,
+                )
                 ok = True
                 try:
                     with span:

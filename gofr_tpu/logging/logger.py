@@ -18,6 +18,7 @@ import time
 from typing import Any, Protocol, runtime_checkable
 
 from gofr_tpu.logging.level import Level, parse_level
+from gofr_tpu.tracing.trace import current_span
 
 _TERMINAL_CLEAR = "\x1b[0m"
 
@@ -99,6 +100,15 @@ class Logger:
         if isinstance(message, PrettyPrint):
             entry["message"] = getattr(message, "__dict__", str(message))
         entry.update({k: v for k, v in kwargs.items() if v is not None})
+        if "trace_id" not in entry:
+            # trace/log correlation: any record emitted under an active
+            # span carries its ids, so `grep <trace_id>` surfaces the
+            # request's structured logs alongside its span tree and
+            # /requestz timeline. Explicit ids (ContextLogger) win.
+            span = current_span()
+            if span is not None:
+                entry["trace_id"] = span.trace_id
+                entry["span_id"] = span.span_id
 
         sink = self._err if level >= Level.ERROR else self._out
         with self._lock:

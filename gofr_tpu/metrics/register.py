@@ -127,24 +127,35 @@ class Gauge(_Instrument):
 class Histogram(_Instrument):
     kind = "histogram"
 
+    # raw observations retained per series for exact percentiles — the
+    # shared instrument replaces ad-hoc private sample rings (the
+    # router's old `_ttfts`), so its percentile must be as precise as
+    # the rings it replaced, not a bucket upper bound
+    RECENT_WINDOW = 512
+
     def __init__(self, name: str, description: str, buckets: tuple[float, ...] = DEFAULT_BUCKETS) -> None:
         super().__init__(name, description)
         self.buckets = tuple(sorted(buckets))
         self._series: dict[tuple, list] = {}  # key -> [bucket_counts, sum, count]
+        self._recent: dict[tuple, Any] = {}   # key -> deque of last-N raw values
 
     def record(self, value: float, labels: dict[str, str]) -> None:
         key = _label_key(labels)
         with self._lock:
             state = self._series.get(key)
             if state is None:
+                import collections
+
                 state = [[0] * len(self.buckets), 0.0, 0]
                 self._series[key] = state
+                self._recent[key] = collections.deque(maxlen=self.RECENT_WINDOW)
             counts, _, _ = state
             for i, ub in enumerate(self.buckets):
                 if value <= ub:
                     counts[i] += 1
             state[1] += value
             state[2] += 1
+            self._recent[key].append(value)
 
     def snapshot(self, labels: dict[str, str] | None = None) -> tuple[float, int]:
         with self._lock:
@@ -152,17 +163,16 @@ class Histogram(_Instrument):
             return (state[1], state[2]) if state else (0.0, 0)
 
     def percentile(self, q: float, labels: dict[str, str] | None = None) -> float:
-        """Approximate percentile from bucket counts (for bench reporting)."""
+        """Exact percentile over the last ``RECENT_WINDOW`` observations
+        of the series (rank-based, like the sample rings this replaced).
+        NaN when the series has no observations."""
         with self._lock:
-            state = self._series.get(_label_key(labels or {}))
-            if not state or state[2] == 0:
+            recent = self._recent.get(_label_key(labels or {}))
+            if not recent:
                 return math.nan
-            counts, _, total = state
-            rank = q * total
-            for i, ub in enumerate(self.buckets):
-                if counts[i] >= rank:
-                    return ub
-            return self.buckets[-1]
+            ordered = sorted(recent)
+        n = len(ordered)
+        return ordered[min(int(q * n), n - 1)]
 
     def expose(self) -> list[str]:
         lines = [f"# HELP {self.name} {self.description}", f"# TYPE {self.name} histogram"]

@@ -29,6 +29,7 @@ class PjrtError(RuntimeError):
 
 _cache_lock = threading.Lock()
 _plugin_cache: dict[str, "PjrtPlugin"] = {}
+_load_failures: dict[str, str] = {}  # path -> first failure, memoized
 
 
 def _lib() -> Any:
@@ -163,8 +164,13 @@ class PjrtPlugin:
     @classmethod
     def load(cls, path: str | None = None) -> "PjrtPlugin":
         """Load (or return the cached) plugin at ``path``. Loads are
-        memoized per path: a plugin stays resident for the process (dlopen
-        handles are not refcount-churned by reconnects)."""
+        memoized per path — FAILURES included: a plugin that failed to
+        initialize (e.g. the real libtpu probing for absent hardware,
+        which can burn ~47 s in retries) fails once per process, not once
+        per reconnect. The health probe on every ``TPUClient.connect``
+        (and the sick-chip suite's per-test fixtures) ride this; a plugin
+        stays resident for the process either way (dlopen handles are not
+        refcount-churned by reconnects)."""
         lib = _lib()
         resolved = path or default_plugin_path()
         if resolved is None:
@@ -173,8 +179,15 @@ class PjrtPlugin:
             cached = _plugin_cache.get(resolved)
             if cached is not None:
                 return cached
-            h = lib.gofr_pjrt_load(resolved.encode())
-            _check(lib, int(h), f"load plugin {resolved}")
+            prior = _load_failures.get(resolved)
+            if prior is not None:
+                raise PjrtError(f"{prior} (memoized failure)")
+            try:
+                h = lib.gofr_pjrt_load(resolved.encode())
+                _check(lib, int(h), f"load plugin {resolved}")
+            except PjrtError as exc:
+                _load_failures[resolved] = str(exc)
+                raise
             plugin = cls(lib, int(h), resolved)
             _plugin_cache[resolved] = plugin
             return plugin

@@ -15,8 +15,13 @@ the unit of concurrency is the *slot*, not the thread. Components:
 - router.py / membership.py: the multi-replica router tier — pubsub
   heartbeat membership, prefix-affinity routing with failover, hedged
   prefill admission (docs/robustness.md "The router plane").
+- timeline.py / device_telemetry.py: the observability layer — per-request
+  lifecycle timelines behind /requestz, and the TPU HBM / duty-cycle
+  poller feeding health, metrics and membership heartbeats
+  (docs/observability.md).
 """
 
+from gofr_tpu.serving.device_telemetry import DeviceTelemetry
 from gofr_tpu.serving.engine import EngineConfig, GenerationResult, ServingEngine
 from gofr_tpu.serving.membership import (
     Heartbeat,
@@ -30,6 +35,7 @@ from gofr_tpu.serving.router import (
     RouterConfig,
 )
 from gofr_tpu.serving.supervisor import EngineSupervisor
+from gofr_tpu.serving.timeline import RequestTimeline, TimelineRecorder
 from gofr_tpu.serving.tokenizer import ByteTokenizer, Tokenizer
 
 __all__ = [
@@ -46,4 +52,7 @@ __all__ = [
     "MembershipTable",
     "ReplicaAnnouncer",
     "Heartbeat",
+    "TimelineRecorder",
+    "RequestTimeline",
+    "DeviceTelemetry",
 ]

@@ -39,6 +39,7 @@ from gofr_tpu.models import llama
 from gofr_tpu.native.runtime import QueueFull, Scheduler
 from gofr_tpu.serving import batch as batch_ops
 from gofr_tpu.serving.shed import QueueWaitEstimator
+from gofr_tpu.serving.timeline import TimelineRecorder
 from gofr_tpu.serving.tokenizer import ByteTokenizer, Tokenizer
 
 DEFAULT_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048)
@@ -83,6 +84,9 @@ class EngineConfig:
     # Mutually exclusive with multi_step > 1 (both are chunking policies).
     spec_tokens: int = 0
     spec_ngram: int = 3
+    # /requestz flight recorder: completed request timelines retained in
+    # the bounded ring (in-flight ones are always all visible)
+    requestz_capacity: int = 256
     # load shedding: reject at submit when the EWMA queue-wait estimate
     # exceeds this many seconds (0 disables the threshold; deadline-aware
     # shedding is always on for requests that carry a deadline)
@@ -134,6 +138,9 @@ class EngineConfig:
             ),
             spec_tokens=int(config.get_or_default("TPU_SPEC_TOKENS", "0")),
             spec_ngram=int(config.get_or_default("TPU_SPEC_NGRAM", "3")),
+            requestz_capacity=int(
+                config.get_or_default("TPU_REQUESTZ_CAPACITY", "256")
+            ),
             shed_max_wait_s=float(config.get_or_default("TPU_SHED_MAX_WAIT_S", "0")),
             drain_deadline_s=float(
                 config.get_or_default("TPU_DRAIN_DEADLINE_S", "30")
@@ -172,7 +179,7 @@ class _Request:
         "id", "prompt_ids", "max_new_tokens", "temperature", "top_k", "top_p",
         "stream_cb", "future", "created", "first_token_at", "tokens", "slot",
         "canceled", "stop_ids", "priority", "dispatched", "deadline",
-        "kv_exhausted",
+        "kv_exhausted", "timeline", "trace_ctx",
     )
 
     def __init__(self, rid: int, prompt_ids: list[int], max_new_tokens: int,
@@ -199,6 +206,10 @@ class _Request:
         # budget: the limit-check retire reports "kv_exhausted", a signal
         # distinct from a legitimate max-tokens "length" stop
         self.kv_exhausted = False
+        # observability rails: the request's flight-recorder timeline and
+        # the caller's trace context (a Span the lifecycle spans hang off)
+        self.timeline: Any = None
+        self.trace_ctx: Any = None
         # absolute perf_counter time the caller stops caring; None = forever
         self.deadline = (self.created + deadline) if deadline else None
 
@@ -295,6 +306,19 @@ class ServingEngine:
         else:
             self._block_steps = 4
         self._sync_every = max(1, int(self.config.decode_sync_every))
+        # the /requestz flight recorder: per-request lifecycle timelines,
+        # stamped only with host-side data already materialized at the
+        # existing sync points (docs/observability.md). Process-lifetime
+        # like the detok executor — a warm restart must not erase the
+        # record of the requests it swept.
+        self.timeline = TimelineRecorder(self.config.requestz_capacity)
+        # engine duty cycle: cumulative busy seconds stamped by the loop
+        # thread (single writer); the device-telemetry poller reads the
+        # delta over its interval (serving/device_telemetry.py)
+        self._busy_s = 0.0
+        # optional DeviceTelemetry poller backref: health_check embeds its
+        # last sample, the membership announcer reads HBM headroom off it
+        self.device_telemetry: Any = None
         # executable-level runtime state (KV storage, per-slot arrays,
         # pipelined-decode device state, admission scheduler) — built by
         # the shared helper so the supervisor's warm restart rebuilds
@@ -750,6 +774,12 @@ class ServingEngine:
         compares it against TPU_ENGINE_STALL_S."""
         return time.monotonic() - self.heartbeat
 
+    def busy_seconds(self) -> float:
+        """Cumulative seconds the loop thread spent doing work (not
+        waiting): the device-telemetry poller derives the engine duty
+        cycle from the delta over its poll interval."""
+        return self._busy_s
+
     @property
     def in_cold_dispatch(self) -> bool:
         """True while the loop is inside a dispatch whose signature has
@@ -809,6 +839,15 @@ class ServingEngine:
             details["kv_pages"] = self.paged_cache.stats()
         if self._prefix_cache is not None:
             details["prefix_cache"] = self._prefix_cache.stats()
+        # the flight recorder's compact latency view: median TTFT /
+        # queue-wait / e2e over the completed ring (phase detail per
+        # request lives at /requestz)
+        details["request_latency"] = self.timeline.latency_summary()
+        if self.device_telemetry is not None:
+            # per-device HBM used/limit + engine duty cycle, as last
+            # polled (serving/device_telemetry.py) — the heartbeat
+            # announcer reads its HBM headroom from the same sample
+            details["device"] = self.device_telemetry.last_sample()
         sup = self._supervisor
         if sup is not None:
             details["supervisor"] = sup.snapshot()
@@ -844,6 +883,7 @@ class ServingEngine:
         priority: int = 0,
         deadline: float | None = None,
         stream_cb: Callable[[int, str, bool], None] | None = None,
+        trace_ctx: Any = None,
     ) -> Any:
         """Thread-safe submit. Returns a concurrent Future resolving to
         GenerationResult. ``stream_cb(token_id, text_piece, done)`` fires per
@@ -851,7 +891,11 @@ class ServingEngine:
         ``deadline`` is the caller's remaining budget in seconds (from the
         HTTP ``X-Request-Timeout`` header or the gRPC deadline): a request
         still queued when it passes is dropped without prefilling (504), one
-        mid-stream retires with finish reason ``deadline_exceeded``."""
+        mid-stream retires with finish reason ``deadline_exceeded``.
+        ``trace_ctx`` is the caller's parent Span (the HTTP/gRPC server
+        span or the router's attempt span): the request's lifecycle spans
+        (queue → prefill/decode/detok) hang off it, and the trace id lands
+        in the request's ``/requestz`` timeline."""
         import concurrent.futures
 
         if self._draining:
@@ -911,6 +955,23 @@ class ServingEngine:
             stop_ids={self.tokenizer.eos_id}, deadline=deadline,
         )
         req.priority = priority
+        # flight-recorder timeline + the queue span, BEFORE any admission
+        # gate that can still reject: a shed/stopped request leaves a
+        # terminal timeline too (the chaos tier audits exactly-one-
+        # terminal over every accepted request id)
+        tl = self.timeline.begin(rid, prompt_tokens=len(prompt_ids))
+        req.timeline = tl
+        req.trace_ctx = trace_ctx
+        if self._tracer is not None:
+            qspan = self._tracer.start_span(
+                "engine.queue", parent=trace_ctx, kind="internal",
+                activate=False,
+            )
+            qspan.set_attribute("request.id", rid)
+            qspan.set_attribute("tokens.prompt", len(prompt_ids))
+            tl.open_span("queue", qspan)
+        elif trace_ctx is not None:
+            tl.trace_id = trace_ctx.trace_id
         # registration + enqueue are ATOMIC w.r.t. warm_restart (same
         # mutex): either the restart's sweep sees this request and
         # requeues/settles it, or this section observes _restarting and
@@ -924,54 +985,63 @@ class ServingEngine:
         # bounded acquire: if another submit is wedged INSIDE a hung
         # scheduler call while holding the mutex, fail fast and retriable
         # instead of piling every client thread up behind it forever
-        if not self._submit_mu.acquire(timeout=5.0):
-            raise ErrorServiceUnavailable(
-                "engine busy; retry on another replica", retry_after=1.0
-            )
         try:
-            if self._restarting:
+            if not self._submit_mu.acquire(timeout=5.0):
                 raise ErrorServiceUnavailable(
-                    "engine restarting; retry", retry_after=1.0
+                    "engine busy; retry on another replica", retry_after=1.0
                 )
-            with self._count_lock:
-                self._by_id[rid] = req
             try:
-                self._sched.submit(rid, len(prompt_ids), max_new, priority)
-            except QueueFull:
+                if self._restarting:
+                    raise ErrorServiceUnavailable(
+                        "engine restarting; retry", retry_after=1.0
+                    )
                 with self._count_lock:
-                    self._by_id.pop(rid, None)
-                if self._metrics:
-                    self._metrics.increment_counter("app_requests_shed_total")
-                raise ErrorTooManyRequests(
-                    retry_after=max(est_wait, 1.0)
-                ) from None
-            except RuntimeError:
-                # "scheduler closed": lost the race against a concurrent
-                # stop()
-                with self._count_lock:
-                    self._by_id.pop(rid, None)
-                raise ErrorServiceUnavailable(
-                    "server stopped; retry on another replica",
-                    retry_after=1.0,
-                ) from None
-            if self._stop_requested:
-                # raced a concurrent stop(): the flag (monotonic, unlike
-                # _restarting) flips BEFORE the leftover sweep, so either
-                # that sweep saw this registration or this re-check sees
-                # the flip — the request cannot strand. (A not-yet-started
-                # engine is fine: submit-then-start is supported.)
-                with self._count_lock:
-                    self._by_id.pop(rid, None)
+                    self._by_id[rid] = req
                 try:
-                    self._sched.cancel(rid)
-                except Exception:
-                    pass
-                raise ErrorServiceUnavailable(
-                    "server stopped; retry on another replica",
-                    retry_after=1.0,
-                )
-        finally:
-            self._submit_mu.release()
+                    self._sched.submit(rid, len(prompt_ids), max_new, priority)
+                except QueueFull:
+                    with self._count_lock:
+                        self._by_id.pop(rid, None)
+                    if self._metrics:
+                        self._metrics.increment_counter("app_requests_shed_total")
+                    raise ErrorTooManyRequests(
+                        retry_after=max(est_wait, 1.0)
+                    ) from None
+                except RuntimeError:
+                    # "scheduler closed": lost the race against a concurrent
+                    # stop()
+                    with self._count_lock:
+                        self._by_id.pop(rid, None)
+                    raise ErrorServiceUnavailable(
+                        "server stopped; retry on another replica",
+                        retry_after=1.0,
+                    ) from None
+                if self._stop_requested:
+                    # raced a concurrent stop(): the flag (monotonic, unlike
+                    # _restarting) flips BEFORE the leftover sweep, so either
+                    # that sweep saw this registration or this re-check sees
+                    # the flip — the request cannot strand. (A not-yet-started
+                    # engine is fine: submit-then-start is supported.)
+                    with self._count_lock:
+                        self._by_id.pop(rid, None)
+                    try:
+                        self._sched.cancel(rid)
+                    except Exception:
+                        pass
+                    raise ErrorServiceUnavailable(
+                        "server stopped; retry on another replica",
+                        retry_after=1.0,
+                    )
+            finally:
+                self._submit_mu.release()
+        except Exception as exc:
+            # the caller gets the raise, but the accepted request id still
+            # owes a terminal timeline — settle the (discarded) future
+            # through the same gate every other path uses. _try_resolve is
+            # exactly-once, so a stop/restart sweep that already settled
+            # this registration cannot double-mark the terminal.
+            self._try_resolve(req, exc=exc)
+            raise
         self._observe_queue(depth + 1)  # this request just joined the queue
         self._wake.set()
         return future
@@ -1058,7 +1128,7 @@ class ServingEngine:
         # loop thread — the old one must exit the moment it thaws instead
         # of racing the replacement over rebuilt state
         while self._running and me is self._thread:
-            self.heartbeat = time.monotonic()
+            self.heartbeat = iter_t0 = time.monotonic()
             chaos.maybe_fail("engine.step")
             if not self._running or me is not self._thread:
                 # stopped or replaced while hung at the chaos point: re-check
@@ -1077,6 +1147,12 @@ class ServingEngine:
                     did_work = True
                 else:
                     self._last_consume_t = None  # idle gap must not skew TPOT
+                # duty-cycle accounting: the iteration so far was WORK
+                # (dispatches, syncs, bookkeeping); the wake wait below is
+                # idle. The telemetry poller divides the busy delta by
+                # wall time (app_engine_duty_cycle). iter_t0, not the
+                # heartbeat — progress points re-stamp that mid-iteration.
+                self._busy_s += time.monotonic() - iter_t0
                 if not did_work:
                     if (self._draining and not self._inflight_q
                             and not any(s is not None for s in self.slots)
@@ -1148,6 +1224,22 @@ class ServingEngine:
                     self._by_id.pop(rid, None)
                 self._expire(req)
                 continue
+            # admission reached: stamp the queue→batch transition and
+            # close the queue span (first stamp wins, so a page-pressure
+            # requeue keeps its original queue-wait truth)
+            tl = req.timeline
+            if tl is not None and "admitted" not in tl.phases:
+                now = time.perf_counter()
+                tl.stamp("admitted")
+                queue_wait = now - req.created
+                qspan = tl.spans.get("queue")
+                if qspan is not None:
+                    qspan.set_attribute("queue.wait_s", round(queue_wait, 6))
+                    qspan.end()
+                if self._metrics:
+                    self._metrics.record_histogram(
+                        "app_request_queue_wait_seconds", queue_wait,
+                    )
             try:
                 self._prefill_into(slot, req)
             except _RequeueRequest:
@@ -1249,9 +1341,20 @@ class ServingEngine:
             cache_key = f"prefill:{bucket}:{len(req.prompt_ids)}:{digest}"
             cached = self._prefix_cache.get(cache_key)
 
-        span = self._span(
-            f"serve.prefill b{bucket}" + (" (prefix hit)" if cached else "")
+        tl = req.timeline
+        if tl is not None:
+            tl.stamp("prefill_start")
+        span = self._req_span(
+            "prefill",
+            f"serve.prefill b{bucket}" + (" (prefix hit)" if cached else ""),
+            req,
         )
+        if tl is not None:
+            pspan = tl.spans.get("prefill")
+            if pspan is not None:
+                pspan.set_attribute("prefill.bucket", bucket)
+                pspan.set_attribute("prefill.prefix_hit", cached is not None)
+                pspan.set_attribute("tokens.prompt", S)
         # bind the KV storage ONCE, before the long dispatch: a warm
         # restart that replaces this thread mid-compute swaps
         # self.paged_cache/self.cache for rebuilt ones — re-reading them
@@ -1326,10 +1429,18 @@ class ServingEngine:
             next(iter(req.stop_ids)) if len(req.stop_ids) == 1 else -1,
         )
 
-        self._shed.observe_ttft(req.first_token_at - req.created)
+        ttft = req.first_token_at - req.created
+        self._shed.observe_ttft(ttft)
+        if tl is not None:
+            # prefill end + first token share the commit instant: the
+            # sampled first token IS the prefill dispatch's last output
+            tl.stamp("prefill_end")
+            tl.stamp("first_token")
+            tl.end_span("prefill")
         if self._metrics:
+            self._metrics.record_histogram("app_ttft_seconds", ttft)
             self._metrics.record_histogram(
-                "app_ttft_seconds", req.first_token_at - req.created
+                "app_request_ttft_seconds", ttft, source="engine",
             )
         self._emit_token(req, first_id)
         self._check_retired()  # stream_cb may have blocked across a restart
@@ -1337,6 +1448,10 @@ class ServingEngine:
             self._retire(slot, "stop")
         elif len(req.tokens) >= req.max_new_tokens:
             self._retire(slot, "length")
+        elif tl is not None and self._tracer is not None:
+            # the request decodes on: open its decode span now — it ends
+            # at terminal settlement with tokens/finish_reason attributes
+            self._req_span("decode", "serve.decode", req)
 
     # -- decode (pipelined N-step blocks) --------------------------------------
     def _decode_step(self) -> bool:
@@ -1524,6 +1639,8 @@ class ServingEngine:
                 if self.slots[slot] is not req:
                     break  # retired mid-chunk: discard the tail
             emitted_total += committed
+            if req.timeline is not None:
+                req.timeline.block(committed)
             # chunk position 0 (the previously emitted token) plus the
             # accepted drafts are now resident KV; the bonus token commits
             # as the NEXT chunk's position 0 — so residency advances by the
@@ -1540,6 +1657,9 @@ class ServingEngine:
         if self._metrics and n_active:
             self._metrics.record_histogram(
                 "app_tpot_seconds", step_time / max(emitted_total / n_active, 1)
+            )
+            self._metrics.record_histogram(
+                "app_decode_block_seconds", step_time
             )
             self._metrics.set_gauge(
                 "app_batch_occupancy", n_active / self.config.max_slots
@@ -1737,10 +1857,19 @@ class ServingEngine:
             n_active += 1
             n_valid = int(packed[slot, rec.steps + 1])
             device_done = bool(packed[slot, rec.steps])
+            committed = 0
             for i in range(n_valid):
                 self._commit_token(slot, req, int(packed[slot, i]))
+                committed += 1
                 if self.slots[slot] is not req:
                     break  # retired mid-block: discard the tail tokens
+            if req.timeline is not None:
+                # flight-recorder stamp at the block's ONE host sync:
+                # COMMITTED tokens only (a mid-block retire discards the
+                # tail — the spec path's `committed` twin), no extra
+                # device read, and no timestamp passed (`now` is
+                # perf_counter; the timeline's clock is monotonic)
+                req.timeline.block(committed)
             if self.slots[slot] is not req:
                 continue
             # committed residency advances by what the device actually
@@ -1771,6 +1900,9 @@ class ServingEngine:
             host_ms = (rec.host_s + (time.perf_counter() - now)) * 1e3
             self._metrics.record_histogram(
                 "app_tpot_seconds", step_time / rec.steps
+            )
+            self._metrics.record_histogram(
+                "app_decode_block_seconds", step_time
             )
             self._metrics.set_gauge(
                 "app_batch_occupancy", n_active / self.config.max_slots
@@ -1868,6 +2000,22 @@ class ServingEngine:
 
     def _retire(self, slot: int, reason: str) -> None:
         req = self.slots[slot]
+        if req is not None and req.timeline is not None:
+            # final residency facts for the decode span, read from the
+            # host mirrors BEFORE the slot is reclaimed (zero device reads)
+            dspan = req.timeline.spans.get("decode")
+            if dspan is not None:
+                resident = int(self.cache_len[slot])
+                dspan.set_attribute(
+                    "batch.size",
+                    sum(1 for s in self.slots if s is not None),
+                )
+                dspan.set_attribute("kv.resident_tokens", resident)
+                if self.paged_cache is not None:
+                    page = self.config.kv_page_size
+                    dspan.set_attribute(
+                        "kv.pages", (resident + page - 1) // page
+                    )
         self.slots[slot] = None
         self.cache_len[slot] = 0
         if self.paged_cache is not None:
@@ -1881,14 +2029,20 @@ class ServingEngine:
                 self._by_id.pop(req.id, None)
             self._finish(req, reason)
 
-    @staticmethod
-    def _try_resolve(req: _Request, value: Any = None,
+    def _try_resolve(self, req: _Request, value: Any = None,
                      exc: Exception | None = None) -> bool:
         """Settle a request's future, tolerant of a concurrent settler:
         done()-then-set is check-then-act, and BOTH sides race — the engine
         thread (_finish/_expire/_fail_all) against drain()/stop() sweeps.
         Losing must never raise InvalidStateError: on the engine thread
-        that would escalate a benign lost race into _fail_all."""
+        that would escalate a benign lost race into _fail_all.
+
+        This is ALSO the one terminal gate for the flight recorder: the
+        settlement winner (and only the winner) marks the request's
+        timeline terminal and force-ends its remaining spans — which is
+        what makes "exactly one terminal phase per request" and "zero
+        leaked spans after drain" chaos-auditable invariants instead of
+        per-call-site discipline."""
         if req.future.done():
             return False
         try:
@@ -1896,9 +2050,41 @@ class ServingEngine:
                 req.future.set_exception(exc)
             else:
                 req.future.set_result(value)
-            return True
         except Exception:
             return False  # the other settler won the race
+        self._record_terminal(req, value, exc)
+        return True
+
+    @staticmethod
+    def _terminal_reason(value: Any, exc: Exception | None) -> str:
+        if value is not None:
+            return getattr(value, "finish_reason", "stop")
+        if isinstance(exc, ErrorDeadlineExceeded):
+            return "deadline_exceeded"
+        if isinstance(exc, ErrorTooManyRequests):
+            return "shed"
+        if isinstance(exc, ErrorServiceUnavailable):
+            return "unavailable"
+        if isinstance(exc, ErrorRequestEntityTooLarge):
+            return "too_large"
+        return "error"
+
+    def _record_terminal(self, req: _Request, value: Any,
+                         exc: Exception | None) -> None:
+        tl = req.timeline
+        if tl is None:
+            return
+        dspan = tl.spans.get("decode")
+        if dspan is not None:
+            dspan.set_attribute("tokens.out", len(req.tokens))
+            dspan.set_attribute("decode.blocks", tl.decode_blocks)
+        reason = self._terminal_reason(value, exc)
+        # snapshot: the engine thread can be opening a span concurrently
+        # with a sweep thread settling (the lost opener re-closes, above)
+        for span in list(tl.spans.values()):
+            if span.end_ns is None:  # ended spans are already exported
+                span.set_attribute("request.finish_reason", reason)
+        self.timeline.finish(tl, reason)
 
     def _settle_future(self, req: _Request, exc: Exception) -> None:
         """Fail a request's future from OUTSIDE the engine thread. Fires
@@ -1933,6 +2119,13 @@ class ServingEngine:
         out_ids = [t for t in req.tokens if t not in req.stop_ids]
         ttft = (req.first_token_at - req.created) if req.first_token_at else 0.0
         duration = now - req.created
+        if self._metrics:
+            self._metrics.record_histogram("app_request_e2e_seconds", duration)
+        # the detok/settlement span covers the off-engine-thread tail:
+        # full-text detokenization, the terminal stream frame, future
+        # resolution — it ends at the terminal mark inside _try_resolve
+        if self._tracer is not None:
+            self._req_span("detok", "serve.detok", req)
 
         def settle() -> None:
             # full-text detokenization + terminal frame + future settlement
@@ -1953,7 +2146,15 @@ class ServingEngine:
                     req.stream_cb(-1, "", True)
                 except Exception:
                     pass
-            self._try_resolve(req, value=result)
+            if req.timeline is not None:
+                req.timeline.stamp("detok_done")
+            if not self._try_resolve(req, value=result) and \
+                    req.timeline is not None:
+                # a drain/stop sweep won the settlement race and closed
+                # the spans BEFORE this path opened its decode/detok
+                # spans — close again so nothing opened after the
+                # sweep's pass can leak (close_spans is idempotent)
+                req.timeline.close_spans()
 
         if not self._submit_detok(settle):
             # executor already shut down (stopping engine): settle inline —
@@ -2185,9 +2386,24 @@ class ServingEngine:
                 depth = self._sched.stats()["queue_depth"]
             self._metrics.set_gauge("app_batch_queue_depth", depth)
 
-    def _span(self, name: str):
-        import contextlib
-
-        if self._tracer is not None:
-            return self._tracer.start_span(name, kind="internal")
-        return contextlib.nullcontext()
+    def _req_span(self, key: str, name: str, req: _Request) -> Any:
+        """Open a lifecycle span for one request, parented on its queue
+        span (the tree reads caller → engine.queue → prefill/decode/detok)
+        or the caller's trace context. Registered on the request's
+        timeline so terminal settlement force-ends whatever a fault path
+        left open. Returns a context manager either way (nullcontext when
+        tracing is off); ``activate=False`` keeps the engine thread's
+        contextvars untouched."""
+        if self._tracer is None:
+            return contextlib.nullcontext()
+        tl = req.timeline
+        parent = (
+            tl.spans.get("queue") if tl is not None else None
+        ) or req.trace_ctx
+        span = self._tracer.start_span(
+            name, parent=parent, kind="internal", activate=False
+        )
+        span.set_attribute("request.id", req.id)
+        if tl is not None:
+            tl.open_span(key, span)
+        return span

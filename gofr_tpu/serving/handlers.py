@@ -1,5 +1,6 @@
 """Ready-made serving handlers: /generate (JSON + SSE stream), /embed,
-/v1/models — the endpoints BASELINE.json configs[1..2] measure.
+/v1/models, /requestz — the endpoints BASELINE.json configs[1..2]
+measure, plus the request flight recorder (docs/observability.md).
 
 Wire-up (mirrors the reference's route ergonomics)::
 
@@ -19,11 +20,13 @@ import json
 from typing import Any
 
 from gofr_tpu.http.errors import (
+    ErrorEntityNotFound,
     ErrorInvalidParam,
     ErrorMissingParam,
     HTTPError,
 )
 from gofr_tpu.http.responder import WireResponse
+from gofr_tpu.tracing.trace import current_span
 
 
 @dataclasses.dataclass
@@ -79,6 +82,9 @@ def register_generation_routes(app: Any, engine: Any, prefix: str = "",
         body = ctx.bind(GenerateRequest)
         kw = _validated_generate_kwargs(body)
         kw["deadline"] = deadline_from_ctx(ctx)
+        # hang the engine's lifecycle spans off the request's server span
+        # (which carries the inbound W3C traceparent when one was sent)
+        kw["trace_ctx"] = current_span()
         if body.stream:
             return _sse_response(engine, body.prompt, kw)
         result = await engine.generate(body.prompt, **kw)
@@ -112,6 +118,7 @@ def register_generation_routes(app: Any, engine: Any, prefix: str = "",
 
     app.post(prefix + "/generate", generate)
     app.get(prefix + "/v1/models", models)
+    register_requestz_routes(app, engine, prefix + "/requestz")
 
 
 def _sse_response(engine: Any, prompt: str, kw: dict) -> WireResponse:
@@ -209,6 +216,7 @@ def register_generation_ws(app: Any, engine: Any, path: str = "/ws/generate",
         body = ctx.bind(GenerateRequest)
         kw = _validated_generate_kwargs(body)
         kw["deadline"] = deadline_from_ctx(ctx)
+        kw["trace_ctx"] = current_span()
         n = 0
         final: dict = {}
         try:
@@ -235,6 +243,45 @@ def register_generation_ws(app: Any, engine: Any, path: str = "/ws/generate",
         return summary
 
     app.websocket(path, ws_generate)
+
+
+def register_requestz_routes(app: Any, engine: Any,
+                             path: str = "/requestz") -> None:
+    """The request flight recorder (docs/observability.md): GET
+    ``/requestz`` returns every in-flight request timeline plus the
+    bounded ring of recently completed ones; ``/requestz/<request_id>``
+    returns one timeline in full. Pure host-side data stamped at points
+    the engine thread already touches — scraping this view costs zero
+    device syncs. Registered automatically by
+    ``register_generation_routes``; callable directly for bare engines."""
+    recorder = getattr(engine, "timeline", None)
+
+    async def requestz(ctx: Any):
+        if recorder is None:
+            return {"in_flight": [], "completed": [],
+                    "error": "engine has no timeline recorder"}
+        raw_limit = ctx.param("limit")
+        try:
+            limit = int(raw_limit) if raw_limit else 64
+        except ValueError:
+            raise ErrorInvalidParam("limit") from None
+        return recorder.snapshot(limit=limit)
+
+    async def requestz_one(ctx: Any):
+        if recorder is None:
+            raise ErrorEntityNotFound("timeline", ctx.path_param("request_id"))
+        raw = ctx.path_param("request_id")
+        try:
+            rid = int(raw)
+        except ValueError:
+            raise ErrorInvalidParam("request_id") from None
+        tl = recorder.get(rid)
+        if tl is None:
+            raise ErrorEntityNotFound("timeline", raw)
+        return tl.to_dict()
+
+    app.get(path, requestz)
+    app.get(path + "/{request_id}", requestz_one)
 
 
 def register_router_routes(app: Any, router: Any,

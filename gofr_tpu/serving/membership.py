@@ -211,6 +211,16 @@ class MembershipTable:
                 return (float("inf"), 0)
             return (view.queue_wait_s, view.queue_depth)
 
+    def headroom_of(self, replica_id: str) -> tuple[float, float | None]:
+        """(kv_free_frac, hbm_free_frac) as last reported — the router's
+        HBM-pressure spill reads these; hbm is None when the replica
+        publishes no device-telemetry signal."""
+        with self._mu:
+            view = self._replicas.get(replica_id)
+            if view is None:
+                return (1.0, None)
+            return (view.kv_free_frac, view.hbm_free_frac)
+
     def candidates(self, now: float | None = None) -> list[str]:
         """Replica ids eligible for NEW work: every UP replica (least
         estimated wait first); when no UP replica exists, SUSPECT
@@ -313,7 +323,15 @@ class ReplicaAnnouncer:
         depth = int(details.get("queue_depth", 0))
         ewma = float(shed.get("ewma_request_s", 0.0))
         waves = depth / max(int(slots_total) or 1, 1)
-        hbm = self._hbm_headroom() if self._hbm_headroom is not None else None
+        if self._hbm_headroom is not None:
+            hbm = self._hbm_headroom()
+        else:
+            # default wiring: the engine's device-telemetry poller
+            # (serving/device_telemetry.py) publishes real HBM headroom —
+            # the router's spill decisions act on actual device pressure,
+            # not a permanently-stubbed None
+            poller = getattr(self.engine, "device_telemetry", None)
+            hbm = poller.hbm_headroom() if poller is not None else None
         with self._seq_mu:
             self._seq += 1
             seq = self._seq

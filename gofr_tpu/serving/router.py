@@ -52,11 +52,13 @@ from gofr_tpu.http.errors import (
     ErrorServiceUnavailable,
     ErrorTooManyRequests,
 )
+from gofr_tpu.metrics.register import Histogram
 from gofr_tpu.serving import membership as ms
 from gofr_tpu.service.options import (
     CircuitBreakerError,
     retry_after_from_headers,
 )
+from gofr_tpu.tracing.trace import current_span, format_traceparent
 
 # The typed-retriable error set: ONLY these may trigger a failover
 # re-route or be swallowed while a better attempt lives. Everything else
@@ -98,6 +100,11 @@ class RouterConfig:
     # observed p99 — hedging inside normal latency doubles prefill load
     # for nothing
     hedge_from_p99: bool = True
+    # affine replica's reported HBM headroom (membership heartbeat
+    # hbm_free_frac, fed by the device-telemetry poller) below this
+    # fraction → spill like queue-wait pressure; 0 disables. Replicas
+    # that report no HBM signal are never spilled on it.
+    spill_hbm_frac: float = 0.05
     heartbeat_topic: str = ms.HEARTBEAT_TOPIC
 
     def __post_init__(self) -> None:
@@ -131,6 +138,9 @@ class RouterConfig:
             hedge_from_p99=config.get_or_default(
                 "TPU_ROUTER_HEDGE_P99", "true"
             ).lower() in ("1", "true", "yes"),
+            spill_hbm_frac=float(
+                config.get_or_default("TPU_ROUTER_SPILL_HBM_FRAC", "0.05")
+            ),
             heartbeat_topic=config.get_or_default(
                 "TPU_ROUTER_HEARTBEAT_TOPIC", ms.HEARTBEAT_TOPIC
             ),
@@ -236,7 +246,8 @@ class HTTPReplica:
         self._next_rid = 0
 
     def submit(self, prompt: str | list[int], *, deadline: float | None = None,
-               stream_cb: Any = None, **kw: Any) -> Any:
+               stream_cb: Any = None, trace_ctx: Any = None,
+               **kw: Any) -> Any:
         with self._rid_mu:
             self._next_rid += 1
             rid = self._next_rid
@@ -248,9 +259,17 @@ class HTTPReplica:
         for key in ("temperature", "top_k", "top_p"):
             if kw.get(key):
                 payload[key] = kw[key]
-        headers = (
-            {"X-Request-Timeout": f"{deadline:.3f}"} if deadline else None
-        )
+        headers: dict[str, str] = {}
+        if deadline:
+            headers["X-Request-Timeout"] = f"{deadline:.3f}"
+        ctx_span = trace_ctx if trace_ctx is not None else current_span()
+        if ctx_span is not None:
+            # W3C propagation over the remote transport: the replica's
+            # HTTP middleware continues this trace, so the cross-process
+            # span tree stays connected
+            headers["traceparent"] = format_traceparent(ctx_span)
+        if not headers:
+            headers = None
 
         def run() -> None:
             try:
@@ -322,17 +341,20 @@ class _RouterRequest:
     attempt owns the client-visible stream, how many tokens crossed."""
 
     def __init__(self, rid: int, prompt: Any, kw: dict[str, Any],
-                 stream_cb: Any, deadline_abs: float | None) -> None:
+                 stream_cb: Any, deadline_abs: float | None,
+                 trace_ctx: Any = None) -> None:
         self.rid = rid
         self.prompt = prompt
         self.kw = kw
         self.stream_cb = stream_cb
         self.deadline_abs = deadline_abs
+        self.trace_ctx = trace_ctx  # parent Span the attempt spans hang off
         self.future: Any = concurrent.futures.Future()
         self.future.request_id = rid
         self.mu = threading.Lock()
         self.tried: list[str] = []
         self.live: dict[str, Any] = {}   # replica_id -> replica future
+        self.spans: dict[str, Any] = {}  # replica_id -> open attempt span
         self.winner: str | None = None
         self.first_token_at: float | None = None
         self.submitted_at = time.monotonic()
@@ -357,11 +379,13 @@ class Router:
         broker: Any = None,
         metrics: Any = None,
         logger: Any = None,
+        tracer: Any = None,
     ) -> None:
         self.config = config or RouterConfig()
         self.broker = broker
         self._metrics = metrics
         self._logger = logger
+        self._tracer = tracer
         self.membership = ms.MembershipTable(
             suspect_after_s=self.config.suspect_after_s or 3.0,
             down_after_s=self.config.down_after_s or 10.0,
@@ -378,8 +402,12 @@ class Router:
         )
         self._stop = threading.Event()
         self._consumer: threading.Thread | None = None
-        self._ttft_mu = threading.Lock()
-        self._ttfts: list[float] = []  # bounded ring, newest appended
+        # TTFT observations land in the SHARED registered
+        # app_request_ttft_seconds histogram (label source=router) — one
+        # series serves /metrics AND the hedge p99 floor. The private
+        # instrument only backs routers wired without a metrics manager
+        # (unit tests), through the identical Histogram type.
+        self._private_ttft: Histogram | None = None
         # counters mirrored into /routerz (metrics keep the canonical
         # series; these make the health view self-contained). Guarded by
         # _stats_mu: they are bumped from caller threads, the failover
@@ -401,7 +429,7 @@ class Router:
         self._metrics = metrics
 
     def use_tracer(self, tracer: Any) -> None:
-        pass
+        self._tracer = tracer
 
     def connect(self) -> None:
         pass
@@ -524,10 +552,16 @@ class Router:
         if affine in routable:
             wait, _depth = self.membership.load_of(affine)
             cap = self.config.spill_wait_s
-            if cap > 0 and wait > cap:
+            hbm_cap = self.config.spill_hbm_frac
+            _kv_free, hbm_free = self.membership.headroom_of(affine)
+            if (cap > 0 and wait > cap) or (
+                hbm_cap > 0 and hbm_free is not None and hbm_free < hbm_cap
+            ):
                 # load-aware spill: the affine replica is healthy but
-                # queued past the bound — one cold prefill elsewhere
-                # beats queueing behind its backlog
+                # queued past the bound — or its heartbeat reports real
+                # HBM pressure (device-telemetry hbm_free_frac below the
+                # floor): one cold prefill elsewhere beats queueing
+                # behind its backlog or OOMing its pools
                 routable = [r for r in routable if r != affine] + [affine]
                 spilled = True
             else:
@@ -540,6 +574,7 @@ class Router:
         *,
         deadline: float | None = None,
         stream_cb: Callable[[int, str, bool], None] | None = None,
+        trace_ctx: Any = None,
         **kw: Any,
     ) -> Any:
         """Route a request to a replica; returns a Future resolving to
@@ -548,7 +583,9 @@ class Router:
         router exactly like an engine. The deadline is the caller's
         remaining budget in seconds; across failovers the ORIGINAL
         absolute deadline is preserved — a re-route never resets the
-        clock."""
+        clock. ``trace_ctx`` (or the caller's active span) parents the
+        per-attempt router spans, and propagates to each replica —
+        in-process directly, over the wire as a W3C ``traceparent``."""
         with self._req_mu:
             self._next_rid += 1
             rid = self._next_rid
@@ -556,7 +593,10 @@ class Router:
             time.monotonic() + deadline
             if deadline is not None and deadline > 0 else None
         )
-        req = _RouterRequest(rid, prompt, dict(kw), stream_cb, deadline_abs)
+        req = _RouterRequest(
+            rid, prompt, dict(kw), stream_cb, deadline_abs,
+            trace_ctx=trace_ctx if trace_ctx is not None else current_span(),
+        )
         candidates, spilled = self._candidates_for(prompt)
         if not candidates:
             with self._stats_mu:
@@ -599,10 +639,12 @@ class Router:
                 with self._req_mu:
                     self._requests.pop(rid, None)
 
-    def _submit_attempt(self, req: _RouterRequest, replica_id: str) -> Any:
+    def _submit_attempt(self, req: _RouterRequest, replica_id: str,
+                        kind: str = "primary") -> Any:
         """One submission to one replica. Raises the replica's admission
         error; the callers decide whether it is retriable (submit's
-        candidate loop / the failover path)."""
+        candidate loop / the failover path). ``kind`` annotates the
+        attempt span: primary, failover, or hedge."""
         remaining = req.remaining()
         if remaining is not None and remaining <= 0:
             raise ErrorDeadlineExceeded(
@@ -615,13 +657,36 @@ class Router:
                 f"replica {replica_id} has no handle", retry_after=1.0
             )
         chaos.maybe_fail("router.route")
+        span = None
+        if self._tracer is not None:
+            span = self._tracer.start_span(
+                "router.attempt", parent=req.trace_ctx, kind="client",
+                activate=False,
+            )
+            span.set_attribute("request.id", req.rid)
+            span.set_attribute("replica.id", replica_id)
+            span.set_attribute("attempt.kind", kind)
         cb = self._attempt_cb(req, replica_id)
-        replica_future = handle.submit(
-            req.prompt, deadline=remaining, stream_cb=cb, **req.kw
-        )
+        submitted = False
+        try:
+            replica_future = handle.submit(
+                req.prompt, deadline=remaining, stream_cb=cb,
+                trace_ctx=span if span is not None else req.trace_ctx,
+                **req.kw,
+            )
+            submitted = True
+        finally:
+            # finally, not an except clause: the router-retry-untyped rule
+            # pins WHICH errors may be handled here, and an admission
+            # failure of any type must not leak the attempt span
+            if not submitted and span is not None:
+                span.set_attribute("attempt.outcome", "admission-failed")
+                span.end()
         with req.mu:
             req.tried.append(replica_id)
             req.live[replica_id] = replica_future
+            if span is not None:
+                req.spans[replica_id] = span
         with self._stats_mu:
             self.routed_total += 1
             self.routes_by_replica[replica_id] = (
@@ -694,9 +759,16 @@ class Router:
         from here."""
         with req.mu:
             req.live.pop(replica_id, None)
+            span = req.spans.pop(replica_id, None)
             live_others = bool(req.live)
             winner = req.winner
         exc = replica_future.exception()
+        if span is not None:
+            span.set_attribute(
+                "attempt.outcome",
+                "ok" if exc is None else f"failed:{type(exc).__name__}",
+            )
+            span.end()
         if exc is None:
             result = replica_future.result()
             with req.mu:
@@ -761,7 +833,7 @@ class Router:
             last_error: Exception = cause
             for replica_id in ordered:
                 try:
-                    self._submit_attempt(req, replica_id)
+                    self._submit_attempt(req, replica_id, kind="failover")
                     return
                 except RETRIABLE_ERRORS as exc:
                     last_error = exc
@@ -778,6 +850,24 @@ class Router:
             self._settle(req, error=exc, replica_id=None)
 
     # -- hedging ---------------------------------------------------------------
+    _TTFT_METRIC = "app_request_ttft_seconds"
+    _TTFT_LABELS = {"source": "router"}
+
+    def _ttft_histogram(self) -> Histogram:
+        """The shared registered TTFT histogram (container/container.py)
+        when a metrics manager is wired; a private instance of the SAME
+        instrument type otherwise — either way ``percentile()`` is the
+        one percentile implementation (no private sample ring)."""
+        if self._metrics is not None:
+            inst = self._metrics.get(self._TTFT_METRIC)
+            if isinstance(inst, Histogram):
+                return inst
+        if self._private_ttft is None:
+            self._private_ttft = Histogram(
+                self._TTFT_METRIC, "router-observed time to first token"
+            )
+        return self._private_ttft
+
     def hedge_delay(self) -> float:
         """The armed hedge delay: the configured floor, raised to the
         observed TTFT p99 once enough samples exist (hedging inside
@@ -787,18 +877,17 @@ class Router:
             return 0.0
         if not self.config.hedge_from_p99:
             return base
-        with self._ttft_mu:
-            n = len(self._ttfts)
-            if n < 20:
-                return base
-            ordered = sorted(self._ttfts)
-        return max(base, ordered[min(int(0.99 * n), n - 1)])
+        hist = self._ttft_histogram()
+        _, n = hist.snapshot(self._TTFT_LABELS)
+        if n < 20:
+            return base
+        return max(base, hist.percentile(0.99, self._TTFT_LABELS))
 
     def _observe_ttft(self, seconds: float) -> None:
-        with self._ttft_mu:
-            self._ttfts.append(seconds)
-            if len(self._ttfts) > 256:
-                del self._ttfts[: len(self._ttfts) - 256]
+        # source=router keeps the router's submit→first-token series
+        # distinct from the engine's admission-side TTFT in the shared
+        # histogram — the hedge floor must key on what the CLIENT waits
+        self._ttft_histogram().record(seconds, dict(self._TTFT_LABELS))
 
     def _arm_hedge(self, req: _RouterRequest) -> None:
         delay = self.hedge_delay()
@@ -835,7 +924,7 @@ class Router:
             if replica_id in tried:
                 continue
             try:
-                self._submit_attempt(req, replica_id)
+                self._submit_attempt(req, replica_id, kind="hedge")
             except RETRIABLE_ERRORS:
                 continue
             except ErrorDeadlineExceeded:
@@ -859,8 +948,14 @@ class Router:
             req.hedge_timer = None
             leftovers = list(req.live.items())
             req.live = {}
+            stray_spans = list(req.spans.values())
+            req.spans = {}
         if timer is not None:
             timer.cancel()
+        for span in stray_spans:
+            # normally ended by each attempt's done-callback; a handle
+            # whose future never settles must not leak its span
+            span.end()
         for lrid, lfut in leftovers:
             self._cancel_attempt(lrid, lfut)
         with self._req_mu:
@@ -941,6 +1036,7 @@ class Router:
                 "affinity_prefix_tokens": self.config.affinity_prefix_tokens,
                 "vnodes": self.config.vnodes,
                 "max_failovers": self.config.max_failovers,
+                "spill_hbm_frac": self.config.spill_hbm_frac,
                 "hedge_delay_s": self.config.hedge_delay_s,
                 "hedge_delay_armed_s": round(self.hedge_delay(), 4),
             },

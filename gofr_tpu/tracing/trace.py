@@ -90,17 +90,25 @@ class Span:
         return (end - self.start_ns) / 1e3
 
     def end(self) -> None:
-        if self.end_ns is not None:
-            return
-        self.end_ns = time.time_ns()
+        # check-and-set under the lock: concurrent enders are an expected
+        # path (a drain/stop sweep force-closing a request's spans while
+        # the engine thread exits its `with span:` block) — both passing
+        # the guard would double-export and double-decrement the live
+        # count, sending Tracer.open_spans() negative
+        with self._lock:
+            if self.end_ns is not None:
+                return
+            self.end_ns = time.time_ns()
         if self._token is not None:
             try:
                 _current_span.reset(self._token)
             except ValueError:
                 pass  # ended in a different context than it started
             self._token = None
-        if self._tracer is not None and self.sampled:
-            self._tracer._on_end(self)
+        if self._tracer is not None:
+            self._tracer._on_close(self)  # live-span accounting, always
+            if self.sampled:
+                self._tracer._on_end(self)
 
     def __enter__(self) -> "Span":
         return self
@@ -124,6 +132,12 @@ class Tracer:
         self.service_name = service_name
         self.processor = processor
         self.sample_ratio = max(0.0, min(1.0, sample_ratio))
+        # live-span accounting: started minus ended. The chaos tier's
+        # leaked-span check asserts this returns to zero after drain() —
+        # an instrumentation path that opens a span and loses it on a
+        # fault would otherwise grow silently forever.
+        self._live_mu = threading.Lock()
+        self._live = 0
 
     def start_span(
         self,
@@ -146,6 +160,8 @@ class Tracer:
             trace_id, parent_id = _rand_hex(16), None
             sampled = self._sample(trace_id)
         span = Span(name, trace_id, _rand_hex(8), parent_id, self, kind=kind, sampled=sampled)
+        with self._live_mu:
+            self._live += 1
         if activate:
             span._token = _current_span.set(span)
         return span
@@ -161,6 +177,21 @@ class Tracer:
     def _on_end(self, span: Span) -> None:
         if self.processor is not None:
             self.processor.on_end(span)
+
+    def _on_close(self, span: Span) -> None:
+        with self._live_mu:
+            self._live -= 1
+
+    def open_spans(self) -> int:
+        """Spans started but not yet ended — the leaked-span audit."""
+        with self._live_mu:
+            return self._live
+
+    def set_sample_ratio(self, ratio: float) -> None:
+        """Live sample-ratio adjustment (the remote trace-ratio poller,
+        logging/remote.py): clamped to [0, 1], applies to spans started
+        after the call."""
+        self.sample_ratio = max(0.0, min(1.0, float(ratio)))
 
     def shutdown(self) -> None:
         if self.processor is not None:

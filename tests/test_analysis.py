@@ -256,6 +256,98 @@ def test_metric_dynamic_name(tmp_path):
     assert [f.rule for f in findings] == ["metric-dynamic-name"]
 
 
+def test_metric_unregistered_via_set_and_record(tmp_path):
+    """Every facade verb is covered — set_gauge and record_histogram of a
+    never-registered name are the PR 1 bug class, not just counters."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/a.py": (
+            "def use(m):\n"
+            '    m.set_gauge("app_never_gauge", 1.0)\n'
+            '    m.record_histogram("app_never_hist", 0.5)\n'
+        ),
+    })
+    assert [f.rule for f in findings] == [
+        "metric-unregistered", "metric-unregistered",
+    ]
+
+
+def test_metric_register_site_enforced_with_container(tmp_path):
+    """Full-tree runs (container/container.py present): a metric used in
+    one subsystem but registered only in an UNRELATED module is flagged —
+    a process that never imports the registering module silently loses
+    the series."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/container/container.py": (
+            'def reg(m):\n    m.new_gauge("app_info", "d")\n'
+        ),
+        "gofr_tpu/datasource/redis/client.py": (
+            'def reg(m):\n    m.new_histogram("app_far_away", "d")\n'
+        ),
+        "gofr_tpu/serving/engine.py": (
+            'def use(m):\n    m.record_histogram("app_far_away", 1.0)\n'
+        ),
+    })
+    assert [f.rule for f in findings] == ["metric-register-site"]
+    assert "app_far_away" in findings[0].message
+
+
+def test_metric_register_site_clean_for_container_and_same_dir(tmp_path):
+    """Negative: registration in container/container.py or in the using
+    file's own directory (self-registering subsystems) is clean."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/container/container.py": (
+            'def reg(m):\n    m.new_histogram("app_catalogued", "d")\n'
+        ),
+        "gofr_tpu/grpcx/server.py": (
+            'def reg(m):\n    m.new_histogram("app_grpc_local", "d")\n'
+        ),
+        "gofr_tpu/grpcx/runtime.py": (
+            "def use(m):\n"
+            '    m.record_histogram("app_catalogued", 1.0)\n'
+            '    m.record_histogram("app_grpc_local", 1.0)\n'
+        ),
+    })
+    assert findings == []
+
+
+def test_metric_register_site_dormant_without_container(tmp_path):
+    """Negative: on a tree without container/container.py (file subsets,
+    fixtures) the site check stays dormant — registration anywhere
+    suffices, as before."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/a.py": 'def reg(m):\n    m.new_counter("app_x", "d")\n',
+        "gofr_tpu/b.py": 'def use(m):\n    m.increment_counter("app_x")\n',
+    })
+    assert findings == []
+
+
+def test_metric_label_cardinality_format_call(tmp_path):
+    """.format()-built label values are as unbounded as f-strings."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/a.py": (
+            "def use(m, rid):\n"
+            '    m.new_histogram("app_h", "d")\n'
+            '    m.record_histogram("app_h", 1.0, "req",\n'
+            '                       "id-{}".format(rid))\n'
+        ),
+    })
+    assert [f.rule for f in findings] == ["metric-label-cardinality"]
+
+
+def test_metric_label_cardinality_bounded_values_clean(tmp_path):
+    """Negative: literal values and bare names (bounded enums) stay
+    clean — only call-site string BUILDING is the cardinality smell."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/a.py": (
+            "def use(m, status):\n"
+            '    m.new_counter("app_c", "d")\n'
+            '    m.increment_counter("app_c", "method", "GET")\n'
+            '    m.increment_counter("app_c", status=status)\n'
+        ),
+    })
+    assert findings == []
+
+
 # ---------------------------------------------------------------- FFI
 def _copy_ffi_fixture(tmp_path) -> str:
     root = tmp_path / "repo"

@@ -55,7 +55,7 @@ def tiny_cfg(max_seq: int = 64) -> llama.LlamaConfig:
     )
 
 
-def make_engine(**cfg_kw) -> ServingEngine:
+def make_engine(tracer=None, **cfg_kw) -> ServingEngine:
     cfg = tiny_cfg(cfg_kw.get("max_seq_len", 64))
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     defaults = dict(
@@ -64,7 +64,8 @@ def make_engine(**cfg_kw) -> ServingEngine:
     )
     defaults.update(cfg_kw)
     return ServingEngine(
-        cfg, params, EngineConfig(**defaults), ByteTokenizer(cfg.vocab_size)
+        cfg, params, EngineConfig(**defaults), ByteTokenizer(cfg.vocab_size),
+        tracer=tracer,
     )
 
 
@@ -228,6 +229,31 @@ def _assert_terminal(outcomes: list, timeout: float = 120.0) -> dict:
     return counts
 
 
+def _assert_timelines_terminal(eng: ServingEngine) -> None:
+    """The flight-recorder invariant (docs/observability.md): after the
+    engine drains, every recorded request left a COMPLETE timeline with
+    exactly one terminal phase — two marks would mean two settlement
+    paths both thought they won; zero means a request vanished without
+    its terminal ever being recorded."""
+    timelines = eng.timeline.all()
+    assert timelines, "no timelines recorded for a workload that ran"
+    stale = [tl.request_id for tl in timelines if not tl.terminal]
+    assert not stale, f"non-terminal timelines after drain: {stale}"
+    bad_marks = {
+        tl.request_id: tl.terminal_marks
+        for tl in timelines if tl.terminal_marks != 1
+    }
+    assert not bad_marks, f"terminal marked != once: {bad_marks}"
+    for tl in timelines:
+        assert "submitted" in tl.phases, tl.to_dict()
+        assert "terminal" in tl.phases, tl.to_dict()
+        # a request that produced tokens must carry the full phase chain
+        if tl.decode_tokens or "first_token" in tl.phases:
+            assert "admitted" in tl.phases, tl.to_dict()
+            assert "prefill_start" in tl.phases, tl.to_dict()
+            assert "prefill_end" in tl.phases, tl.to_dict()
+
+
 def _assert_reclaimed(eng: ServingEngine) -> None:
     deadline = time.time() + 30
     while time.time() < deadline:
@@ -248,10 +274,13 @@ def _assert_reclaimed(eng: ServingEngine) -> None:
 @pytest.mark.parametrize("seed", CHAOS_SEEDS)
 @pytest.mark.parametrize("kv_layout", ["dense", "paged"])
 def test_lifecycle_invariant_under_faults(seed, kv_layout, monkeypatch):
+    from gofr_tpu.tracing import Tracer
+
+    tracer = Tracer("chaos")  # no processor: pure open/close accounting
     kw = dict(kv_layout=kv_layout)
     if kv_layout == "paged":
         kw.update(kv_page_size=8)
-    eng = make_engine(**kw)
+    eng = make_engine(tracer=tracer, **kw)
 
     # pin "expired requests are never prefilled": track born-dead requests
     born_dead: set[int] = set()
@@ -295,6 +324,13 @@ def test_lifecycle_invariant_under_faults(seed, kv_layout, monkeypatch):
         assert eng.drain(deadline_s=60) is True
         assert eng._thread is None or not eng._thread.is_alive()
         assert eng.health_check()["status"] == "DOWN"  # no wedge
+        # observability invariants ride the same storm: every request
+        # left exactly one terminal timeline phase, and no lifecycle
+        # span leaked across a single fault path
+        _assert_timelines_terminal(eng)
+        assert tracer.open_spans() == 0, (
+            f"{tracer.open_spans()} span(s) leaked across the chaos run"
+        )
     finally:
         if eng._running:
             eng.stop()

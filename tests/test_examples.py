@@ -175,6 +175,15 @@ def test_serving_llama_example(boot):
     assert out["data"]["usage"]["completion_tokens"] >= 1
     status, out = fetch(base + "/v1/models")
     assert status == 200 and out["data"]["models"][0]["family"] == "llama"
+    # the flight recorder rides along: the generate above left a
+    # terminal timeline visible at /requestz
+    status, out = fetch(base + "/requestz")
+    assert status == 200
+    done = out["data"]["completed"]
+    assert done and done[0]["finish_reason"] in ("stop", "length")
+    rid = done[0]["request_id"]
+    status, out = fetch(base + f"/requestz/{rid}")
+    assert status == 200 and out["data"]["terminal"] is True
 
 
 def test_sample_cmd_example(capsys):

@@ -35,7 +35,11 @@ def test_histogram_buckets_and_percentile():
     assert 'lat_bucket{le="+Inf"} 4' in text
     assert "lat_count 4" in text
     inst = m.get("lat")
-    assert inst.percentile(0.5) == 0.1
+    # exact rank-based percentile over the recent-sample window (the
+    # shared histogram replaced the router's private TTFT ring, so its
+    # percentile is the real observation, not a bucket upper bound)
+    assert inst.percentile(0.5) == 0.05
+    assert inst.percentile(0.99) == 0.5
 
 
 def test_gauge_set_delete():

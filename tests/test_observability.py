@@ -1,0 +1,590 @@
+"""End-to-end serving observability (docs/observability.md): request
+timelines + /requestz, the connected lifecycle span tree, trace/log
+correlation, device telemetry, prometheus exposition well-formedness,
+and the remote trace-ratio knob."""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import json
+import time
+from types import SimpleNamespace
+
+import jax
+import pytest
+
+from gofr_tpu.logging import new_logger
+from gofr_tpu.metrics import new_metrics_manager
+from gofr_tpu.metrics.promlint import lint_exposition
+from gofr_tpu.models import llama
+from gofr_tpu.serving import (
+    ByteTokenizer,
+    DeviceTelemetry,
+    EngineConfig,
+    Heartbeat,
+    LocalReplica,
+    ReplicaAnnouncer,
+    Router,
+    RouterConfig,
+    ServingEngine,
+)
+from gofr_tpu.serving.timeline import TimelineRecorder
+from gofr_tpu.tracing import InMemoryExporter, Tracer
+from gofr_tpu.tracing.export import SimpleSpanProcessor
+
+
+def tiny_engine(tracer=None, metrics=None, **cfg_kw) -> ServingEngine:
+    cfg = llama.LlamaConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq_len=64,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    defaults = dict(
+        max_slots=2, max_seq_len=64, prefill_buckets=(16,),
+        admission_per_step=2, max_queue=32,
+    )
+    defaults.update(cfg_kw)
+    return ServingEngine(
+        cfg, params, EngineConfig(**defaults), ByteTokenizer(cfg.vocab_size),
+        tracer=tracer, metrics=metrics,
+    )
+
+
+# ------------------------------------------------------------ timelines
+
+def test_timeline_phases_and_derived_latencies():
+    rec = TimelineRecorder(capacity=4)
+    tl = rec.begin(1, prompt_tokens=5)
+    tl.stamp("admitted")
+    tl.stamp("prefill_start")
+    tl.stamp("prefill_end")
+    tl.stamp("first_token")
+    tl.block(4)
+    tl.block(3)
+    assert rec.finish(tl, "stop") is True
+    view = tl.to_dict()
+    assert view["terminal"] and view["finish_reason"] == "stop"
+    assert view["prompt_tokens"] == 5
+    assert view["decode"] == pytest.approx(
+        {"blocks": 2, "tokens": 7, "last_block_ms": view["decode"]["last_block_ms"]}
+    )
+    assert view["queue_wait_ms"] is not None
+    assert view["ttft_ms"] >= view["queue_wait_ms"]
+    assert view["e2e_ms"] >= view["ttft_ms"]
+    order = list(view["phases_ms"])
+    assert order[0] == "submitted" and order[-1] == "terminal"
+
+
+def test_timeline_terminal_exactly_once_counted():
+    rec = TimelineRecorder()
+    tl = rec.begin(7)
+    assert rec.finish(tl, "stop") is True
+    assert rec.finish(tl, "error") is False  # loser counted, not recorded
+    assert tl.finish_reason == "stop"
+    assert tl.terminal_marks == 2  # the audit counter sees the double
+
+
+def test_recorder_ring_bounds_completed_and_keeps_inflight():
+    rec = TimelineRecorder(capacity=3)
+    live = rec.begin(100)
+    for rid in range(1, 6):
+        rec.finish(rec.begin(rid), "stop")
+    snap = rec.snapshot()
+    assert snap["in_flight_count"] == 1
+    assert snap["completed_count"] == 3  # ring dropped the oldest two
+    done_ids = [t["request_id"] for t in snap["completed"]]
+    assert done_ids == [5, 4, 3]  # newest first
+    assert rec.get(100) is live
+    assert rec.get(5) is not None and rec.get(1) is None
+
+
+def test_requestz_routes_serve_timelines():
+    from gofr_tpu.http.errors import ErrorEntityNotFound
+    from gofr_tpu.serving.handlers import register_requestz_routes
+
+    rec = TimelineRecorder()
+    tl = rec.begin(42, prompt_tokens=3, trace_id="ab" * 16)
+    rec.finish(tl, "stop")
+    engine = SimpleNamespace(timeline=rec)
+
+    routes: dict = {}
+    app = SimpleNamespace(
+        get=lambda path, h: routes.__setitem__(path, h),
+        post=lambda path, h: None,
+    )
+    register_requestz_routes(app, engine)
+    assert set(routes) == {"/requestz", "/requestz/{request_id}"}
+
+    ctx = SimpleNamespace(param=lambda k: "", path_param=lambda k: "42")
+    snap = asyncio.run(routes["/requestz"](ctx))
+    assert snap["completed"][0]["request_id"] == 42
+    one = asyncio.run(routes["/requestz/{request_id}"](ctx))
+    assert one["trace_id"] == "ab" * 16
+    missing = SimpleNamespace(param=lambda k: "", path_param=lambda k: "9")
+    with pytest.raises(ErrorEntityNotFound):
+        asyncio.run(routes["/requestz/{request_id}"](missing))
+    # bounded views: limit=0 means ZERO completed entries (not all), and
+    # a non-numeric limit is a 400-class param error, not a 500
+    from gofr_tpu.http.errors import ErrorInvalidParam
+
+    zero = SimpleNamespace(param=lambda k: "0", path_param=lambda k: "42")
+    assert asyncio.run(routes["/requestz"](zero))["completed"] == []
+    bad = SimpleNamespace(param=lambda k: "nope", path_param=lambda k: "42")
+    with pytest.raises(ErrorInvalidParam):
+        asyncio.run(routes["/requestz"](bad))
+
+
+# -------------------------------------------- the end-to-end trace tree
+
+def test_http_traceparent_yields_connected_span_tree_and_correlates():
+    """The acceptance path: an HTTP request with an inbound traceparent
+    produces ONE connected trace spanning router attempt → engine queue →
+    prefill → decode → detok, and the same trace id shows up in the
+    request's /requestz timeline and its structured log records."""
+    from gofr_tpu.http.middleware import (
+        chain,
+        logging_middleware,
+        tracing_middleware,
+    )
+    from gofr_tpu.http.request import Request
+    from gofr_tpu.http.responder import WireResponse
+    from gofr_tpu.tracing.trace import current_span
+
+    exporter = InMemoryExporter()
+    tracer = Tracer("obs-test", SimpleSpanProcessor(exporter))
+    log_sink = io.StringIO()
+    logger = new_logger("INFO", out=log_sink, err=log_sink)
+
+    engine = tiny_engine(tracer=tracer)
+    router = Router(RouterConfig(heartbeat_s=0.05), tracer=tracer)
+    router.add_replica(LocalReplica("r1", engine))
+    router.membership.observe(Heartbeat("r1", 1))
+    engine.start()
+
+    async def generate(req):
+        body = json.loads(req.body)
+        fut = router.submit(
+            body["prompt"], max_new_tokens=4, trace_ctx=current_span(),
+        )
+        result = await asyncio.wrap_future(fut)
+        return WireResponse(
+            status=200, body=json.dumps({"text": result.text}).encode(),
+        )
+
+    handler = chain(generate, [tracing_middleware(tracer),
+                               logging_middleware(logger)])
+    trace_id, caller_span = "a" * 32, "b" * 16
+    req = Request(
+        "POST", "/generate", {},
+        {"traceparent": f"00-{trace_id}-{caller_span}-01"},
+        json.dumps({"prompt": "observability"}).encode(),
+    )
+    try:
+        resp = asyncio.run(handler(req))
+        assert resp.status == 200
+    finally:
+        assert engine.drain(deadline_s=60) is True
+    router.stop()
+
+    spans = {s.name.split()[0]: s for s in exporter.spans}
+    for name in ("POST", "router.attempt", "engine.queue",
+                 "serve.prefill", "serve.decode", "serve.detok"):
+        assert name in spans, (name, sorted(spans))
+    # one trace, rooted at the caller's span id
+    assert {s.trace_id for s in exporter.spans} == {trace_id}
+    server = spans["POST"]
+    assert server.parent_id == caller_span
+    assert spans["router.attempt"].parent_id == server.span_id
+    assert spans["engine.queue"].parent_id == spans["router.attempt"].span_id
+    for leaf in ("serve.prefill", "serve.decode", "serve.detok"):
+        assert spans[leaf].parent_id == spans["engine.queue"].span_id
+    assert spans["router.attempt"].attributes["replica.id"] == "r1"
+    assert spans["router.attempt"].attributes["attempt.outcome"] == "ok"
+    assert spans["serve.decode"].attributes["request.finish_reason"] in (
+        "stop", "length",
+    )
+    assert spans["serve.decode"].attributes["batch.size"] >= 1
+    assert spans["serve.decode"].attributes["kv.resident_tokens"] >= 1
+    assert spans["serve.decode"].attributes["tokens.out"] >= 1
+    # nothing leaked across the happy path either
+    assert tracer.open_spans() == 0
+
+    # /requestz carries the same trace id
+    timelines = engine.timeline.completed()
+    assert len(timelines) == 1
+    assert timelines[0].trace_id == trace_id
+    assert timelines[0].to_dict()["decode"]["tokens"] >= 1
+
+    # ...and so do the structured request logs
+    records = [json.loads(line) for line in log_sink.getvalue().splitlines()]
+    request_logs = [r for r in records if r.get("trace_id") == trace_id]
+    assert request_logs, records
+
+
+def test_engine_spans_parent_on_caller_context_without_router():
+    """Direct engine.submit with a trace_ctx: queue span hangs off it."""
+    exporter = InMemoryExporter()
+    tracer = Tracer("t", SimpleSpanProcessor(exporter))
+    engine = tiny_engine(tracer=tracer)
+    engine.start()
+    try:
+        parent = tracer.start_span("caller", activate=False)
+        engine.submit(
+            "hello", max_new_tokens=2, trace_ctx=parent,
+        ).result(timeout=60)
+        parent.end()
+    finally:
+        engine.drain(deadline_s=60)
+    by_name = {s.name.split()[0]: s for s in exporter.spans}
+    assert by_name["engine.queue"].parent_id == parent.span_id
+    assert by_name["engine.queue"].trace_id == parent.trace_id
+    assert tracer.open_spans() == 0
+
+
+def test_shed_request_leaves_terminal_timeline():
+    """A request rejected at the scheduler still records exactly one
+    terminal phase — the flight recorder covers admission failures."""
+    engine = tiny_engine(max_queue=1)
+    # never started: queued submissions park in the scheduler queue
+    engine.submit("first", max_new_tokens=2)
+    from gofr_tpu.http.errors import ErrorTooManyRequests
+
+    with pytest.raises(ErrorTooManyRequests):
+        for i in range(10):
+            engine.submit(f"overflow {i}", max_new_tokens=2)
+    shed = [
+        tl for tl in engine.timeline.completed()
+        if tl.finish_reason == "shed"
+    ]
+    assert shed and all(tl.terminal_marks == 1 for tl in shed)
+    engine.stop()
+
+
+# ---------------------------------------------------- phase histograms
+
+def test_phase_histograms_recorded_through_registered_names():
+    m = new_metrics_manager()
+    from gofr_tpu.container.container import Container  # registration catalog
+    from gofr_tpu.config import MapConfig
+
+    container = Container(MapConfig({"LOG_LEVEL": "ERROR"}, use_env=False))
+    engine = tiny_engine(metrics=container.metrics_manager)
+    engine.start()
+    try:
+        engine.submit("measure me", max_new_tokens=6).result(timeout=60)
+    finally:
+        engine.drain(deadline_s=60)
+    mm = container.metrics_manager
+    for name in ("app_request_queue_wait_seconds", "app_request_e2e_seconds",
+                 "app_decode_block_seconds"):
+        _total, count = mm.get(name).snapshot()
+        assert count >= 1, name
+    _total, count = mm.get("app_request_ttft_seconds").snapshot(
+        {"source": "engine"}
+    )
+    assert count >= 1
+    container.close()
+
+
+def test_router_hedge_floor_reads_shared_histogram():
+    """Satellite: the private _ttfts ring is gone — the hedge p99 floor
+    reads the registered app_request_ttft_seconds histogram when a
+    metrics manager is wired."""
+    m = new_metrics_manager()
+    m.new_histogram("app_request_ttft_seconds", "ttft")
+    router = Router(RouterConfig(hedge_delay_s=0.01), metrics=m)
+    assert not hasattr(router, "_ttfts")
+    for _ in range(30):
+        router._observe_ttft(0.2)
+    assert router.hedge_delay() == pytest.approx(0.2)
+    # the observations landed in the SHARED registered series
+    _total, count = m.get("app_request_ttft_seconds").snapshot(
+        {"source": "router"}
+    )
+    assert count == 30
+    router.stop()
+
+
+# ------------------------------------------------------ device telemetry
+
+class _FakeDevice:
+    def __init__(self, dev_id: int, used: int, limit: int) -> None:
+        self.id = dev_id
+        self.platform = "tpu"
+        self._stats = {"bytes_in_use": used, "bytes_limit": limit}
+
+    def memory_stats(self):
+        return dict(self._stats)
+
+
+class _FakeEngine:
+    def __init__(self) -> None:
+        self.busy = 0.0
+
+    def busy_seconds(self) -> float:
+        return self.busy
+
+    def health_check(self):
+        return {"status": "UP", "details": {}}
+
+
+def test_device_telemetry_samples_hbm_and_duty(monkeypatch):
+    monkeypatch.setattr(
+        jax, "local_devices",
+        lambda: [_FakeDevice(0, 600, 1000), _FakeDevice(1, 900, 1000)],
+    )
+    m = new_metrics_manager()
+    for name in ("app_tpu_hbm_bytes", "app_tpu_hbm_util",
+                 "app_engine_duty_cycle",
+                 "app_tpu_hbm_used_bytes", "app_tpu_hbm_limit_bytes"):
+        m.new_gauge(name, name)
+    eng = _FakeEngine()
+    tel = DeviceTelemetry(eng, metrics=m, interval_s=60)
+    first = tel.sample()
+    assert "engine_duty_cycle" not in first  # no window on the first poll
+    eng.busy += 1e6  # busy >> wall: duty clamps to 1.0
+    sample = tel.sample()
+    assert sample["hbm_free_frac"] == pytest.approx(0.1)  # tightest device
+    assert sample["engine_duty_cycle"] == 1.0
+    assert m.get("app_tpu_hbm_bytes").value(
+        {"device": "0", "kind": "used"}
+    ) == 600
+    assert m.get("app_tpu_hbm_bytes").value(
+        {"device": "1", "kind": "limit"}
+    ) == 1000
+    assert m.get("app_tpu_hbm_util").value({"device": "1"}) == pytest.approx(0.9)
+    assert m.get("app_engine_duty_cycle").value() == 1.0
+    # the engine backref: health embeds the sample
+    assert eng.device_telemetry is tel
+    assert tel.hbm_headroom() == pytest.approx(0.1)
+
+
+def test_heartbeat_carries_device_telemetry_headroom(monkeypatch):
+    monkeypatch.setattr(jax, "local_devices", lambda: [_FakeDevice(0, 750, 1000)])
+    eng = _FakeEngine()
+    tel = DeviceTelemetry(eng, interval_s=60)
+    tel.sample()
+
+    published: list = []
+    announcer = ReplicaAnnouncer(
+        "r1", eng,
+        publisher=SimpleNamespace(
+            publish=lambda topic, payload: published.append(payload)
+        ),
+    )
+    hb = announcer.compose()
+    assert hb.hbm_free_frac == pytest.approx(0.25)
+    assert announcer.beat() is True
+    assert Heartbeat.from_json(published[0]).hbm_free_frac == pytest.approx(0.25)
+
+
+def test_engine_health_embeds_device_sample_and_busy_counter(monkeypatch):
+    monkeypatch.setattr(jax, "local_devices", lambda: [_FakeDevice(0, 10, 100)])
+    engine = tiny_engine()
+    tel = DeviceTelemetry(engine, interval_s=60)
+    tel.sample()
+    engine.start()
+    try:
+        engine.submit("busy", max_new_tokens=2).result(timeout=60)
+        health = engine.health_check()
+        assert health["details"]["device"]["devices"][0]["hbm_util"] == 0.1
+        assert engine.busy_seconds() > 0.0
+        lat = health["details"]["request_latency"]
+        assert lat["completed"] == 1
+        assert lat["ttft_ms_p50"] > 0 and lat["e2e_ms_p50"] >= lat["ttft_ms_p50"]
+    finally:
+        engine.drain(deadline_s=60)
+
+
+def test_router_spills_on_hbm_pressure():
+    from gofr_tpu.testutil.replica import StubReplicaEngine
+
+    a, b = StubReplicaEngine("a"), StubReplicaEngine("b")
+    router = Router(RouterConfig(heartbeat_s=0.05, spill_hbm_frac=0.1))
+    for stub in (a, b):
+        router.add_replica(LocalReplica(stub.replica_id, stub))
+    router.membership.observe(Heartbeat("a", 1, hbm_free_frac=0.02))
+    router.membership.observe(Heartbeat("b", 1, hbm_free_frac=0.9))
+    # find a prompt affine to the pressured replica, then watch it spill
+    for i in range(200):
+        prompt = f"p{i} shared-prefix"
+        router.membership.observe(Heartbeat("a", 2 + i, hbm_free_frac=0.9))
+        candidates, _ = router._candidates_for(prompt)
+        if candidates and candidates[0] == "a":
+            router.membership.observe(
+                Heartbeat("a", 500 + i, hbm_free_frac=0.02)
+            )
+            spilled_candidates, spilled = router._candidates_for(prompt)
+            assert spilled is True
+            assert spilled_candidates[0] == "b"
+            break
+    else:
+        raise AssertionError("no prompt affine to replica a")
+    router.stop()
+
+
+# --------------------------------------------------- /metrics well-formed
+
+def test_metrics_exposition_is_well_formed_via_scrape():
+    """Tier-1 CI gate: scrape the real /metrics surface of a container
+    with live serving series and validate prometheus text-format
+    invariants (HELP/TYPE pairing, cumulative buckets, no duplicate
+    series)."""
+    from gofr_tpu.config import MapConfig
+    from gofr_tpu.container.container import Container
+    from gofr_tpu.metrics.server import MetricsHandler
+
+    container = Container(MapConfig({"LOG_LEVEL": "ERROR"}, use_env=False))
+    m = container.metrics_manager
+    m.record_histogram("app_request_ttft_seconds", 0.12, source="engine")
+    m.record_histogram("app_request_ttft_seconds", 0.3, source="router")
+    m.record_histogram("app_request_queue_wait_seconds", 0.01)
+    m.record_histogram("app_request_e2e_seconds", 1.2)
+    m.record_histogram("app_decode_block_seconds", 0.02)
+    m.set_gauge("app_tpu_hbm_bytes", 1024, device="0", kind="used")
+    m.set_gauge("app_tpu_hbm_util", 0.5, device="0")
+    m.set_gauge("app_engine_duty_cycle", 0.8)
+    m.increment_counter("app_requests_shed_total")
+
+    handler = MetricsHandler(container)
+    resp = asyncio.run(handler(SimpleNamespace(path="/metrics", method="GET")))
+    text = resp.body.decode()
+    assert "app_request_ttft_seconds_bucket" in text
+    assert "app_tpu_hbm_util" in text
+    problems = lint_exposition(text)
+    assert problems == [], "\n".join(problems)
+    container.close()
+
+
+def test_promlint_catches_malformed_expositions():
+    # missing TYPE/HELP
+    assert lint_exposition("orphan_metric 1\n")
+    # duplicate series
+    dup = (
+        "# HELP x d\n# TYPE x counter\nx{a=\"1\"} 1\nx{a=\"1\"} 2\n"
+    )
+    assert any("duplicate series" in p for p in lint_exposition(dup))
+    # non-cumulative histogram buckets
+    bad_hist = (
+        "# HELP h d\n# TYPE h histogram\n"
+        'h_bucket{le="0.1"} 5\nh_bucket{le="1"} 3\nh_bucket{le="+Inf"} 3\n'
+        "h_sum 1\nh_count 3\n"
+    )
+    assert any("not cumulative" in p for p in lint_exposition(bad_hist))
+    # +Inf bucket disagreeing with _count
+    off_count = (
+        "# HELP h d\n# TYPE h histogram\n"
+        'h_bucket{le="+Inf"} 3\nh_sum 1\nh_count 4\n'
+    )
+    assert any("_count" in p for p in lint_exposition(off_count))
+    # HELP after samples
+    late_help = "# TYPE y gauge\ny 1\n# HELP y d\n"
+    assert any("after its samples" in p for p in lint_exposition(late_help))
+    # a clean minimal exposition stays clean
+    ok = (
+        "# HELP h d\n# TYPE h histogram\n"
+        'h_bucket{le="0.1"} 1\nh_bucket{le="+Inf"} 2\nh_sum 0.6\nh_count 2\n'
+    )
+    assert lint_exposition(ok) == []
+
+
+# ------------------------------------------- trace/log + remote ratio
+
+def test_logger_injects_active_span_ids():
+    sink = io.StringIO()
+    logger = new_logger("INFO", out=sink, err=sink)
+    tracer = Tracer("t")
+    with tracer.start_span("op") as span:
+        logger.info("inside")
+    logger.info("outside")
+    inside, outside = [json.loads(l) for l in sink.getvalue().splitlines()]
+    assert inside["trace_id"] == span.trace_id
+    assert inside["span_id"] == span.span_id
+    assert "trace_id" not in outside
+    # explicit ids (ContextLogger) always win over injection
+    sink2 = io.StringIO()
+    logger2 = new_logger("INFO", out=sink2, err=sink2)
+    with tracer.start_span("op2"):
+        logger2.info("explicit", trace_id="x" * 32)
+    assert json.loads(sink2.getvalue())["trace_id"] == "x" * 32
+
+
+def test_remote_trace_ratio_poller_applies_clamped_ratio():
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from gofr_tpu.logging.remote import start_remote_trace_ratio_poller
+
+    class RatioEndpoint(BaseHTTPRequestHandler):
+        ratio = 0.25
+
+        def do_GET(self):
+            body = json.dumps(
+                {"data": [{"sampleRatio": type(self).ratio}]}
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = HTTPServer(("127.0.0.1", 0), RatioEndpoint)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    tracer = Tracer("t", sample_ratio=1.0)
+    thread = start_remote_trace_ratio_poller(
+        tracer, f"http://127.0.0.1:{httpd.server_port}/", interval=0.05,
+    )
+    try:
+        deadline = time.time() + 5
+        while tracer.sample_ratio != 0.25 and time.time() < deadline:
+            time.sleep(0.02)
+        assert tracer.sample_ratio == 0.25
+        RatioEndpoint.ratio = 7.5  # out of range: clamps to 1.0
+        deadline = time.time() + 5
+        while tracer.sample_ratio != 1.0 and time.time() < deadline:
+            time.sleep(0.02)
+        assert tracer.sample_ratio == 1.0
+    finally:
+        thread._gofr_stop.set()
+        httpd.shutdown()
+
+
+def test_grpc_traceparent_metadata_roundtrip():
+    """gRPC propagation: the server interceptor extracts inbound
+    traceparent metadata; the client attaches the active span outbound."""
+    pytest.importorskip("grpc")
+    from gofr_tpu.grpcx.inference import _trace_metadata
+    from gofr_tpu.grpcx.server import _remote_trace
+
+    header = f"00-{'c' * 32}-{'d' * 16}-01"
+    ctx = SimpleNamespace(
+        invocation_metadata=lambda: (("traceparent", header),)
+    )
+    assert _remote_trace(ctx) == ("c" * 32, "d" * 16)
+    assert _remote_trace(SimpleNamespace(invocation_metadata=lambda: ())) is None
+
+    tracer = Tracer("t")
+    assert _trace_metadata() is None
+    with tracer.start_span("caller") as span:
+        md = dict(_trace_metadata())
+        assert md["traceparent"] == f"00-{span.trace_id}-{span.span_id}-01"
+
+
+def test_bench_timeline_stats_shape():
+    """bench.py derives ttft_ms_p50/queue_wait_ms from the recorder —
+    the JSONL fields future ratchet floors can cover."""
+    import bench
+
+    rec = TimelineRecorder()
+    for rid in range(5):
+        tl = rec.begin(rid)
+        tl.phases["admitted"] = tl.phases["submitted"] + 0.01
+        tl.phases["first_token"] = tl.phases["submitted"] + 0.1
+        rec.finish(tl, "stop")
+    stats = bench._timeline_stats(SimpleNamespace(timeline=rec))
+    assert stats["ttft_ms_p50"] == pytest.approx(100.0, rel=0.01)
+    assert stats["queue_wait_ms"] == pytest.approx(10.0, rel=0.01)
+    assert bench._timeline_stats(SimpleNamespace()) == {}

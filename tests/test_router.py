@@ -141,7 +141,12 @@ def test_announcer_heartbeats_reach_router_over_pubsub():
     try:
         deadline = time.monotonic() + 5.0
         while time.monotonic() < deadline:
-            if router.membership.candidates():
+            # wait for a BEAT to land, not merely for a routable
+            # candidate: a freshly registered replica is already
+            # SUSPECT-routable, so candidates() goes non-empty before
+            # the consumer thread has necessarily observed anything —
+            # asserting UP off that signal races thread scheduling
+            if router.membership.state_of("rep-1") == UP:
                 break
             time.sleep(0.01)
         assert router.membership.candidates() == ["rep-1"]
