@@ -443,6 +443,89 @@ def _timeline_stats(engine: Any) -> dict:
     return out
 
 
+def _engine_mixed_load(cfg: Any, params: Any, on_tpu: bool) -> dict:
+    """TTFT under mixed long-prefill/decode load (ROADMAP item 1, the
+    vLLM/TGI serving-study lens arXiv:2511.17593): several rows decode
+    long generations while long prompts chunk through the continuous-
+    batching step planner; short probes submitted into that load measure
+    TTFT-under-load straight from the timeline recorder. The headline
+    value — short-prompt TTFT p50 under load — is what head-of-line
+    blocking used to destroy, and is CPU-verifiable: the ratcheted
+    direction:"min" floor in analysis/bench_floors.json gates it without
+    a TPU run."""
+    from gofr_tpu.serving import ByteTokenizer, EngineConfig, ServingEngine
+
+    chunk = 64 if on_tpu else 16
+    engine = ServingEngine(
+        cfg,
+        params,
+        EngineConfig(
+            max_slots=8,
+            max_seq_len=512 if on_tpu else 128,
+            prefill_buckets=(64,) if on_tpu else (16,),
+            prefill_chunk_tokens=chunk,
+            max_queue=64,
+        ),
+        ByteTokenizer(cfg.vocab_size),
+        metrics=_engine_metrics(),
+    )
+    engine.start()
+    try:
+        # warm every executable off the clock: bucketed prefill, the
+        # ragged chunk dispatch, and the decode block
+        engine.submit("warm", max_new_tokens=4, temperature=0.0).result(timeout=1200)
+        engine.submit(
+            "w" * (chunk * 3), max_new_tokens=4, temperature=0.0
+        ).result(timeout=1200)
+        # unloaded short-prompt TTFT baseline
+        base = [
+            engine.submit(f"b{i}", max_new_tokens=2, temperature=0.0)
+            .result(timeout=1200).ttft_s
+            for i in range(6)
+        ]
+        # the mixed load: 4 rows decoding long generations + long prompts
+        # chunking through admission, with short probes riding along
+        decode_futs = [
+            engine.submit(f"decode row {i}", max_new_tokens=48,
+                          temperature=0.0)
+            for i in range(4)
+        ]
+        long_futs = [
+            engine.submit("L" * (chunk * 5), max_new_tokens=8,
+                          temperature=0.0)
+            for _ in range(2)
+        ]
+        short_futs = []
+        for i in range(8):
+            short_futs.append(
+                engine.submit(f"s{i}", max_new_tokens=2, temperature=0.0)
+            )
+            time.sleep(0.03)
+        shorts = [f.result(timeout=1200) for f in short_futs]
+        longs = [f.result(timeout=1200) for f in long_futs]
+        for f in decode_futs:
+            f.result(timeout=1200)
+        long_tl = engine.timeline.get(longs[0].request_id)
+        short_ttft = _percentiles([r.ttft_s for r in shorts])
+        base_p50 = sorted(base)[len(base) // 2]
+        stats = {
+            "short_ttft_ms_p50": short_ttft.get("p50_ms", 0.0),
+            "short_ttft_ms_p99": short_ttft.get("p99_ms", 0.0),
+            "unloaded_ttft_ms_p50": round(base_p50 * 1e3, 3),
+            "ttft_load_factor": round(
+                short_ttft.get("p50_ms", 0.0) / max(base_p50 * 1e3, 1e-6), 2
+            ),
+            "long_prompt_chunks": (
+                len(long_tl.prefill_chunks) if long_tl is not None else None
+            ),
+            "prefill_chunk_tokens": chunk,
+            **_timeline_stats(engine),
+        }
+        return stats
+    finally:
+        engine.stop()
+
+
 def _http_generate_load(engine: Any, on_tpu: bool) -> dict:
     """The same engine behind the real HTTP server: closed-loop POST
     /generate, end-to-end latency measured at the client."""
@@ -1006,6 +1089,24 @@ def _run_benchmarks(platform: str, init_error: str | None, wall_start: float) ->
         engine.stop()
     print(json.dumps(http_line), flush=True)
     lines.append(http_line)
+
+    # --- TTFT under mixed long-prefill/decode load (CPU-verifiable) --------
+    def run_mixed() -> dict:
+        if params is None:
+            raise RuntimeError("skipped: headline phase failed to build params")
+        return _engine_mixed_load(cfg, params, on_tpu)
+
+    mixed_line = _phase_line(
+        f"engine_mixed_ttft_ms_p50_{model_kind}_{platform}", "ms",
+        run_mixed, value_key="short_ttft_ms_p50",
+        on_tpu=on_tpu and not init_error, init_error=init_error,
+    )
+    print(json.dumps(mixed_line), flush=True)
+    # the mixed-load TTFT gate is CPU-verifiable by design (ROADMAP item
+    # 1): commit its evidence even off-TPU so the direction:"min" floor
+    # always has a record to check
+    if "error" not in mixed_line:
+        _append_local_record(mixed_line)
 
     # --- framework-only phases (no TPU dependence at all) ------------------
     echo_line = _phase_line(

@@ -37,18 +37,30 @@ FLOORS_PATH = os.path.join(_REPO, "gofr_tpu", "analysis", "bench_floors.json")
 
 
 def load_floors(path: str | None = None) -> dict[str, dict[str, float]]:
-    """{metric: {"floor": value, "tolerance": fraction}} from the committed
-    floors file. Tolerance defaults per entry."""
+    """{metric: {"floor": value, "tolerance": fraction, "direction":
+    "max"|"min"}} from the committed floors file. ``direction`` defaults
+    to "max" (throughput-style: higher is better, the floor is a lower
+    bound). ``"min"`` inverts the gate for latency-style metrics (TTFT
+    under load): the best value is the LOWEST, a regression is exceeding
+    floor*(1+tolerance), and the ratchet moves the floor DOWN."""
     with open(path or FLOORS_PATH) as f:
         raw = json.load(f)
     floors: dict[str, dict[str, float]] = {}
     for metric, entry in raw.get("floors", {}).items():
         if isinstance(entry, (int, float)):  # shorthand: bare floor value
             entry = {"floor": entry}
+        direction = str(entry.get("direction", "max"))
+        if direction not in ("max", "min"):
+            raise ValueError(
+                f"floor {metric}: direction must be 'max' or 'min', "
+                f"got {direction!r}"
+            )
         floors[metric] = {
             "floor": float(entry["floor"]),
             "tolerance": float(entry.get("tolerance", DEFAULT_TOLERANCE)),
         }
+        if direction == "min":  # "max" stays implicit: entry shape is stable
+            floors[metric]["direction"] = "min"
     return floors
 
 
@@ -72,8 +84,9 @@ def parse_records(lines: Iterable[str]) -> list[dict]:
 
 def best_values(records: Iterable[dict],
                 floors: dict[str, dict]) -> dict[str, float]:
-    """Best (max) numeric value per floored metric, accepting the exact
-    metric name and its ``_best_recorded`` twin."""
+    """Best numeric value per floored metric (max for throughput-style
+    floors, min for direction:"min" latency-style ones), accepting the
+    exact metric name and its ``_best_recorded`` twin."""
     best: dict[str, float] = {}
     for rec in records:
         metric = rec["metric"]
@@ -84,7 +97,10 @@ def best_values(records: Iterable[dict],
         value = rec.get("value")
         if not isinstance(value, (int, float)) or isinstance(value, bool):
             continue
-        if metric not in best or value > best[metric]:
+        lower_better = floors[metric].get("direction") == "min"
+        if metric not in best or (
+            value < best[metric] if lower_better else value > best[metric]
+        ):
             best[metric] = float(value)
     return best
 
@@ -104,6 +120,18 @@ def check_records(
                 f"{metric}: no record to check (floor {entry['floor']:g} "
                 "carried; a TPU run appends evidence to BENCH_LOCAL.jsonl)"
             )
+            continue
+        if entry.get("direction") == "min":
+            allowed = entry["floor"] * (1.0 + entry["tolerance"])
+            if best[metric] > allowed:
+                violations.append(
+                    f"{metric}: best value {best[metric]:g} is above the "
+                    f"ratcheted ceiling {entry['floor']:g} "
+                    f"(+{entry['tolerance']:.0%} tolerance = {allowed:g}) "
+                    "— a latency regression; fix it, or consciously raise "
+                    "the floor in analysis/bench_floors.json with a "
+                    "justification"
+                )
             continue
         allowed = entry["floor"] * (1.0 - entry["tolerance"])
         if best[metric] < allowed:
@@ -126,9 +154,14 @@ def update_floors(
     out: dict[str, dict[str, float]] = {}
     for metric, entry in floors.items():
         floor = entry["floor"]
-        if metric in best and best[metric] > floor:
+        lower_better = entry.get("direction") == "min"
+        if metric in best and (
+            best[metric] < floor if lower_better else best[metric] > floor
+        ):
             floor = round(best[metric], 4)
         out[metric] = {"floor": floor, "tolerance": entry["tolerance"]}
+        if lower_better:
+            out[metric]["direction"] = "min"
     return out
 
 

@@ -75,6 +75,7 @@ DISPATCH_ZONES: dict[str, set[str] | str] = {
     "gofr_tpu/serving/handlers.py": "*",
     "gofr_tpu/serving/engine.py": "*",
     "gofr_tpu/serving/batch.py": "*",
+    "gofr_tpu/serving/stepplan.py": "*",
     "gofr_tpu/serving/native_embed.py": "*",
     "gofr_tpu/serving/router.py": "*",
 }
@@ -107,11 +108,13 @@ ROUTER_RETRIABLE_NAMES = {
 HOT_SYNC_ZONES: dict[str, set[str] | str] = {
     "gofr_tpu/serving/engine.py": {
         "_loop", "_loop_body", "_decode_step", "_spec_step",
-        "_dispatch_decode", "_consume_block", "_commit_token",
-        "_emit_token", "_emit_async", "_block_sync", "_slot_in_flight",
-        "_make_device_state", "_retire",
+        "_dispatch_decode", "_dispatch_ragged", "_consume_block",
+        "_commit_token", "_commit_first_token", "_emit_token",
+        "_emit_async", "_block_sync", "_slot_in_flight",
+        "_make_device_state", "_retire", "_plan_step", "_cursor_health",
     },
     "gofr_tpu/serving/batch.py": "*",
+    "gofr_tpu/serving/stepplan.py": "*",
 }
 
 BLOCKING_CALLS = {

@@ -95,6 +95,7 @@ _SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
 RETRACE_ZONE_FILES = (
     "gofr_tpu/serving/engine.py",
     "gofr_tpu/serving/batch.py",
+    "gofr_tpu/serving/stepplan.py",
     "gofr_tpu/serving/kv_cache.py",
 )
 RETRACE_ZONE_DIRS = ("gofr_tpu/ops/",)

@@ -33,6 +33,7 @@ from typing import Any, Callable
 POINTS = (
     "sched.submit",     # native-scheduler boundary: request queueing
     "sched.admit",      # native-scheduler boundary: batch admission
+    "sched.plan",       # step-plan assembly (continuous-batching policy)
     "decode.dispatch",  # engine decode dispatch (device step)
     "engine.step",      # top of the engine loop iteration (raise AND hang)
     "device.loss",      # device/executable poisoning (persistent KV dies)

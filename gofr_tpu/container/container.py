@@ -178,6 +178,27 @@ class Container:
             "Detokenization/stream emissions queued behind the off-engine-"
             "thread executor",
         )
+        # continuous batching (serving/stepplan.py, docs/performance.md):
+        # per-chunk prefill sizes and the step plan the engine assembled
+        # each iteration — decode reserved first, chunks fill the rest
+        m.new_histogram(
+            "app_prefill_chunk_tokens",
+            "Prompt tokens per committed prefill chunk (label "
+            "kind=compute|prefix_hit)",
+            buckets=(16, 32, 64, 128, 256, 512, 1024),
+        )
+        m.new_gauge(
+            "app_step_plan_prefill_tokens",
+            "Prefill-chunk tokens granted by the latest step plan",
+        )
+        m.new_gauge(
+            "app_step_plan_decode_rows",
+            "Decode rows reserved first by the latest step plan",
+        )
+        m.new_gauge(
+            "app_step_plan_cursors",
+            "Partially-prefilled requests carrying a live chunk cursor",
+        )
         m.new_counter(
             "app_requests_shed_total",
             "Requests rejected by admission control (queue full or "

@@ -6,8 +6,12 @@ the unit of concurrency is the *slot*, not the thread. Components:
 
 - engine.py: the ServingEngine — admission queue, slot allocation, prefill/
   decode interleave, per-token streaming, cancellation, metrics.
+- stepplan.py: the continuous-batching step planner — per-iteration token
+  budgets (decode reserved first), chunk cursors for long prompts
+  (docs/performance.md "Continuous batching").
 - batch.py: jitted fixed-shape device functions (slot prefill insert,
-  batched decode+sample step).
+  batched decode+sample step, the unified ragged prefill-chunk + decode
+  dispatch).
 - tokenizer.py: tokenizer boundary (pluggable; byte-level default so the
   stack runs with zero external assets).
 - handlers.py: ready-made HTTP handlers (/generate JSON + SSE stream,

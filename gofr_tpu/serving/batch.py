@@ -109,10 +109,11 @@ class DecodeState:
     admission, so the device's stop evaluation covers both. ``stop_tok``
     is the row's EOS id (-1 disables). ``done`` rows are frozen: they stop
     spending budget and emit -1, and their garbage KV writes land where
-    they cannot matter — the trash page on the paged layout; position 0 of
-    the row's OWN slot on dense (step_len clamps to 1), which is safe only
-    because a done row's KV is never read again and re-admission rewrites
-    the whole row via insert_slot*."""
+    they cannot matter — the trash page on the paged layout; PAST the
+    cache bound on dense (``.at[].set`` drops out-of-bounds writes).
+    Position 0 was the old dense target, which became a corruption bug
+    the moment rows could be frozen while still holding LIVE prompt KV
+    (mid-chunked-prefill cursor rows)."""
 
     last_token: jnp.ndarray  # [B] int32
     seq_len: jnp.ndarray  # [B] int32 — tokens RESIDENT in KV (incl. prompt)
@@ -233,13 +234,17 @@ def decode_block(
     """``steps`` fused decode+sample+stop-eval iterations in ONE dispatch
     over the dense slot cache. A row that stops mid-block freezes: no
     further KV writes or budget spend, its remaining columns are -1.
+    Frozen rows aim their scatter PAST the cache bound (``.at[].set``
+    drops out-of-bounds writes) — position 0 would corrupt live prompt
+    KV for a row that is frozen because it is still mid-chunked-prefill.
     Returns (packed [B, steps+2] — see :func:`_pack_block` — cache,
     state); the packed array is the block's ONLY host-read value."""
+    oob = cache.k.shape[2] + 1  # static: one past the slot's last position
 
     def step(carry, _):
         cache, st = carry
         live = active & ~st.done
-        step_len = jnp.where(live, st.seq_len + 1, 1)
+        step_len = jnp.where(live, st.seq_len + 1, oob)
         logits, cache = llama.decode_step(
             cfg, params, st.last_token, cache, step_len
         )
@@ -316,6 +321,270 @@ def decode_block_paged_q(
     )
     packed = _pack_block(jnp.transpose(toks), state.done, active)
     return packed, k_pool, v_pool, ks_pool, vs_pool, state
+
+
+# ------------------------------------------------- unified ragged dispatch
+#
+# Continuous batching (Ragged Paged Attention, arXiv:2604.15464): one
+# dispatch runs a ragged mix of PREFILL CHUNKS (the next <=C prompt tokens
+# of each partially-prefilled row, written into the same slot cache / page
+# pool decode reads) and an N-step DECODE BLOCK, returning ONE packed
+# array so the host still pays exactly one sync per block. A row whose
+# chunk completes its prompt gets its first token sampled ON DEVICE (with
+# the same fold_in(root, request_id) key the host path uses) and is folded
+# into the donated DecodeState in the same dispatch — admission to decode
+# costs no extra host round trip.
+
+
+def _fold_finished_prefill(
+    st: DecodeState,
+    logits_c: jnp.ndarray,   # [B, C, V] chunk-forward logits
+    chunk_start: jnp.ndarray,  # [B] resident length before the chunk
+    finish: jnp.ndarray,     # [B] bool — this chunk completes the prompt
+    new_len: jnp.ndarray,    # [B] resident length after the chunk
+    budgets: jnp.ndarray,    # [B] tokens the row may emit AFTER the first
+    stops: jnp.ndarray,      # [B] per-row stop id (-1 disables)
+    temps: jnp.ndarray,
+    topks: jnp.ndarray,
+    topps: jnp.ndarray,
+    rids: jnp.ndarray,       # [B] request ids (first-token RNG keys)
+    rng_root: jax.Array,
+) -> tuple[DecodeState, jnp.ndarray, jnp.ndarray]:
+    """Sample first tokens for rows whose prompt just finished prefilling
+    and fold them into the decode carry. Returns (state, first [B] — -1
+    on non-finishing rows — last_logits [B, V] at each row's final chunk
+    position, for the chunk-prefix cache)."""
+    C = logits_c.shape[1]
+    pos = jnp.clip(new_len - chunk_start - 1, 0, C - 1)
+    last_logits = jnp.take_along_axis(
+        logits_c, pos[:, None, None], axis=1
+    )[:, 0]  # [B, V]
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(rng_root, rids)
+
+    def sample_one(lg, key, t, tk, tp):
+        return sample_logits(
+            lg[None], key, temperature=t, top_k=tk, top_p=tp
+        )[0]
+
+    sampled = jax.vmap(sample_one)(last_logits, keys, temps, topks, topps)
+    done_f = (sampled == stops) | (budgets <= 0)
+    st = DecodeState(
+        jnp.where(finish, sampled, st.last_token),
+        jnp.where(finish, new_len, st.seq_len),
+        jnp.where(finish, done_f, st.done),
+        jnp.where(finish, budgets, st.budget),
+        jnp.where(finish, stops, st.stop_tok),
+        jnp.where(finish, temps, st.temperature),
+        jnp.where(finish, topks, st.top_k),
+        jnp.where(finish, topps, st.top_p),
+        st.rng,
+    )
+    return st, jnp.where(finish, sampled, -1), last_logits
+
+
+def _pack_ragged(toks: jnp.ndarray, done: jnp.ndarray, active: jnp.ndarray,
+                 first: jnp.ndarray) -> jnp.ndarray:
+    """:func:`_pack_block` plus one trailing column: the on-device-sampled
+    first token of rows whose prefill finished this dispatch (-1
+    elsewhere). Layout [B, steps+3]: tokens | done | n_valid | first."""
+    return jnp.concatenate(
+        [_pack_block(toks, done, active), first[:, None].astype(jnp.int32)],
+        axis=1,
+    )
+
+
+@partial(jax.jit, static_argnums=(0, 16), donate_argnums=(2, 3))
+def ragged_step(
+    cfg: llama.LlamaConfig,
+    params: dict,
+    cache: llama.KVCache,      # donated (bf16 or int8 dense)
+    state: DecodeState,        # donated
+    chunk: jnp.ndarray,        # [B, C] next prompt tokens (pad past len)
+    chunk_start: jnp.ndarray,  # [B] resident length before the chunk;
+                               # NON-chunk rows pass max_seq_len so their
+                               # writes fall out of bounds and are dropped
+    finish: jnp.ndarray,       # [B] bool — chunk completes the prompt
+    new_len: jnp.ndarray,      # [B] resident length after the chunk
+    budgets: jnp.ndarray,      # [B] decode budget once admitted
+    stops: jnp.ndarray,        # [B]
+    temps: jnp.ndarray,        # [B]
+    topks: jnp.ndarray,        # [B]
+    topps: jnp.ndarray,        # [B]
+    rids: jnp.ndarray,         # [B] request ids (first-token keys)
+    rng_root: jax.Array,
+    decode_active: jnp.ndarray,  # [B] bool — rows decoding THIS block
+    steps: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, llama.KVCache, DecodeState]:
+    """Unified ragged dispatch, dense cache: prefill-chunk forward for the
+    chunk rows, first-token fold for finishing rows, then the N-step
+    decode scan — one dispatch, one packed host read. Returns (packed
+    [B, steps+3] — see :func:`_pack_ragged` — last_logits [B, V], cache,
+    state); ``last_logits`` stays on device unless the engine retains it
+    for the chunk-prefix cache."""
+    logits_c, cache = llama.decode_chunk.__wrapped__(
+        cfg, params, chunk, cache, chunk_start
+    )
+    state, first, last_logits = _fold_finished_prefill(
+        state, logits_c, chunk_start, finish, new_len, budgets, stops,
+        temps, topks, topps, rids, rng_root,
+    )
+    # frozen rows include MID-PREFILL cursor rows whose low positions hold
+    # live prompt KV: their scatter must drop out of bounds, never land on
+    # position 0 (see decode_block)
+    oob = cache.k.shape[2] + 1
+
+    def step(carry, _):
+        cache, st = carry
+        live = decode_active & ~st.done
+        step_len = jnp.where(live, st.seq_len + 1, oob)
+        logits, cache = llama.decode_step(
+            cfg, params, st.last_token, cache, step_len
+        )
+        st, out = _block_step(st, decode_active, logits)
+        return (cache, st), out
+
+    (cache, state), toks = jax.lax.scan(
+        step, (cache, state), None, length=steps
+    )
+    packed = _pack_ragged(
+        jnp.transpose(toks), state.done, decode_active, first
+    )
+    return packed, last_logits, cache, state
+
+
+@partial(jax.jit, static_argnums=(0, 20), donate_argnums=(2, 3, 4))
+def ragged_step_paged(
+    cfg: llama.LlamaConfig,
+    params: dict,
+    k_pool: jnp.ndarray,       # donated
+    v_pool: jnp.ndarray,       # donated
+    state: DecodeState,        # donated
+    block_tables: jnp.ndarray,  # [B, M] — covers chunk AND block writes
+    chunk: jnp.ndarray,        # [B, C]
+    chunk_start: jnp.ndarray,  # [B]
+    chunk_active: jnp.ndarray,  # [B] bool — rows prefill-chunking now
+    kv_capacity: jnp.ndarray,  # [B] tokens covered by owned pages
+    finish: jnp.ndarray,
+    new_len: jnp.ndarray,
+    budgets: jnp.ndarray,
+    stops: jnp.ndarray,
+    temps: jnp.ndarray,
+    topks: jnp.ndarray,
+    topps: jnp.ndarray,
+    rids: jnp.ndarray,
+    rng_root: jax.Array,
+    decode_active: jnp.ndarray,
+    steps: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, DecodeState]:
+    """Paged twin of :func:`ragged_step`: chunk writes route through the
+    block tables (inactive rows and beyond-capacity positions divert to
+    the trash page), decode appends likewise."""
+    logits_c, k_pool, v_pool = llama.decode_chunk_paged.__wrapped__(
+        cfg, params, chunk, k_pool, v_pool, block_tables, chunk_start,
+        chunk_active, kv_capacity,
+    )
+    state, first, last_logits = _fold_finished_prefill(
+        state, logits_c, chunk_start, finish, new_len, budgets, stops,
+        temps, topks, topps, rids, rng_root,
+    )
+
+    def step(carry, _):
+        kp, vp, st = carry
+        live = decode_active & ~st.done
+        step_len = jnp.where(live, st.seq_len + 1, 1)
+        logits, kp, vp = llama.decode_step_paged(
+            cfg, params, st.last_token, kp, vp, block_tables, step_len, live
+        )
+        st, out = _block_step(st, decode_active, logits)
+        return (kp, vp, st), out
+
+    (k_pool, v_pool, state), toks = jax.lax.scan(
+        step, (k_pool, v_pool, state), None, length=steps
+    )
+    packed = _pack_ragged(
+        jnp.transpose(toks), state.done, decode_active, first
+    )
+    return packed, last_logits, k_pool, v_pool, state
+
+
+@partial(jax.jit, static_argnums=(0, 22), donate_argnums=(2, 3, 4, 5, 6))
+def ragged_step_paged_q(
+    cfg: llama.LlamaConfig,
+    params: dict,
+    k_pool: jnp.ndarray,       # int8, donated
+    v_pool: jnp.ndarray,
+    ks_pool: jnp.ndarray,      # f32 scales, donated
+    vs_pool: jnp.ndarray,
+    state: DecodeState,        # donated
+    block_tables: jnp.ndarray,
+    chunk: jnp.ndarray,
+    chunk_start: jnp.ndarray,
+    chunk_active: jnp.ndarray,
+    kv_capacity: jnp.ndarray,
+    finish: jnp.ndarray,
+    new_len: jnp.ndarray,
+    budgets: jnp.ndarray,
+    stops: jnp.ndarray,
+    temps: jnp.ndarray,
+    topks: jnp.ndarray,
+    topps: jnp.ndarray,
+    rids: jnp.ndarray,
+    rng_root: jax.Array,
+    decode_active: jnp.ndarray,
+    steps: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray,
+           jnp.ndarray, DecodeState]:
+    """int8 twin of :func:`ragged_step_paged`."""
+    logits_c, k_pool, v_pool, ks_pool, vs_pool = (
+        llama.decode_chunk_paged_q.__wrapped__(
+            cfg, params, chunk, k_pool, v_pool, ks_pool, vs_pool,
+            block_tables, chunk_start, chunk_active, kv_capacity,
+        )
+    )
+    state, first, last_logits = _fold_finished_prefill(
+        state, logits_c, chunk_start, finish, new_len, budgets, stops,
+        temps, topks, topps, rids, rng_root,
+    )
+
+    def step(carry, _):
+        kp, vp, ksp, vsp, st = carry
+        live = decode_active & ~st.done
+        step_len = jnp.where(live, st.seq_len + 1, 1)
+        logits, kp, vp, ksp, vsp = llama.decode_step_paged_q(
+            cfg, params, st.last_token, kp, vp, ksp, vsp, block_tables,
+            step_len, live,
+        )
+        st, out = _block_step(st, decode_active, logits)
+        return (kp, vp, ksp, vsp, st), out
+
+    (k_pool, v_pool, ks_pool, vs_pool, state), toks = jax.lax.scan(
+        step, (k_pool, v_pool, ks_pool, vs_pool, state), None, length=steps
+    )
+    packed = _pack_ragged(
+        jnp.transpose(toks), state.done, decode_active, first
+    )
+    return packed, last_logits, k_pool, v_pool, ks_pool, vs_pool, state
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def insert_chunk(
+    k_cache: jnp.ndarray,  # [L, B, S_max, Hkv, Dh] donated
+    v_cache: jnp.ndarray,
+    k_slab: jnp.ndarray,  # [L, C, Hkv, Dh] cached chunk-prefix slab
+    v_slab: jnp.ndarray,
+    slot: jnp.ndarray,  # scalar int32
+    start: jnp.ndarray,  # scalar int32 — token offset of the slab
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter a cached chunk-prefix slab into slot row
+    [.., slot, start:start+C] — :func:`insert_slot`'s offset twin, used
+    when a chunked admission skips already-cached chunk prefixes."""
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k_slab[:, None], (0, slot, start, 0, 0)
+    )
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v_slab[:, None], (0, slot, start, 0, 0)
+    )
+    return k_cache, v_cache
 
 
 # ----------------------------------------------------- speculative decoding

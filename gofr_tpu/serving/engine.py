@@ -39,6 +39,7 @@ from gofr_tpu.models import llama
 from gofr_tpu.native.runtime import QueueFull, Scheduler
 from gofr_tpu.serving import batch as batch_ops
 from gofr_tpu.serving.shed import QueueWaitEstimator
+from gofr_tpu.serving.stepplan import ChunkCursor, StepPlan, StepPlanner
 from gofr_tpu.serving.timeline import TimelineRecorder
 from gofr_tpu.serving.tokenizer import ByteTokenizer, Tokenizer
 
@@ -52,8 +53,23 @@ class EngineConfig:
     max_new_tokens_default: int = 128
     max_queue: int = 256
     prefill_buckets: tuple[int, ...] = DEFAULT_BUCKETS
-    admission_per_step: int = 4  # prefills between decode steps (TTFT vs TPOT)
-    prefill_token_budget: int = 4096  # prompt tokens admitted per step
+    # DEPRECATED alias (continuous batching, docs/performance.md): caps
+    # fresh admissions per step plan — the planner's max_admissions
+    admission_per_step: int = 4
+    # DEPRECATED alias: the native scheduler's per-admit token gate; the
+    # per-iteration prefill pacing now lives in prefill_chunk_tokens /
+    # step_token_budget (serving/stepplan.py)
+    prefill_token_budget: int = 4096
+    # continuous batching: prompts longer than this prefill in chunks of
+    # this many tokens, interleaved with decode blocks in one ragged
+    # dispatch — one long prefill can no longer head-of-line-block the
+    # decoding rows. Also the per-iteration prefill token budget when
+    # step_token_budget is 0 (auto).
+    prefill_chunk_tokens: int = 256
+    # explicit per-iteration token target: decode rows (rows*block_steps)
+    # are reserved FIRST, prefill chunks fill the remainder. 0 = auto
+    # (decode implicitly reserved + one chunk budget of prefill).
+    step_token_budget: int = 0
     idle_sleep_s: float = 0.002
     # KV layout: "dense" reserves [slots, max_seq] rows; "paged" commits HBM
     # by resident tokens through the pooled page table (serving/kv_cache.py)
@@ -119,6 +135,12 @@ class EngineConfig:
             ),
             prefill_token_budget=int(
                 config.get_or_default("TPU_BATCH_PREFILL_BUDGET", "4096")
+            ),
+            prefill_chunk_tokens=int(
+                config.get_or_default("TPU_PREFILL_CHUNK_TOKENS", "256")
+            ),
+            step_token_budget=int(
+                config.get_or_default("TPU_STEP_TOKEN_BUDGET", "0")
             ),
             idle_sleep_s=float(config.get_or_default("TPU_IDLE_SLEEP_S", "0.002")),
             kv_layout=config.get_or_default("TPU_KV_LAYOUT", "dense"),
@@ -228,15 +250,24 @@ class _Inflight:
     never donated anywhere — holding it here cannot alias a donated
     carry (the round-4 use-after-donate shape)."""
 
-    __slots__ = ("packed", "rows", "dispatched_at", "steps", "host_s")
+    __slots__ = ("packed", "rows", "dispatched_at", "steps", "host_s",
+                 "prefill_rows", "last_logits")
 
     def __init__(self, packed: Any, rows: list, dispatched_at: float,
-                 steps: int = 1, host_s: float = 0.0) -> None:
+                 steps: int = 1, host_s: float = 0.0,
+                 prefill_rows: list | None = None,
+                 last_logits: Any = None) -> None:
         self.packed = packed
         self.rows = rows
         self.dispatched_at = dispatched_at
         self.steps = steps
         self.host_s = host_s  # host-side time spent building the dispatch
+        # ragged dispatches only: the prefill-chunk rows this block ran —
+        # (slot, req, cursor, start, n_tokens, final, chunk_index) — plus
+        # the device-resident last-position logits (retained ONLY for the
+        # chunk-prefix cache; never synced here)
+        self.prefill_rows = prefill_rows or []
+        self.last_logits = last_logits
 
 
 def _block_sync(value: Any) -> np.ndarray:
@@ -306,6 +337,31 @@ class ServingEngine:
         else:
             self._block_steps = 4
         self._sync_every = max(1, int(self.config.decode_sync_every))
+        # continuous batching (serving/stepplan.py, docs/performance.md):
+        # prompts longer than one chunk prefill through the unified ragged
+        # dispatch, interleaved with decode blocks. Speculative mode keeps
+        # monolithic prefills — spec chunking and prefill chunking are
+        # both per-dispatch chunking policies and the spec path is
+        # unpipelined by design.
+        self._chunk_enabled = self.config.spec_tokens == 0
+        chunk = max(1, int(self.config.prefill_chunk_tokens))
+        if self.config.kv_layout == "paged":
+            # chunk boundaries double as chunk-prefix-cache boundaries,
+            # and cached slabs scatter through whole pages — align the
+            # chunk size down to the page grid
+            page = max(1, int(self.config.kv_page_size))
+            chunk = max(page, (chunk // page) * page)
+        self._chunk_tokens = min(chunk, self.config.max_seq_len)
+        self._planner = StepPlanner(
+            chunk_tokens=self._chunk_tokens,
+            block_steps=self._block_steps,
+            step_token_budget=self.config.step_token_budget,
+            max_admissions=self.config.admission_per_step,
+        )
+        # chunk-prefix cache entries hold raw bf16 slabs; a quantized
+        # layout would re-quantize on every hit and drift — int8 engines
+        # keep only the whole-prompt (single-chunk) prefix cache
+        self._chunk_cache_enabled = self.config.kv_dtype != "int8"
         # the /requestz flight recorder: per-request lifecycle timelines,
         # stamped only with host-side data already materialized at the
         # existing sync points (docs/observability.md). Process-lifetime
@@ -316,6 +372,7 @@ class ServingEngine:
         # thread (single writer); the device-telemetry poller reads the
         # delta over its interval (serving/device_telemetry.py)
         self._busy_s = 0.0
+        self._iter_t0 = time.monotonic()  # rebased at each loop iteration
         # optional DeviceTelemetry poller backref: health_check embeds its
         # last sample, the membership announcer reads HBM headroom off it
         self.device_telemetry: Any = None
@@ -674,7 +731,14 @@ class ServingEngine:
                     self._by_id.clear()
                 requeue: list[_Request] = []
                 for req in pending:
-                    if req.slot is None and not req.tokens and not req.canceled:
+                    if not req.tokens and not req.canceled:
+                        # never emitted a token: still queued, OR
+                        # partially-prefilled behind a chunk cursor — its
+                        # committed chunks die with the pools either way,
+                        # so it requeues and re-prefills FROM CHUNK 0 on
+                        # the rebuilt engine (the chunk-prefix cache, when
+                        # on, makes the re-prefill cheap)
+                        req.slot = None  # the old slot died with the pools
                         requeue.append(req)
                     else:
                         self._settle_future(req, ErrorServiceUnavailable(
@@ -779,6 +843,18 @@ class ServingEngine:
         waiting): the device-telemetry poller derives the engine duty
         cycle from the delta over its poll interval."""
         return self._busy_s
+
+    def _flush_busy(self) -> None:
+        """Fold the running iteration's elapsed work time into the busy
+        counter and rebase. Called at each iteration's end AND from
+        _finish before a terminal settlement is queued — a caller that
+        observed its request complete must observe busy_seconds() > 0,
+        even when the whole generation fit inside the loop's very first
+        iteration (a prefill whose first token is EOS). Engine-thread
+        only: _finish and the loop share the single writer."""
+        now = time.monotonic()
+        self._busy_s += now - self._iter_t0
+        self._iter_t0 = now
 
     @property
     def in_cold_dispatch(self) -> bool:
@@ -940,10 +1016,14 @@ class ServingEngine:
         prompt_ids = (
             self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
         )
-        # keep the TAIL within both limits: the sequence budget AND the
-        # largest configured prefill bucket (a prompt longer than every
-        # bucket cannot be prefilled — it used to crash the slab scatter)
-        max_prompt = min(self.config.max_seq_len - 1, max(self._buckets()))
+        # keep the TAIL within the sequence budget. Short prompts keep the
+        # additional largest-bucket clamp (the monolithic prefill path
+        # cannot scatter past its biggest bucket); prompts that route
+        # through chunked prefill have no bucket — any length up to the
+        # sequence cap chunks through (docs/performance.md).
+        max_prompt = self.config.max_seq_len - 1
+        if not self._route_chunked(min(len(prompt_ids), max_prompt)):
+            max_prompt = min(max_prompt, max(self._buckets()))
         prompt_ids = prompt_ids[-max_prompt:]
         budget = self.config.max_seq_len - len(prompt_ids)
         max_new = min(max_new_tokens or self.config.max_new_tokens_default, budget)
@@ -1128,7 +1208,7 @@ class ServingEngine:
         # loop thread — the old one must exit the moment it thaws instead
         # of racing the replacement over rebuilt state
         while self._running and me is self._thread:
-            self.heartbeat = iter_t0 = time.monotonic()
+            self.heartbeat = self._iter_t0 = time.monotonic()
             chaos.maybe_fail("engine.step")
             if not self._running or me is not self._thread:
                 # stopped or replaced while hung at the chaos point: re-check
@@ -1137,9 +1217,10 @@ class ServingEngine:
                 # queue this iteration would admit from)
                 continue
             try:
-                did_work = self._admit()
+                plan = self._plan_step()
+                did_work = self._admit(plan)
                 if any(s is not None for s in self.slots):
-                    did_work |= self._decode_step()
+                    did_work |= self._decode_step(plan)
                 elif self._inflight_q:
                     # drain: every row of the in-flight blocks retired while
                     # they ran; their tokens are stale by construction
@@ -1150,9 +1231,11 @@ class ServingEngine:
                 # duty-cycle accounting: the iteration so far was WORK
                 # (dispatches, syncs, bookkeeping); the wake wait below is
                 # idle. The telemetry poller divides the busy delta by
-                # wall time (app_engine_duty_cycle). iter_t0, not the
-                # heartbeat — progress points re-stamp that mid-iteration.
-                self._busy_s += time.monotonic() - iter_t0
+                # wall time (app_engine_duty_cycle). _iter_t0, not the
+                # heartbeat — progress points re-stamp that mid-iteration,
+                # and _finish flushes the running iteration's slice early
+                # so a settled request always implies recorded busy time.
+                self._flush_busy()
                 if not did_work:
                     if (self._draining and not self._inflight_q
                             and not any(s is not None for s in self.slots)
@@ -1180,7 +1263,50 @@ class ServingEngine:
                 time.sleep(cfg.idle_sleep_s)
 
     # -- admission -------------------------------------------------------------
-    def _admit(self) -> bool:
+    def _plan_step(self) -> StepPlan:
+        """Assemble this iteration's step plan (serving/stepplan.py):
+        decode rows reserved first, chunk grants for partially-prefilled
+        cursors, an admission quota out of the leftover budget."""
+        decode_rows = sum(
+            1 for slot, req in enumerate(self.slots)
+            if req is not None and slot not in self._cursors
+        )
+        free_slots = sum(1 for s in self.slots if s is None)
+        plan = self._planner.plan(
+            decode_rows=decode_rows,
+            cursors=list(self._cursors.values()),
+            free_slots=free_slots,
+            queue_depth=self._sched.pending(),
+        )
+        if self._metrics:
+            # set on CHANGE (including the drop back to zero at idle —
+            # a frozen non-zero gauge would report phantom load forever),
+            # skipped in steady state to keep per-iteration host cost flat
+            snapshot = (plan.prefill_tokens, decode_rows, len(self._cursors))
+            if snapshot != self._plan_gauges:
+                self._plan_gauges = snapshot
+                self._metrics.set_gauge(
+                    "app_step_plan_prefill_tokens", plan.prefill_tokens
+                )
+                self._metrics.set_gauge(
+                    "app_step_plan_decode_rows", decode_rows
+                )
+                self._metrics.set_gauge(
+                    "app_step_plan_cursors", len(self._cursors)
+                )
+        return plan
+
+    def _route_chunked(self, prompt_len: int) -> bool:
+        """True when a prompt prefills through chunk cursors + the ragged
+        dispatch instead of one monolithic bucketed prefill: longer than a
+        chunk, or longer than every bucket (the monolithic path cannot
+        scatter past its biggest bucket)."""
+        if not self._chunk_enabled:
+            return False
+        return (prompt_len > self._chunk_tokens
+                or prompt_len > max(self._buckets()))
+
+    def _admit(self, plan: StepPlan | None = None) -> bool:
         # bind ONCE: a warm restart that replaces this thread mid-admit
         # swaps self._sched for a rebuilt one — the pairs delivered below
         # belong to THIS scheduler, and releases/requeues must never land
@@ -1192,7 +1318,14 @@ class ServingEngine:
             # native admit round trip entirely; per-block host overhead is
             # the budget this loop is built around
             return False
-        pairs, canceled_ids = sched.admit(self.config.admission_per_step)
+        # the plan's quota is never 0 while the queue is non-empty (a
+        # canceled-but-queued request resolves only through an admit
+        # delivery); max(…, 1) covers a submit that raced in after the
+        # plan read its queue depth
+        cap = max(plan.admit_cap, 1) if plan is not None else (
+            self.config.admission_per_step
+        )
+        pairs, canceled_ids = sched.admit(cap)
         # the admit call itself can hang (native mutex held under a wedged
         # step); a thread thawing out of it retired would otherwise process
         # the old scheduler's pairs against the REPLACEMENT engine's state
@@ -1241,7 +1374,10 @@ class ServingEngine:
                         "app_request_queue_wait_seconds", queue_wait,
                     )
             try:
-                self._prefill_into(slot, req)
+                if self._route_chunked(len(req.prompt_ids)):
+                    self._start_cursor(slot, req)
+                else:
+                    self._prefill_into(slot, req)
             except _RequeueRequest:
                 # transient (KV pages exhausted): back to the HEAD of its
                 # priority class (it keeps its FIFO position — later smaller
@@ -1409,10 +1545,17 @@ class ServingEngine:
         # stamps nothing anywhere (and a first-call jit compile widens the
         # threshold via _cold_dispatch above)
         self.heartbeat = time.monotonic()
+        self._commit_prefilled(slot, req, first_id, S)
+
+    def _commit_prefilled(self, slot: int, req: _Request, first_id: int,
+                          resident: int) -> None:
+        """First-token commit shared by the monolithic prefill path and a
+        full chunk-prefix cache hit: slot bookkeeping, the DecodeState
+        admission fold, TTFT stamps/metrics, first-token emission and the
+        stop/length retire chain."""
         req.slot = slot
-        req.first_token_at = time.perf_counter()
         self.slots[slot] = req
-        self.cache_len[slot] = S
+        self.cache_len[slot] = resident
         self.last_token[slot] = first_id
         self.temperature[slot] = req.temperature
         self.top_k[slot] = req.top_k
@@ -1425,45 +1568,199 @@ class ServingEngine:
         # A multi-token stop set disables device stop-eval (-1 sentinel);
         # the host's _commit_token still enforces it at each sync.
         self._pending_admit[slot] = (
-            first_id, S, req.max_new_tokens - 1,
+            first_id, resident, req.max_new_tokens - 1,
             next(iter(req.stop_ids)) if len(req.stop_ids) == 1 else -1,
         )
+        self._commit_first_token(slot, req, first_id)
 
-        ttft = req.first_token_at - req.created
-        self._shed.observe_ttft(ttft)
-        if tl is not None:
-            # prefill end + first token share the commit instant: the
-            # sampled first token IS the prefill dispatch's last output
-            tl.stamp("prefill_end")
-            tl.stamp("first_token")
-            tl.end_span("prefill")
-        if self._metrics:
-            self._metrics.record_histogram("app_ttft_seconds", ttft)
-            self._metrics.record_histogram(
-                "app_request_ttft_seconds", ttft, source="engine",
+    # -- chunked prefill (continuous batching) ---------------------------------
+    def _chunk_cache_keys(self, prompt_ids: list[int]) -> list[tuple[int, int, str]]:
+        """Chunk-prefix cache keys for every chunk boundary of a prompt:
+        chunk geometry + the content digest of the FULL prefix up to each
+        boundary — two prompts sharing a prefix share its chunk entries,
+        and a chunk-size change can never alias. ONE incremental blake2b
+        pass with a copy() snapshot per boundary: digesting each prefix
+        from scratch would be quadratic in prompt length on the engine
+        thread."""
+        import hashlib as _hashlib
+
+        arr = np.asarray(prompt_ids, np.int32)
+        h = _hashlib.blake2b(digest_size=16)
+        out: list[tuple[int, int, str]] = []
+        pos, total = 0, len(prompt_ids)
+        while pos < total:
+            end = min(pos + self._chunk_tokens, total)
+            h.update(arr[pos:end].tobytes())
+            key = (
+                f"chunkpfx:{self._chunk_tokens}:{pos}:{end}:"
+                f"{h.copy().hexdigest()}"
             )
-        self._emit_token(req, first_id)
-        self._check_retired()  # stream_cb may have blocked across a restart
-        if first_id in req.stop_ids:
-            self._retire(slot, "stop")
-        elif len(req.tokens) >= req.max_new_tokens:
-            self._retire(slot, "length")
-        elif tl is not None and self._tracer is not None:
-            # the request decodes on: open its decode span now — it ends
-            # at terminal settlement with tokens/finish_reason attributes
-            self._req_span("decode", "serve.decode", req)
+            out.append((pos, end, key))
+            pos = end
+        return out
+
+    def _start_cursor(self, slot: int, req: _Request) -> None:
+        """Admit a long prompt as a chunk cursor: claim the slot, skip any
+        already-cached chunk prefixes, and leave the rest of the prompt to
+        the step planner's chunk grants. Raises before touching slot state
+        on page pressure (_RequeueRequest) or a never-fits prompt (413) —
+        the _admit cleanup contract."""
+        total = len(req.prompt_ids)
+        pc = self.paged_cache
+        if pc is not None and pc.pages_needed(total) > pc.num_pages:
+            raise ErrorRequestEntityTooLarge(
+                f"prompt needs {pc.pages_needed(total)} KV pages; "
+                f"pool has {pc.num_pages} in total"
+            )
+
+        # probe the prefix cache for the longest chain of cached
+        # chunk-boundary prefixes (each entry holds that chunk's K/V delta
+        # slab + the prefix's last-position logits). The boundary keys are
+        # computed ONCE per tenancy and ride the cursor — the per-chunk
+        # PUT at consume reuses them instead of re-digesting the prefix.
+        hits: list[tuple[int, int, Any]] = []
+        pos = 0
+        cache_keys: dict[tuple[int, int], str] | None = None
+        if self._prefix_cache is not None and self._chunk_cache_enabled:
+            boundaries = self._chunk_cache_keys(req.prompt_ids)
+            cache_keys = {(s, e): k for s, e, k in boundaries}
+            for start, end, key in boundaries:
+                val = self._prefix_cache.get(key)
+                if val is None:
+                    break
+                hits.append((start, end, val))
+                pos = end
+
+        from gofr_tpu.serving.kv_cache import OutOfBlocks
+
+        if hits and pc is not None:
+            try:
+                pc.alloc_slot(slot, seq_id=req.id, prompt_len=0,
+                              reserve_tokens=pos)
+            except OutOfBlocks:
+                raise _RequeueRequest() from None
+
+        tl = req.timeline
+        if tl is not None:
+            tl.stamp("prefill_start")
+        for start, end, (_logits, k_slab, v_slab) in hits:
+            if pc is not None:
+                pc.write_span(slot, start, k_slab, v_slab)
+            else:
+                dense = self.cache
+                dense.k, dense.v = batch_ops.insert_chunk(
+                    dense.k, dense.v, k_slab, v_slab,
+                    jnp.int32(slot), jnp.int32(start),
+                )
+        if hits:
+            if pc is not None:
+                pc.advance_slot(slot, pos)
+            if tl is not None:
+                tl.chunk(0, pos, prefix_hit=True)
+            if self._metrics:
+                self._metrics.record_histogram(
+                    "app_prefill_chunk_tokens", pos, kind="prefix_hit",
+                )
+
+        if pos >= total:
+            # the WHOLE prompt was cached at chunk boundaries: sample the
+            # first token from the cached last-position logits and admit
+            # straight to decode — zero prefill dispatches (the admission-
+            # path sync mirrors the monolithic prefix-hit path)
+            span = self._req_span("prefill", "serve.prefill chunked (prefix hit)", req)
+            with span:
+                last_logits = hits[-1][2][0]
+                key = jax.random.fold_in(self._rng_root, req.id)
+                from gofr_tpu.ops.sampling import sample_logits
+
+                first = sample_logits(
+                    last_logits, key,
+                    temperature=jnp.float32(req.temperature),
+                    top_k=jnp.int32(req.top_k),
+                    top_p=jnp.float32(req.top_p),
+                )
+                first_id = int(first[0])
+            self._check_retired()
+            self._commit_prefilled(slot, req, first_id, total)
+            return
+
+        cursor = ChunkCursor(req=req, slot=slot, total=total, seq=self._cursor_seq)
+        self._cursor_seq += 1
+        cursor.cache_keys = cache_keys
+        cursor.committed = cursor.dispatched = pos
+        cursor.prefix_hit = pos
+        cursor.chunk_index = 1 if hits else 0
+        cursor.allocated = bool(hits and pc is not None)
+        req.slot = slot
+        self.slots[slot] = req
+        self.cache_len[slot] = pos
+        self.last_token[slot] = 0
+        self.temperature[slot] = req.temperature
+        self.top_k[slot] = req.top_k
+        self.top_p[slot] = req.top_p
+        self._cursors[slot] = cursor
+
+    def _cursor_requeue(self, slot: int, req: _Request,
+                        cursor: ChunkCursor) -> None:
+        """Transient KV-pool pressure mid-chunked-prefill: give the pages
+        back and requeue the request from chunk 0 at the head of its
+        priority class — prefill pressure is a transient, not an error.
+        Only legal with nothing in flight for the cursor (an in-flight
+        ragged dispatch still writes through this slot's pages)."""
+        self._cursors.pop(slot, None)
+        self.slots[slot] = None
+        self.cache_len[slot] = 0
+        req.slot = None
+        if self.paged_cache is not None:
+            try:
+                self.paged_cache.free_slot(slot)
+            except Exception:
+                pass
+        sched = self._sched
+        try:
+            sched.release(slot)
+        except KeyError:
+            pass
+        try:
+            sched.submit(
+                req.id, len(req.prompt_ids), req.max_new_tokens,
+                req.priority, front=True,
+            )
+        except Exception:
+            with self._count_lock:
+                self._by_id.pop(req.id, None)
+            self._try_resolve(req, exc=ErrorTooManyRequests())
+
+    def _cursor_health(self, slot: int, req: _Request, cursor: ChunkCursor,
+                       now: float) -> None:
+        """Mid-chunk retirement/requeue gate, run at each dispatch scan:
+        cancel and deadline expiry retire the partially-prefilled row;
+        pool pressure requeues it from chunk 0 — all deferred while a
+        dispatched ragged chunk is still in flight for the slot (its
+        writes ride the page tables snapshotted at dispatch; freeing the
+        pages under it would hand them to another row)."""
+        if cursor.in_flight > 0:
+            return
+        if req.canceled:
+            self._retire(slot, "cancel")
+        elif req.expired(now):
+            self._retire(slot, "deadline_exceeded")
+        elif cursor.blocked:
+            self._cursor_requeue(slot, req, cursor)
 
     # -- decode (pipelined N-step blocks) --------------------------------------
-    def _decode_step(self) -> bool:
-        """Dispatch the NEXT N-step device block, then materialize the
-        OLDEST outstanding one. The dispatch feeds on the device-resident
-        DecodeState carry directly, so the device never waits for host
-        bookkeeping; the host's single block sync overlaps the next
-        block's compute (double-buffered — depth = decode_sync_every)."""
+    def _decode_step(self, plan: StepPlan | None = None) -> bool:
+        """Dispatch the NEXT N-step device block — a plain decode block,
+        or the unified ragged dispatch when the step plan granted prefill
+        chunks — then materialize the OLDEST outstanding one. The dispatch
+        feeds on the device-resident DecodeState carry directly, so the
+        device never waits for host bookkeeping; the host's single block
+        sync overlaps the next block's compute (double-buffered — depth =
+        decode_sync_every)."""
         self._check_retired()  # replaced during a long _admit: unwind first
         if self.config.spec_tokens > 0:
             return self._spec_step()
-        inflight = self._dispatch_decode()
+        inflight = self._dispatch_decode(plan)
         if inflight is not None:
             self._inflight_q.append(inflight)
         did = inflight is not None
@@ -1690,7 +1987,9 @@ class ServingEngine:
         done = np.ones(B, bool)
         stop = np.full(B, -1, np.int32)
         for slot, req in enumerate(self.slots):
-            if req is None:
+            if req is None or slot in self._cursors:
+                # a mid-chunked-prefill row is not decoding: it stays
+                # frozen (done) until its final chunk's on-device fold
                 continue
             remaining = req.max_new_tokens - len(req.tokens)
             budget[slot] = max(remaining, 0)
@@ -1704,7 +2003,7 @@ class ServingEngine:
             stop, self.temperature, self.top_k, self.top_p, sub,
         )
 
-    def _dispatch_decode(self) -> _Inflight | None:
+    def _dispatch_decode(self, plan: StepPlan | None = None) -> _Inflight | None:
         cfg = self.model_cfg
         chaos.maybe_fail("decode.dispatch")
         self._maybe_device_loss()
@@ -1717,6 +2016,13 @@ class ServingEngine:
         now = time.perf_counter()
         for slot, req in enumerate(self.slots):
             if req is None:
+                continue
+            cursor = self._cursors.get(slot)
+            if cursor is not None:
+                # mid-chunked-prefill: not a decode row. Cancel/deadline/
+                # pool-pressure exits run here, deferred while a ragged
+                # chunk is still in flight for the slot.
+                self._cursor_health(slot, req, cursor, now)
                 continue
             if req.canceled:
                 # retire immediately; pending in-flight tokens (if any) are
@@ -1759,7 +2065,43 @@ class ServingEngine:
                     # else: tokens the client paid for are still in flight —
                     # commit them at the next sync and retire there
             rows = kept
-        if not rows:
+
+        # -- prefill-chunk rows: the step plan's grants, page coverage
+        # reserved up front (including each cursor's dispatched-ahead gap,
+        # like decode's). A cursor the pool cannot cover is BLOCKED, not
+        # stalled — the rest of the plan proceeds and the blocked cursor
+        # requeues from chunk 0 once nothing is in flight for it.
+        chunk_rows: list[tuple[int, ChunkCursor, _Request, int, int]] = []
+        if plan is not None and plan.grants and self._cursors:
+            from gofr_tpu.serving.kv_cache import OutOfBlocks
+
+            for slot, grant in plan.grants:
+                cursor = self._cursors.get(slot)
+                if cursor is None or cursor.blocked or cursor.done:
+                    continue
+                req = cursor.req
+                if req.canceled or req.expired(now):
+                    continue  # _cursor_health retires it at the next scan
+                n = min(grant, cursor.remaining)
+                if n <= 0:
+                    continue
+                if pc is not None:
+                    if not cursor.allocated:
+                        try:
+                            pc.alloc_slot(slot, seq_id=req.id, prompt_len=0,
+                                          reserve_tokens=n)
+                            cursor.allocated = True
+                        except OutOfBlocks:
+                            cursor.blocked = True
+                            continue
+                    elif not pc.try_reserve_slot(
+                        slot, cursor.in_flight + n
+                    ):
+                        cursor.blocked = True
+                        continue
+                chunk_rows.append((slot, cursor, req, cursor.dispatched, n))
+
+        if not rows and not chunk_rows:
             return None
 
         mask = np.zeros(self.config.max_slots, bool)
@@ -1803,7 +2145,13 @@ class ServingEngine:
         # mutates): a retired thread returning from a hung dispatch must
         # not clobber the replacement engine's state at assignment time —
         # self.* commits happen only after the retirement check
-        if pc is not None:
+        prefill_rows: list = []
+        last_logits = None
+        if chunk_rows:
+            (packed, last_logits, new_cache, new_state, prefill_rows) = (
+                self._dispatch_ragged(cfg, pc, state, mask_d, chunk_rows, N)
+            )
+        elif pc is not None:
             tables_d = pc.tables_device()
             with self._cold_dispatch("decode", "paged", pc.quantized, N):
                 if pc.quantized:
@@ -1831,9 +2179,118 @@ class ServingEngine:
         self._dec_state = new_state
         for _, req in rows:
             req.dispatched += N
-        return _Inflight(
-            packed, rows, t0, steps=N, host_s=t0 - host_t0
+        # the last-position chunk logits are retained ONLY when the
+        # chunk-prefix cache will store them at consume (device ref, no
+        # sync); otherwise drop the reference so the buffer can free
+        keep_logits = (
+            last_logits
+            if (prefill_rows and self._prefix_cache is not None
+                and self._chunk_cache_enabled) else None
         )
+        return _Inflight(
+            packed, rows, t0, steps=N, host_s=t0 - host_t0,
+            prefill_rows=prefill_rows, last_logits=keep_logits,
+        )
+
+    def _dispatch_ragged(self, cfg: Any, pc: Any, state: Any, mask_d: Any,
+                         chunk_rows: list, N: int) -> tuple:
+        """Assemble and launch ONE unified ragged dispatch: the granted
+        prefill chunks (per-row slices of their prompts, ragged within the
+        fixed [B, C] chunk buffer) plus the N-step decode block, against
+        the same slot cache / page pool — batch_ops.ragged_step*. Rows
+        whose chunk completes the prompt get their first token sampled on
+        device and are folded into the donated DecodeState inside the
+        dispatch; the host reads everything back at the block's single
+        sync."""
+        B = self.config.max_slots
+        C = self._chunk_tokens
+        chunk = np.full((B, C), -1, np.int32)
+        # non-chunk rows aim their (masked/inactive) chunk writes past the
+        # dense cache bound so the scatter drops them; paged rows divert
+        # to the trash page via the active mask instead
+        start = np.full(B, self.config.max_seq_len, np.int32)
+        finish = np.zeros(B, bool)
+        cactive = np.zeros(B, bool)
+        new_len = np.zeros(B, np.int32)
+        budgets = np.zeros(B, np.int32)
+        stops = np.full(B, -1, np.int32)
+        rids = np.zeros(B, np.int32)
+        kvcap = np.zeros(B, np.int32)
+        for slot, cursor, req, start_pos, n in chunk_rows:
+            chunk[slot, :n] = req.prompt_ids[start_pos : start_pos + n]
+            start[slot] = start_pos
+            cactive[slot] = True
+            finish[slot] = start_pos + n >= cursor.total
+            new_len[slot] = start_pos + n
+            budgets[slot] = req.max_new_tokens - 1
+            stops[slot] = (
+                next(iter(req.stop_ids)) if len(req.stop_ids) == 1 else -1
+            )
+            rids[slot] = req.id
+            if pc is not None:
+                kvcap[slot] = pc.owned_capacity(slot)
+        chunk_d = jnp.asarray(chunk)
+        start_d = jnp.asarray(start)
+        finish_d = jnp.asarray(finish)
+        newlen_d = jnp.asarray(new_len)
+        budgets_d = jnp.asarray(budgets)
+        stops_d = jnp.asarray(stops)
+        rids_d = jnp.asarray(rids)
+        # ragged dispatches re-upload the [B] sampling params (three tiny
+        # host→device copies, no sync) — chunk traffic is a small fraction
+        # of decode traffic, not worth a dirty-tracking cache
+        temps_d = jnp.asarray(self.temperature.copy())
+        topks_d = jnp.asarray(self.top_k.copy())
+        topps_d = jnp.asarray(self.top_p.copy())
+        if pc is not None:
+            tables_d = pc.tables_device()
+            cactive_d = jnp.asarray(cactive)
+            kvcap_d = jnp.asarray(kvcap)
+            with self._cold_dispatch("ragged", "paged", pc.quantized, N):
+                if pc.quantized:
+                    (packed, last_logits, pc.k_pool, pc.v_pool, pc.ks_pool,
+                     pc.vs_pool, new_state) = batch_ops.ragged_step_paged_q(
+                        cfg, self.params, pc.k_pool, pc.v_pool,
+                        pc.ks_pool, pc.vs_pool, state, tables_d, chunk_d,
+                        start_d, cactive_d, kvcap_d, finish_d, newlen_d,
+                        budgets_d, stops_d, temps_d, topks_d, topps_d,
+                        rids_d, self._rng_root, mask_d, N,
+                    )
+                else:
+                    (packed, last_logits, pc.k_pool, pc.v_pool,
+                     new_state) = batch_ops.ragged_step_paged(
+                        cfg, self.params, pc.k_pool, pc.v_pool, state,
+                        tables_d, chunk_d, start_d, cactive_d, kvcap_d,
+                        finish_d, newlen_d, budgets_d, stops_d, temps_d,
+                        topks_d, topps_d, rids_d, self._rng_root,
+                        mask_d, N,
+                    )
+            new_cache = self.cache  # dense path untouched
+        else:
+            with self._cold_dispatch("ragged", "dense",
+                                     self.cache.quantized, N):
+                (packed, last_logits, new_cache,
+                 new_state) = batch_ops.ragged_step(
+                    cfg, self.params, self.cache, state, chunk_d, start_d,
+                    finish_d, newlen_d, budgets_d, stops_d, temps_d,
+                    topks_d, topps_d, rids_d, self._rng_root, mask_d, N,
+                )
+        prefill_rows = []
+        for slot, cursor, req, start_pos, n in chunk_rows:
+            idx = cursor.chunk_index
+            cursor.chunk_index += 1
+            cursor.dispatched = start_pos + n
+            fin = bool(finish[slot])
+            prefill_rows.append((slot, req, cursor, start_pos, n, fin, idx))
+            if self._tracer is not None and req.timeline is not None:
+                span = self._req_span(
+                    f"prefill_chunk:{idx}", "serve.prefill_chunk", req
+                )
+                span.set_attribute("chunk.index", idx)
+                span.set_attribute("chunk.tokens", n)
+                span.set_attribute("chunk.start", start_pos)
+                span.set_attribute("chunk.final", fin)
+        return packed, last_logits, new_cache, new_state, prefill_rows
 
     def _consume_block(self, rec: _Inflight) -> None:
         packed = _block_sync(rec.packed)  # THE one sync for N device steps
@@ -1896,6 +2353,59 @@ class ServingEngine:
                     else "length",
                 )
 
+        # -- prefill-chunk rows (ragged dispatches only): commit each
+        # chunk's residency, feed the chunk-prefix cache, and admit rows
+        # whose prompt just finished — their device-sampled first token
+        # rides the same packed sync in the trailing column
+        for slot, req, cursor, start_pos, n, fin, idx in rec.prefill_rows:
+            if (self.slots[slot] is not req
+                    or self._cursors.get(slot) is not cursor):
+                continue  # retired/requeued since dispatch: stale chunk
+            n_active += 1
+            cursor.committed = start_pos + n
+            self.cache_len[slot] = cursor.committed
+            if self.paged_cache is not None:
+                self.paged_cache.advance_slot(slot, n)
+            tl = req.timeline
+            if tl is not None:
+                tl.chunk(idx, n, prefix_hit=False, start=start_pos)
+                tl.end_span(f"prefill_chunk:{idx}")
+            if self._metrics:
+                self._metrics.record_histogram(
+                    "app_prefill_chunk_tokens", n, kind="compute",
+                )
+            # only whole-chunk-aligned spans have a precomputed key: the
+            # lookup walk probes exactly (k*C, k*C+C|total), and the paged
+            # extraction needs a page-aligned start — the planner
+            # guarantees this shape; a missing key (future policy drift)
+            # skips the put instead of failing the engine loop
+            put_key = (
+                cursor.cache_keys.get((start_pos, start_pos + n))
+                if cursor.cache_keys is not None else None
+            )
+            if (self._prefix_cache is not None and self._chunk_cache_enabled
+                    and rec.last_logits is not None and put_key is not None):
+                # chunk-prefix cache PUT: the chunk's K/V just became
+                # resident — extract its slab (pure device reads, no sync;
+                # the slices/gathers are fresh buffers safe to retain) and
+                # store it with the prefix's last-position logits, so a
+                # later prompt sharing this prefix skips the chunk
+                if self.paged_cache is not None:
+                    k_slab, v_slab = self.paged_cache.read_span(
+                        slot, start_pos, start_pos + n
+                    )
+                else:
+                    k_slab = self.cache.k[:, slot, start_pos : start_pos + n]
+                    v_slab = self.cache.v[:, slot, start_pos : start_pos + n]
+                self._prefix_cache.put(
+                    put_key,
+                    (rec.last_logits[slot : slot + 1], k_slab, v_slab),
+                )
+            if fin:
+                self._cursors.pop(slot, None)
+                first_id = int(packed[slot, rec.steps + 2])
+                self._commit_first_token(slot, req, first_id)
+
         if self._metrics and n_active:
             host_ms = (rec.host_s + (time.perf_counter() - now)) * 1e3
             self._metrics.record_histogram(
@@ -1921,6 +2431,41 @@ class ServingEngine:
             with self._detok_mu:
                 depth = self._detok_depth
             self._metrics.set_gauge("app_detok_queue_depth", depth)
+
+    def _commit_first_token(self, slot: int, req: _Request,
+                            first_id: int) -> None:
+        """THE first-token commit tail, shared by the monolithic prefill
+        path (_commit_prefilled, which scatters the _pending_admit fold
+        first) and the ragged chunked path (where the token was sampled
+        on device and folded into the DecodeState inside the dispatch):
+        TTFT stamps/metrics, emission, and the ONE stop/length retire
+        chain — a divergence between the two admission routes is exactly
+        the bug class sharing this prevents."""
+        req.first_token_at = time.perf_counter()
+        self.last_token[slot] = first_id
+        ttft = req.first_token_at - req.created
+        self._shed.observe_ttft(ttft)
+        tl = req.timeline
+        if tl is not None:
+            # prefill end + first token share the commit instant: the
+            # sampled first token IS the prefill's last output
+            tl.stamp("prefill_end")
+            tl.stamp("first_token")
+            tl.end_span("prefill")  # no-op on the chunked path (per-chunk
+            # spans end at their own consumes)
+        if self._metrics:
+            self._metrics.record_histogram("app_ttft_seconds", ttft)
+            self._metrics.record_histogram(
+                "app_request_ttft_seconds", ttft, source="engine",
+            )
+        self._emit_token(req, first_id)
+        self._check_retired()  # stream_cb may have blocked across a restart
+        if first_id in req.stop_ids:
+            self._retire(slot, "stop")
+        elif len(req.tokens) >= req.max_new_tokens:
+            self._retire(slot, "length")
+        elif tl is not None and self._tracer is not None:
+            self._req_span("decode", "serve.decode", req)
 
     # -- bookkeeping -----------------------------------------------------------
     def _commit_token(self, slot: int, req: _Request, token_id: int) -> None:
@@ -2018,6 +2563,7 @@ class ServingEngine:
                     )
         self.slots[slot] = None
         self.cache_len[slot] = 0
+        self._cursors.pop(slot, None)  # a mid-chunked-prefill retire
         if self.paged_cache is not None:
             self.paged_cache.free_slot(slot)
         try:
@@ -2110,6 +2656,10 @@ class ServingEngine:
         self._try_resolve(req, exc=ErrorDeadlineExceeded())
 
     def _finish(self, req: _Request, reason: str) -> None:
+        # flush the running iteration's busy slice BEFORE the settlement
+        # is queued: once the caller observes its result, the duty-cycle
+        # counter must already show the work that produced it
+        self._flush_busy()
         now = time.perf_counter()
         self._shed.observe_request(now - req.created)
         if reason == "deadline_exceeded" and self._metrics:
@@ -2314,6 +2864,15 @@ class ServingEngine:
         self._mask_dev: Any = None  # cached device active mask
         self._mask_host: Any = None  # host copy the cache was built from
         self._last_consume_t: float | None = None
+        # continuous batching: per-slot chunk cursors for prompts mid-
+        # chunked-prefill (serving/stepplan.py). A slot with a live cursor
+        # holds its request but is NOT a decode row yet; the cursor's
+        # committed/dispatched carry the chunk position between
+        # iterations. Rebuilt empty on warm restart — partially-prefilled
+        # requests requeue from chunk 0 (their KV died with the pools).
+        self._cursors: dict[int, ChunkCursor] = {}
+        self._cursor_seq = 0
+        self._plan_gauges: tuple | None = None  # last-exported step-plan gauges
         self._sched = Scheduler(
             self.config.max_slots, self.config.max_queue,
             self.config.prefill_token_budget,
@@ -2336,8 +2895,11 @@ class ServingEngine:
 
     def _fail_all(self, exc: Exception, kv_unhealthy: bool | None = None) -> None:
         # pipeline state is unrecoverable mid-step: drop the in-flight
-        # record and force re-upload of device-resident state
+        # record and force re-upload of device-resident state. Chunk
+        # cursors die with it — their rows fail through the slot sweep
+        # below like any other active request.
         self._inflight_q.clear()
+        self._cursors.clear()
         self._pending_admit.clear()
         self._dec_state = None  # rebuilt from host mirrors at next dispatch
         self._mask_dev = None

@@ -304,6 +304,66 @@ class PagedKVCache:
                 self.k_pool, self.v_pool, k_slab, v_slab, page_ids
             )
 
+    def write_span(
+        self, slot: int, start: int, k_slab: jnp.ndarray, v_slab: jnp.ndarray
+    ) -> None:
+        """Scatter a cached chunk-prefix slab [L, C, Hkv, Dh] into the
+        pages covering token span [start, start+C) — ``write_prefill``'s
+        offset twin for chunked admissions that skip cached chunk
+        prefixes. ``start`` must be page-aligned; the caller reserved
+        coverage through ``alloc_slot``/``try_reserve_slot`` first. The
+        slab is padded to whole pages (pad positions sit beyond
+        ``seq_lens`` and are masked at read)."""
+        if self.quantized:
+            raise ValueError("write_span: int8 pools take no cached slabs")
+        if start % self.page_size:
+            raise ValueError(f"write_span start {start} not page-aligned")
+        seq_id = self._slot_seq[slot]
+        assert seq_id is not None
+        L, C, Hkv, Dh = k_slab.shape
+        p0 = start // self.page_size
+        p1 = self.pages_needed(start + C)
+        pad = (p1 - p0) * self.page_size - C
+        if pad:
+            k_slab = jnp.pad(k_slab, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            v_slab = jnp.pad(v_slab, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        owned = self.allocator.block_table(seq_id)
+        if p1 > len(owned):
+            self.allocator.extend(seq_id, p1 * self.page_size)
+            owned = self.allocator.block_table(seq_id)
+            self.tables[slot, : len(owned)] = owned
+        page_ids = jnp.asarray(owned[p0:p1], jnp.int32)
+        self.k_pool, self.v_pool = _write_pages(
+            self.k_pool, self.v_pool, k_slab, v_slab, page_ids
+        )
+
+    def read_span(
+        self, slot: int, start: int, end: int
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Gather the slot's resident K/V for token span [start, end) out
+        of the page pool into contiguous slabs [L, end-start, Hkv, Dh] —
+        the chunk-prefix cache's extraction path (serving/engine.py).
+        ``start`` must be page-aligned (chunk boundaries are); the gather
+        is a pure device read (no sync, nothing donated) and the returned
+        slabs are fresh buffers safe to retain across later dispatches.
+        bf16 pools only: a quantized pool would have to dequantize here,
+        and re-quantizing on the next hit would drift — the engine keeps
+        chunk-prefix caching off for int8 layouts."""
+        if self.quantized:
+            raise ValueError("read_span: int8 pools are not extractable")
+        if start % self.page_size:
+            raise ValueError(f"read_span start {start} not page-aligned")
+        p0 = start // self.page_size
+        p1 = self.pages_needed(end)
+        page_ids = self.tables[slot, p0:p1]
+        k = self.k_pool[:, page_ids]  # [L, n, Hkv, page, Dh]
+        v = self.v_pool[:, page_ids]
+        L, n, Hkv, page, Dh = k.shape
+        k = k.transpose(0, 1, 3, 2, 4).reshape(L, n * page, Hkv, Dh)
+        v = v.transpose(0, 1, 3, 2, 4).reshape(L, n * page, Hkv, Dh)
+        off = start - p0 * self.page_size  # 0 by alignment, kept explicit
+        return k[:, off : off + (end - start)], v[:, off : off + (end - start)]
+
     def tables_device(self) -> jnp.ndarray:
         # .copy(): host→device transfers are async, and the engine's
         # pipelined dispatch mutates self.tables (extend_slot) while the

@@ -52,7 +52,8 @@ class RequestTimeline:
     __slots__ = (
         "request_id", "trace_id", "created_unix", "prompt_tokens",
         "phases", "decode_blocks", "decode_tokens", "last_block_at",
-        "finish_reason", "terminal_at", "terminal_marks", "spans", "_t0",
+        "prefill_chunks", "finish_reason", "terminal_at", "terminal_marks",
+        "spans", "_t0",
     )
 
     def __init__(self, request_id: int, prompt_tokens: int = 0,
@@ -66,6 +67,11 @@ class RequestTimeline:
         self.decode_blocks = 0
         self.decode_tokens = 0
         self.last_block_at: float | None = None
+        # chunked-prefill record (continuous batching): one entry per
+        # committed prefill chunk — {index, tokens, prefix_hit, ms}. A
+        # monolithic (single-bucket) prefill leaves this empty; the
+        # prefill_start→prefill_end stamps cover it either way.
+        self.prefill_chunks: list[dict[str, Any]] = []
         self.finish_reason: str | None = None
         self.terminal_at: float | None = None
         # how many times a terminal state was recorded for this request —
@@ -89,6 +95,23 @@ class RequestTimeline:
         self.decode_blocks += 1
         self.decode_tokens += int(n_tokens)
         self.last_block_at = time.monotonic() if t is None else t
+
+    def chunk(self, index: int, n_tokens: int, prefix_hit: bool = False,
+              start: int = 0) -> None:
+        """One committed prefill chunk (or a skipped cached prefix),
+        stamped at the ragged block's single host sync — same zero-new-
+        device-syncs rule as :meth:`block`. ``start`` is the chunk's
+        token offset in the prompt: the chaos tier audits that committed
+        spans are contiguous and never overlap (a requeued request
+        restarts at 0 — double-prefilling committed KV is the bug class
+        the audit pins)."""
+        self.prefill_chunks.append({
+            "index": int(index),
+            "start": int(start),
+            "tokens": int(n_tokens),
+            "prefix_hit": bool(prefix_hit),
+            "ms": round((time.monotonic() - self._t0) * 1e3, 3),
+        })
 
     # -- span registry -------------------------------------------------------
     def open_span(self, phase: str, span: Any) -> Any:
@@ -185,6 +208,10 @@ class RequestTimeline:
                 ),
             },
         }
+        if self.prefill_chunks:
+            # snapshot (list() of the live list): the engine thread may
+            # append a chunk while /requestz serializes an in-flight row
+            out["prefill_chunks"] = list(self.prefill_chunks)
         for key, value in (
             ("queue_wait_ms", self.queue_wait_s()),
             ("ttft_ms", self.ttft_s()),

@@ -123,6 +123,54 @@ def test_run_check_cli_pass_and_fail(tmp_path):
                      floors_path=floors_path, out=io.StringIO()) == 2
 
 
+MIN_FLOORS = {
+    "engine_mixed_ttft_ms_p50_tiny_cpu": {
+        "floor": 100.0, "tolerance": 0.50, "direction": "min",
+    },
+}
+
+
+def test_min_direction_floor_gates_latency_regressions():
+    """direction:"min" inverts the gate for latency-style metrics (TTFT
+    under load): lower is better, the violation is EXCEEDING the floor
+    plus tolerance."""
+    ok = [rec("engine_mixed_ttft_ms_p50_tiny_cpu", 140.0)]  # <= 150 allowed
+    bad = [rec("engine_mixed_ttft_ms_p50_tiny_cpu", 160.0)]
+    assert check_records(ok, MIN_FLOORS)[0] == []
+    violations, _ = check_records(bad, MIN_FLOORS)
+    assert len(violations) == 1
+    assert "above the ratcheted ceiling" in violations[0]
+
+
+def test_min_direction_best_value_is_the_lowest():
+    records = [
+        rec("engine_mixed_ttft_ms_p50_tiny_cpu", 400.0),
+        rec("engine_mixed_ttft_ms_p50_tiny_cpu", 90.0),  # best (lowest)
+        rec("engine_mixed_ttft_ms_p50_tiny_cpu", 200.0),
+    ]
+    assert check_records(records, MIN_FLOORS)[0] == []
+
+
+def test_min_direction_update_ratchets_down_never_up():
+    records = [rec("engine_mixed_ttft_ms_p50_tiny_cpu", 80.0)]
+    updated = update_floors(records, MIN_FLOORS)
+    entry = updated["engine_mixed_ttft_ms_p50_tiny_cpu"]
+    assert entry["floor"] == 80.0 and entry["direction"] == "min"
+    # a worse run never loosens the committed floor
+    worse = update_floors(
+        [rec("engine_mixed_ttft_ms_p50_tiny_cpu", 500.0)], MIN_FLOORS
+    )
+    assert worse["engine_mixed_ttft_ms_p50_tiny_cpu"]["floor"] == 100.0
+
+
+def test_min_direction_round_trips_through_the_floors_file(tmp_path):
+    path = str(tmp_path / "floors.json")
+    save_floors(MIN_FLOORS, path)
+    loaded = load_floors(path)
+    entry = loaded["engine_mixed_ttft_ms_p50_tiny_cpu"]
+    assert entry["direction"] == "min" and entry["floor"] == 100.0
+
+
 def test_bench_py_check_entrypoint_needs_no_backend():
     """`bench.py --check` is the CI gate: it must run (and pass against the
     committed BENCH_LOCAL.jsonl) without initializing any jax backend —

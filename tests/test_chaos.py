@@ -336,6 +336,103 @@ def test_lifecycle_invariant_under_faults(seed, kv_layout, monkeypatch):
             eng.stop()
 
 
+def _assert_chunk_spans_never_double_prefill(eng: ServingEngine) -> None:
+    """The chunked-prefill invariant: within one slot tenancy, committed
+    chunk spans are contiguous and strictly increasing — a chunk cursor
+    never re-commits (double-prefills) KV it already committed. A requeue
+    (pool pressure, warm restart) legitimately restarts a NEW run at
+    start 0; overlap or regression WITHIN a run is the bug class."""
+    for tl in eng.timeline.all():
+        runs: list[list] = [[]]
+        for c in tl.prefill_chunks:
+            if c["start"] == 0 and runs[-1]:
+                runs.append([])
+            runs[-1].append(c)
+        for run in runs:
+            pos = 0
+            for c in run:
+                assert c["start"] == pos, (
+                    f"request {tl.request_id}: chunk committed at "
+                    f"{c['start']}, expected {pos}: {tl.prefill_chunks}"
+                )
+                pos = c["start"] + c["tokens"]
+        # a request that produced tokens finished its prefill: the final
+        # run covers the whole prompt exactly once
+        if tl.prefill_chunks and (
+            tl.decode_tokens or "first_token" in tl.phases
+        ):
+            assert sum(c["tokens"] for c in runs[-1]) == tl.prompt_tokens, (
+                tl.request_id, tl.prefill_chunks, tl.prompt_tokens,
+            )
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+@pytest.mark.parametrize("kv_layout", ["dense", "paged"])
+def test_mid_chunk_faults_preserve_lifecycle(seed, kv_layout):
+    """Faults landing MID-CHUNKED-PREFILL — while a step plan is being
+    assembled (the sched.plan point) and while the paged pool is under
+    pressure — must preserve the lifecycle invariant for partially-
+    prefilled requests: slots+pages reclaimed, exactly one terminal per
+    request, and a chunk cursor never double-prefills committed KV."""
+    kw = dict(
+        kv_layout=kv_layout, max_seq_len=128, prefill_buckets=(16,),
+        prefill_chunk_tokens=8, max_slots=2,
+    )
+    if kv_layout == "paged":
+        kw.update(kv_page_size=8, kv_num_pages=20)  # tight: real pressure
+    eng = make_engine(**kw)
+
+    rates = {
+        "sched.plan": 0.05,
+        "sched.admit": 0.04,
+        "decode.dispatch": 0.04,
+    }
+    if kv_layout == "paged":
+        rates["kv.alloc"] = 0.10
+    inj = chaos.ChaosInjector(seed, rates, max_faults=3)
+
+    outcomes: list = []
+    lock = threading.Lock()
+
+    def submit_one(i: int) -> None:
+        # every other request is LONG (4+ chunks at chunk=8); the rest are
+        # the usual short/deadline/cancel mix
+        kind = ("long", "short", "long_cancel", "deadline")[i % 4]
+        prompt = ("Z" * 40) if kind.startswith("long") else f"req {i}"[:8]
+        deadline = 30.0 if kind == "deadline" else None
+        try:
+            fut = eng.submit(prompt, max_new_tokens=(2, 4)[i % 2],
+                             temperature=0.0, deadline=deadline)
+        except TERMINAL_ERRORS as exc:
+            with lock:
+                outcomes.append((kind, exc))
+            return
+        if kind == "long_cancel":
+            eng.cancel(fut.request_id)
+        with lock:
+            outcomes.append((kind, fut))
+
+    eng.start()
+    try:
+        with chaos.active(inj):
+            with cf.ThreadPoolExecutor(4) as ex:
+                list(ex.map(submit_one, range(12)))
+            _assert_terminal(outcomes)
+        # still servable after the storm, then drain clean
+        probe = eng.submit("probe", max_new_tokens=2).result(timeout=60)
+        assert probe.finish_reason in ("stop", "length")
+        _assert_reclaimed(eng)
+        assert eng.drain(deadline_s=60) is True
+        assert eng.health_check()["status"] == "DOWN"
+        _assert_timelines_terminal(eng)
+        _assert_chunk_spans_never_double_prefill(eng)
+    finally:
+        if eng._running:
+            eng.stop()
+
+
 @pytest.mark.chaos
 @pytest.mark.slow
 @pytest.mark.parametrize("seed", CHAOS_SEEDS)

@@ -242,6 +242,8 @@ def test_engine_config_reads_every_knob():
         "TPU_BATCH_PREFILL_BUCKETS": "32, 64,128",
         "TPU_BATCH_ADMISSION_PER_STEP": "7",
         "TPU_BATCH_PREFILL_BUDGET": "2048",
+        "TPU_PREFILL_CHUNK_TOKENS": "96",
+        "TPU_STEP_TOKEN_BUDGET": "384",
         "TPU_IDLE_SLEEP_S": "0.01",
         "TPU_KV_LAYOUT": "paged",
         "TPU_KV_PAGE_SIZE": "32",
@@ -257,6 +259,8 @@ def test_engine_config_reads_every_knob():
     assert cfg.prefill_buckets == (32, 64, 128)
     assert cfg.admission_per_step == 7
     assert cfg.prefill_token_budget == 2048
+    assert cfg.prefill_chunk_tokens == 96
+    assert cfg.step_token_budget == 384
     assert cfg.idle_sleep_s == 0.01
     assert cfg.kv_layout == "paged"
     assert cfg.kv_page_size == 32
@@ -390,17 +394,27 @@ def test_decode_sync_every_depth_matches_depth_one(engine_setup):
 
 
 def test_prompt_longer_than_largest_bucket_truncates(engine_setup):
-    """A prompt exceeding every prefill bucket keeps its tail instead of
-    crashing the prefill slab scatter (regression: shape (18,) into (16,))."""
+    """A prompt exceeding every prefill bucket is SERVED IN FULL through
+    chunked prefill now (continuous batching) — the old tail-truncation
+    survives only where chunking is off (speculative mode), where it
+    still guards the original slab-scatter crash (shape (18,) into (16,))."""
     cfg, params = engine_setup
     engine = make_engine(cfg, params, prefill_buckets=(16,))
     engine.start()
     try:
         r = engine.submit("x" * 40, max_new_tokens=3, temperature=0.0).result(timeout=120)
-        assert r.prompt_tokens <= 16
+        assert r.prompt_tokens == 41  # whole prompt, chunked — not truncated
         assert r.completion_tokens >= 1
     finally:
         engine.stop()
+    spec = make_engine(cfg, params, prefill_buckets=(16,), spec_tokens=2)
+    spec.start()
+    try:
+        r = spec.submit("x" * 40, max_new_tokens=3, temperature=0.0).result(timeout=120)
+        assert r.prompt_tokens <= 16  # monolithic path: tail within the bucket
+        assert r.completion_tokens >= 1
+    finally:
+        spec.stop()
 
 
 def test_prefix_cache_skips_repeat_prefills(engine_setup):

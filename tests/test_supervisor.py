@@ -643,7 +643,10 @@ def test_stall_inside_warmed_dispatch_heals_without_corruption():
     batch_ops.prefill_compute = stall_once
     try:
         old_thread = eng._thread
-        res = eng.submit("stalls mid-prefill", max_new_tokens=4).result(
+        # prompt must fit the 16-token bucket: the stall is pinned INSIDE
+        # the monolithic prefill_compute dispatch (a longer prompt would
+        # route through chunked prefill and never reach the patched stall)
+        res = eng.submit("stalls mid-pre", max_new_tokens=4).result(
             timeout=120
         )
         # the request survived the restart and finished NORMALLY — before
