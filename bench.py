@@ -526,6 +526,161 @@ def _engine_mixed_load(cfg: Any, params: Any, on_tpu: bool) -> dict:
         engine.stop()
 
 
+def _router_warm_prefix(cfg: Any, params: Any, on_tpu: bool) -> dict:
+    """Warm-prefix TTFT at multi-replica scale (ROADMAP item 3, AIBrix
+    multi-tier KV pooling arXiv:2504.03648): two in-process replicas
+    behind the real Router, heartbeat-gossiped prefix advertisements,
+    host-RAM spill enabled, and a mid-run failover of the affine
+    replica. Repeated-system-prompt traffic populates one replica's
+    prefix cache; after the failover the survivor admits the same
+    prefixes via warm KV migration instead of cold re-prefill. The
+    headline — timeline-derived warm-prefix TTFT p50 across the tier —
+    is CPU-verifiable: the direction:"min" floor
+    (router_warm_prefix_ttft_ms_p50_*) gates it without a TPU run."""
+    from gofr_tpu.datasource.pubsub import InMemoryBroker
+    from gofr_tpu.serving import (
+        ByteTokenizer,
+        EngineConfig,
+        KVMigrator,
+        LocalReplica,
+        ReplicaAnnouncer,
+        Router,
+        RouterConfig,
+        ServingEngine,
+        local_engine_fetcher,
+    )
+
+    chunk = 64 if on_tpu else 16
+    broker = InMemoryBroker(consumer_group="bench-router")
+    router = Router(
+        RouterConfig(heartbeat_s=0.05, suspect_after_s=0.6,
+                     down_after_s=5.0, spill_wait_s=0.0),
+        broker=broker,
+    )
+    engines: dict[str, Any] = {}
+    migrators: dict[str, Any] = {}
+    for rid in ("rep-0", "rep-1"):
+        migrators[rid] = KVMigrator(rid, router.prefix_index)
+        engines[rid] = ServingEngine(
+            cfg, params,
+            EngineConfig(
+                max_slots=8,
+                max_seq_len=512 if on_tpu else 128,
+                prefill_buckets=(64,) if on_tpu else (16,),
+                prefill_chunk_tokens=chunk,
+                max_queue=64,
+                prefix_cache_entries=64,
+                kv_spill_bytes=64 << 20,
+            ),
+            ByteTokenizer(cfg.vocab_size),
+            metrics=_engine_metrics(),
+            kv_migrator=migrators[rid],
+        )
+    for rid, eng in engines.items():
+        other = next(r for r in engines if r != rid)
+        migrators[rid].add_peer(other, local_engine_fetcher(engines[other]))
+        router.add_replica(LocalReplica(rid, eng))
+    announcers = {
+        rid: ReplicaAnnouncer(rid, eng, broker, interval_s=0.05)
+        for rid, eng in engines.items()
+    }
+    for eng in engines.values():
+        eng.start()
+    router.start()
+    for ann in announcers.values():
+        ann.start()
+    deadline = time.monotonic() + 10.0
+    while (len(router.membership.candidates()) < 2
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
+    try:
+        # warm every executable on BOTH replicas off the clock; their
+        # compile-dominated timelines are excluded from the stats below
+        warmup_rids: dict[str, set] = {rid: set() for rid in engines}
+        for rid, eng in engines.items():
+            for wp in ("z" * (chunk * 4), "z"):
+                r = eng.submit(wp, max_new_tokens=4,
+                               temperature=0.0).result(timeout=1200)
+                warmup_rids[rid].add(r.request_id)
+        sys_prompt = ("You are a serving benchmark. Answer briefly. "
+                      * ((chunk * 3) // 40 + 1))[: chunk * 3]
+        prompts = [sys_prompt + f"q{i}" for i in range(4)]
+        max_new = 8 if on_tpu else 4
+
+        def issue(prompt: str):
+            return router.submit(
+                prompt, max_new_tokens=max_new, temperature=0.0, deadline=60.0
+            ).result(timeout=1200)
+
+        # shared-prefix population + repeats on the affine replica
+        for _round in range(3):
+            for p in prompts:
+                issue(p)
+        # beats carry the populated advertisement before the failover
+        time.sleep(0.3)
+        affine = max(
+            router.routes_by_replica, key=router.routes_by_replica.get
+        )
+        survivor = next(r for r in engines if r != affine)
+        # failover mid-run: the affine replica goes silent and drains —
+        # its cache stays fetchable (the warm-transfer source)
+        announcers[affine].stop(final_beat=False)
+        router.mark_replica_down(affine, reason="bench-failover")
+        engines[affine].drain(deadline_s=10.0)
+        for _round in range(3):
+            for p in prompts:
+                issue(p)
+
+        warm_ttfts: list[float] = []
+        cold_ttfts: list[float] = []
+        migrated = 0
+        for rid, eng in engines.items():
+            for tl in eng.timeline.completed():
+                ttft = tl.ttft_s()
+                if (ttft is None or tl.prefix_tier is None
+                        or tl.request_id in warmup_rids[rid]):
+                    continue
+                if tl.prefix_tier == "miss":
+                    cold_ttfts.append(ttft)
+                else:
+                    warm_ttfts.append(ttft)
+                    if tl.prefix_tier == "remote":
+                        migrated += 1
+        if not warm_ttfts:
+            # emitting 0.0 here would trivially satisfy (and ratchet)
+            # the direction:"min" floor — the exact regression the gate
+            # exists to catch must surface as a phase error instead
+            raise RuntimeError(
+                "warm-prefix phase produced no warm-tier samples "
+                "(advertisements or migration broken?)"
+            )
+        warm = _percentiles(warm_ttfts)
+        cold = _percentiles(cold_ttfts)
+        return {
+            "warm_ttft_ms_p50": warm.get("p50_ms", 0.0),
+            "warm_ttft_ms_p99": warm.get("p99_ms", 0.0),
+            "cold_ttft_ms_p50": cold.get("p50_ms", 0.0),
+            "warm_vs_cold": round(
+                cold.get("p50_ms", 0.0) / max(warm.get("p50_ms", 0.0), 1e-6), 2
+            ),
+            "warm_samples": len(warm_ttfts),
+            "cold_samples": len(cold_ttfts),
+            "remote_migrated_requests": migrated,
+            "kv_migrations": sum(
+                m.migrations_total for m in migrators.values()
+            ),
+            "failed_over_replica": affine,
+            "survivor": survivor,
+            "prefill_chunk_tokens": chunk,
+        }
+    finally:
+        for ann in announcers.values():
+            ann.stop(final_beat=False)
+        router.stop()
+        for eng in engines.values():
+            eng.stop()
+
+
 def _http_generate_load(engine: Any, on_tpu: bool) -> dict:
     """The same engine behind the real HTTP server: closed-loop POST
     /generate, end-to-end latency measured at the client."""
@@ -1108,6 +1263,21 @@ def _run_benchmarks(platform: str, init_error: str | None, wall_start: float) ->
     if "error" not in mixed_line:
         _append_local_record(mixed_line)
 
+    # --- warm-prefix TTFT across replicas (KV reuse tier, CPU-verifiable) --
+    def run_warm_prefix() -> dict:
+        if params is None:
+            raise RuntimeError("skipped: headline phase failed to build params")
+        return _router_warm_prefix(cfg, params, on_tpu)
+
+    warm_line = _phase_line(
+        f"router_warm_prefix_ttft_ms_p50_{model_kind}_{platform}", "ms",
+        run_warm_prefix, value_key="warm_ttft_ms_p50",
+        on_tpu=on_tpu and not init_error, init_error=init_error,
+    )
+    print(json.dumps(warm_line), flush=True)
+    if "error" not in warm_line:
+        _append_local_record(warm_line)
+
     # --- framework-only phases (no TPU dependence at all) ------------------
     echo_line = _phase_line(
         "grpc_unary_echo_req_per_s", "req/s", _grpc_unary_echo,
@@ -1286,6 +1456,9 @@ def _engine_metrics() -> Any:
     m.new_gauge("app_batch_queue_depth", "queue depth")
     m.new_gauge("app_batch_occupancy", "occupancy")
     m.new_gauge("app_kv_cache_pages_used", "pages")
+    m.new_counter("app_kv_prefix_hits_total", "prefix hits by tier")
+    m.new_gauge("app_kv_spill_bytes", "host spill tier bytes")
+    m.new_counter("app_kv_migrations_total", "warm prefix migrations")
     return m
 
 

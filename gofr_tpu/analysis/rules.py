@@ -78,6 +78,16 @@ DISPATCH_ZONES: dict[str, set[str] | str] = {
     "gofr_tpu/serving/stepplan.py": "*",
     "gofr_tpu/serving/native_embed.py": "*",
     "gofr_tpu/serving/router.py": "*",
+    # KV reuse tier: engine-thread-facing surfaces only — the spill
+    # worker (_spill_task/_to_host) and the wire codec (encode_entry)
+    # run off-thread BY DESIGN and stay out of the zone
+    "gofr_tpu/serving/kv_spill.py": {
+        "get", "get_with_tier", "put", "peek", "evict", "_offer",
+        "_to_device", "advertised",
+    },
+    "gofr_tpu/serving/prefix_index.py": {
+        "fetch_chain", "fetch_one", "locate", "longest_chain", "observe",
+    },
 }
 
 # retry/backoff paths reachable from handlers: uninterruptible sleeps only
@@ -112,9 +122,20 @@ HOT_SYNC_ZONES: dict[str, set[str] | str] = {
         "_commit_token", "_commit_first_token", "_emit_token",
         "_emit_async", "_block_sync", "_slot_in_flight",
         "_make_device_state", "_retire", "_plan_step", "_cursor_health",
+        "_cache_lookup", "_record_prefix_tier",
     },
     "gofr_tpu/serving/batch.py": "*",
     "gofr_tpu/serving/stepplan.py": "*",
+    # migration/upload paths that run on the engine thread: a host sync
+    # sneaking in here would stall admission behind a device round-trip.
+    # The spill worker's np.asarray (device→host, its own thread) and
+    # the /kv/fetch codec (HTTP worker) are deliberately OUTSIDE.
+    "gofr_tpu/serving/kv_spill.py": {
+        "get", "get_with_tier", "put", "peek", "_offer", "_to_device",
+    },
+    "gofr_tpu/serving/prefix_index.py": {
+        "fetch_chain", "fetch_one", "locate", "longest_chain",
+    },
 }
 
 BLOCKING_CALLS = {

@@ -38,6 +38,8 @@ POINTS = (
     "engine.step",      # top of the engine loop iteration (raise AND hang)
     "device.loss",      # device/executable poisoning (persistent KV dies)
     "kv.alloc",         # paged-KV pool allocation / extension
+    "kv.spill",         # host-RAM spill worker (device→host copy drops)
+    "kv.migrate",       # cross-replica KV page fetch (source dies mid-transfer)
     "service.request",  # outbound HTTP service client
     "pubsub.publish",   # pubsub publish
     "pubsub.subscribe",  # consumer-loop poll (broker fetch)

@@ -152,6 +152,23 @@ class Container:
         m.new_gauge("app_batch_queue_depth", "Requests waiting for batch admission")
         m.new_gauge("app_batch_occupancy", "Fraction of batch slots occupied")
         m.new_gauge("app_kv_cache_pages_used", "Paged KV-cache pages in use")
+        # cluster-wide KV reuse tiers (serving/kv_spill.py +
+        # serving/prefix_index.py, docs/performance.md "KV reuse tiers"):
+        # which tier served each admission's cached prefix, the host
+        # spill pool's residency, and cross-replica warm migrations
+        m.new_counter(
+            "app_kv_prefix_hits_total",
+            "Prefix-cache admission lookups by warmest serving tier "
+            "(label tier=device|host|remote|miss)",
+        )
+        m.new_gauge(
+            "app_kv_spill_bytes",
+            "Bytes resident in the host-RAM KV spill tier",
+        )
+        m.new_counter(
+            "app_kv_migrations_total",
+            "Warm KV prefix migrations fetched from another replica",
+        )
         m.new_histogram("app_ttft_seconds", "Time to first token")
         m.new_histogram(
             "app_tpot_seconds", "Time per output token",

@@ -19,6 +19,10 @@ the unit of concurrency is the *slot*, not the thread. Components:
 - router.py / membership.py: the multi-replica router tier — pubsub
   heartbeat membership, prefix-affinity routing with failover, hedged
   prefill admission (docs/robustness.md "The router plane").
+- kv_spill.py / prefix_index.py: the cluster-wide KV reuse tiers —
+  host-RAM spill pool under the device prefix cache, heartbeat-gossiped
+  distributed prefix index, warm KV page migration between replicas
+  (docs/performance.md "KV reuse tiers").
 - timeline.py / device_telemetry.py: the observability layer — per-request
   lifecycle timelines behind /requestz, and the TPU HBM / duty-cycle
   poller feeding health, metrics and membership heartbeats
@@ -37,6 +41,12 @@ from gofr_tpu.serving.router import (
     LocalReplica,
     Router,
     RouterConfig,
+)
+from gofr_tpu.serving.kv_spill import HostSpillTier, TieredPrefixCache
+from gofr_tpu.serving.prefix_index import (
+    KVMigrator,
+    PrefixIndex,
+    local_engine_fetcher,
 )
 from gofr_tpu.serving.supervisor import EngineSupervisor
 from gofr_tpu.serving.timeline import RequestTimeline, TimelineRecorder
@@ -59,4 +69,9 @@ __all__ = [
     "TimelineRecorder",
     "RequestTimeline",
     "DeviceTelemetry",
+    "TieredPrefixCache",
+    "HostSpillTier",
+    "PrefixIndex",
+    "KVMigrator",
+    "local_engine_fetcher",
 ]

@@ -93,6 +93,11 @@ class EngineConfig:
     # The byte bound caps HBM regardless of bucket sizes.
     prefix_cache_entries: int = 0
     prefix_cache_bytes: int = 256 * 1024 * 1024
+    # host-RAM spill tier under the device prefix cache (serving/
+    # kv_spill.py, docs/performance.md "KV reuse tiers"): entries the
+    # device LRU evicts spill to pinned host arrays instead of dropping,
+    # and a hit re-uploads asynchronously. 0 disables the tier.
+    kv_spill_bytes: int = 0
     # speculative decoding (prompt-lookup drafting): K draft tokens are
     # verified per dispatch; greedy rows commit the accepted prefix + a
     # bonus token (LOSSLESS vs plain greedy), sampled rows take normal
@@ -157,6 +162,9 @@ class EngineConfig:
             prefix_cache_bytes=int(
                 config.get_or_default("TPU_PREFIX_CACHE_BYTES",
                                       str(256 * 1024 * 1024))
+            ),
+            kv_spill_bytes=int(
+                config.get_or_default("TPU_KV_SPILL_BYTES", "0")
             ),
             spec_tokens=int(config.get_or_default("TPU_SPEC_TOKENS", "0")),
             spec_ngram=int(config.get_or_default("TPU_SPEC_NGRAM", "3")),
@@ -294,6 +302,7 @@ class ServingEngine:
         tracer: Any = None,
         seed: int = 0,
         prefix_cache: Any = None,
+        kv_migrator: Any = None,
     ) -> None:
         self.model_cfg = cfg
         self.params = params
@@ -305,14 +314,32 @@ class ServingEngine:
         if prefix_cache is not None:
             self._prefix_cache = prefix_cache  # any container Cache impl
         elif self.config.prefix_cache_entries > 0:
-            from gofr_tpu.serving.prefix_cache import PrefixCache
+            if self.config.kv_spill_bytes > 0:
+                # two-tier: device LRU over a host-RAM spill pool —
+                # capacity evictions demote instead of dropping
+                # (docs/performance.md "KV reuse tiers")
+                from gofr_tpu.serving.kv_spill import TieredPrefixCache
 
-            self._prefix_cache = PrefixCache(
-                self.config.prefix_cache_entries,
-                max_bytes=self.config.prefix_cache_bytes,
-            )
+                self._prefix_cache = TieredPrefixCache(
+                    self.config.prefix_cache_entries,
+                    max_bytes=self.config.prefix_cache_bytes,
+                    spill_bytes=self.config.kv_spill_bytes,
+                    metrics=metrics,
+                )
+            else:
+                from gofr_tpu.serving.prefix_cache import PrefixCache
+
+                self._prefix_cache = PrefixCache(
+                    self.config.prefix_cache_entries,
+                    max_bytes=self.config.prefix_cache_bytes,
+                )
         else:
             self._prefix_cache = None
+        # cluster-wide KV reuse (serving/prefix_index.py): when wired, a
+        # local cache miss consults the distributed prefix index and
+        # migrates the advertised slabs from the owning replica instead
+        # of re-prefilling — advisory, every failure degrades to compute
+        self._kv_migrator = kv_migrator
 
         if self.config.kv_dtype not in ("bf16", "int8"):
             raise ValueError(
@@ -607,6 +634,15 @@ class ServingEngine:
                 "engine stopped before the request was served; retry",
                 retry_after=1.0,
             ))
+        # the spill tier's worker executor (serving/kv_spill.py) is
+        # engine-lifetime: stop accepting device→host copies now —
+        # already-queued spills still settle. isinstance, NOT duck-typed:
+        # an injected container cache may expose close() with datasource
+        # semantics the engine must never invoke on a shared resource
+        from gofr_tpu.serving.kv_spill import TieredPrefixCache
+
+        if isinstance(self._prefix_cache, TieredPrefixCache):
+            self._prefix_cache.close()
         try:
             self._sched.close()  # fallible: destroy status is checked
         finally:
@@ -1431,6 +1467,53 @@ class ServingEngine:
         self._observe_queue()
         return bool(pairs or canceled_ids)
 
+    # -- KV reuse tiers (prefix cache + host spill + cluster migration) --------
+    def _cache_lookup(self, key: str) -> tuple[Any, str]:
+        """Prefix-cache lookup with tier attribution: ``(value, tier)``
+        where tier is ``device`` / ``host`` / ``miss``. Plain (single-
+        tier) caches report ``device`` on a hit."""
+        cache = self._prefix_cache
+        tiered = getattr(cache, "get_with_tier", None)
+        if tiered is not None:
+            return tiered(key)
+        value = cache.get(key)
+        return value, ("device" if value is not None else "miss")
+
+    def _record_prefix_tier(self, req: _Request, tier: str) -> None:
+        """Stamp the request's warmest-source attribution — the
+        ``/requestz`` timeline's ``prefix_tier`` and the per-tier hit
+        counter (docs/observability.md). First stamp wins on the
+        timeline (a pool-pressure requeue keeps its original truth);
+        the counter counts admission walks."""
+        tl = req.timeline
+        if tl is not None and tl.prefix_tier is None:
+            tl.prefix_tier = tier
+        if self._metrics:
+            self._metrics.increment_counter(
+                "app_kv_prefix_hits_total", tier=tier
+            )
+
+    def prefix_advertisement(self, limit: int = 128) -> list[list[str]] | None:
+        """This replica's bounded [key, tier] advertisement for the
+        distributed prefix index (serving/prefix_index.py), carried on
+        the membership heartbeat. None when the cache exposes no key
+        listing (injected container caches)."""
+        cache = self._prefix_cache
+        if cache is None:
+            return None
+        advertised = getattr(cache, "advertised", None)
+        if advertised is not None:
+            pairs = advertised(limit)
+        else:
+            keys_fn = getattr(cache, "keys", None)
+            if keys_fn is None:
+                return None
+            pairs = [
+                (str(k), "device")
+                for k in list(reversed(keys_fn()))[:limit]
+            ]
+        return [[key, tier] for key, tier in pairs]
+
     def _prefill_into(self, slot: int, req: _Request) -> None:
         cfg = self.model_cfg
         S = len(req.prompt_ids)
@@ -1463,6 +1546,7 @@ class ServingEngine:
 
         cache_key = None
         cached = None
+        prefix_tier = None
         if self._prefix_cache is not None:
             # sampling params are NOT in the key: the cached value is the
             # pre-sampling prefill output, shared across temperatures.
@@ -1475,7 +1559,25 @@ class ServingEngine:
                 np.asarray(req.prompt_ids, np.int32).tobytes(), digest_size=16
             ).hexdigest()
             cache_key = f"prefill:{bucket}:{len(req.prompt_ids)}:{digest}"
-            cached = self._prefix_cache.get(cache_key)
+            cached, prefix_tier = self._cache_lookup(cache_key)
+            if cached is None and self._kv_migrator is not None:
+                # cluster tier: another replica advertises this exact
+                # prefill — migrate its slabs instead of recomputing
+                # (advisory: any failure stays a compute miss)
+                fetched = self._kv_migrator.fetch_one(cache_key)
+                # the fetch can block (remote transport timeout): a warm
+                # restart may have retired this thread meanwhile — the
+                # put below would poison the cache the restart just
+                # reset (the same hazard as the compute-path put)
+                self._check_retired()
+                if fetched is not None:
+                    from gofr_tpu.serving.kv_spill import _to_device
+
+                    cached = _to_device(fetched)
+                    prefix_tier = "remote"
+                    # pay the transfer once per replica, not per request
+                    self._prefix_cache.put(cache_key, cached)
+            self._record_prefix_tier(req, prefix_tier)
 
         tl = req.timeline
         if tl is not None:
@@ -1490,6 +1592,7 @@ class ServingEngine:
             if pspan is not None:
                 pspan.set_attribute("prefill.bucket", bucket)
                 pspan.set_attribute("prefill.prefix_hit", cached is not None)
+                pspan.set_attribute("prefix_tier", prefix_tier or "miss")
                 pspan.set_attribute("tokens.prompt", S)
         # bind the KV storage ONCE, before the long dispatch: a warm
         # restart that replaces this thread mid-compute swaps
@@ -1621,15 +1724,49 @@ class ServingEngine:
         hits: list[tuple[int, int, Any]] = []
         pos = 0
         cache_keys: dict[tuple[int, int], str] | None = None
+        tiers: set[str] = set()
         if self._prefix_cache is not None and self._chunk_cache_enabled:
             boundaries = self._chunk_cache_keys(req.prompt_ids)
             cache_keys = {(s, e): k for s, e, k in boundaries}
             for start, end, key in boundaries:
-                val = self._prefix_cache.get(key)
+                val, tier = self._cache_lookup(key)
                 if val is None:
                     break
                 hits.append((start, end, val))
+                tiers.add(tier)
                 pos = end
+            if pos < total and self._kv_migrator is not None:
+                # cluster tier: migrate the longest advertised
+                # chunk-boundary chain from the owning replica. The
+                # fetch is advisory and contiguous-from-pos by contract
+                # — a torn transfer keeps the fetched prefix and the
+                # planner's chunk grants compute the rest (never a
+                # double-prefill: committed spans stay contiguous).
+                remaining = [b for b in boundaries if b[0] >= pos]
+                fetched = self._kv_migrator.fetch_chain(remaining)
+                # the fetch can block (remote transport timeout): a
+                # retired thread must not put dead slabs into the
+                # replacement engine's freshly-reset cache
+                self._check_retired()
+                if fetched:
+                    from gofr_tpu.serving.kv_spill import _to_device
+
+                    for start, end, val in fetched:
+                        val = _to_device(val)  # async upload, no sync
+                        hits.append((start, end, val))
+                        pos = end
+                        # pay the transfer once per replica: later
+                        # requests sharing this prefix hit locally
+                        self._prefix_cache.put(
+                            cache_keys[(start, end)], val
+                        )
+                    tiers.add("remote")
+            self._record_prefix_tier(
+                req,
+                "remote" if "remote" in tiers
+                else "host" if "host" in tiers
+                else "device" if hits else "miss",
+            )
 
         from gofr_tpu.serving.kv_cache import OutOfBlocks
 
@@ -1668,6 +1805,13 @@ class ServingEngine:
             # straight to decode — zero prefill dispatches (the admission-
             # path sync mirrors the monolithic prefix-hit path)
             span = self._req_span("prefill", "serve.prefill chunked (prefix hit)", req)
+            if tl is not None:
+                pspan = tl.spans.get("prefill")
+                if pspan is not None:
+                    pspan.set_attribute("prefill.prefix_hit", True)
+                    pspan.set_attribute(
+                        "prefix_tier", tl.prefix_tier or "device"
+                    )
             with span:
                 last_logits = hits[-1][2][0]
                 key = jax.random.fold_in(self._rng_root, req.id)
@@ -2290,6 +2434,11 @@ class ServingEngine:
                 span.set_attribute("chunk.tokens", n)
                 span.set_attribute("chunk.start", start_pos)
                 span.set_attribute("chunk.final", fin)
+                # warm-transfer attribution: which tier served this
+                # request's cached prefix (miss = fully computed)
+                span.set_attribute(
+                    "prefix_tier", req.timeline.prefix_tier or "miss"
+                )
         return packed, last_logits, new_cache, new_state, prefill_rows
 
     def _consume_block(self, rec: _Inflight) -> None:

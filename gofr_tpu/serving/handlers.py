@@ -119,6 +119,7 @@ def register_generation_routes(app: Any, engine: Any, prefix: str = "",
     app.post(prefix + "/generate", generate)
     app.get(prefix + "/v1/models", models)
     register_requestz_routes(app, engine, prefix + "/requestz")
+    register_kv_fetch_routes(app, engine, prefix + "/kv/fetch")
 
 
 def _sse_response(engine: Any, prompt: str, kw: dict) -> WireResponse:
@@ -282,6 +283,54 @@ def register_requestz_routes(app: Any, engine: Any,
 
     app.get(path, requestz)
     app.get(path + "/{request_id}", requestz_one)
+
+
+def register_kv_fetch_routes(app: Any, engine: Any,
+                             path: str = "/kv/fetch") -> None:
+    """Warm KV page migration, server half (docs/performance.md "KV
+    reuse tiers"): POST ``{"keys": [...]}`` returns the requested
+    prefix-cache entries — whole-prompt prefills and chunk-boundary K/V
+    delta slabs — serialized for the wire
+    (serving/prefix_index.encode_entry). Keys the cache no longer holds
+    are simply absent from the response: the advertisement that named
+    them was stale, and the fetching replica degrades to a compute miss.
+    The device→host materialization runs on the HTTP worker thread,
+    never the engine thread. Registered automatically by
+    ``register_generation_routes``."""
+    from gofr_tpu.serving.prefix_index import encode_entry
+
+    MAX_KEYS = 64  # one fetch moves at most one prompt's chain
+
+    async def kv_fetch(ctx: Any):
+        body = ctx.bind(dict) or {}
+        keys = body.get("keys")
+        if not keys or not isinstance(keys, list):
+            raise ErrorMissingParam("keys")
+        if len(keys) > MAX_KEYS:
+            raise ErrorInvalidParam("keys")
+        cache = getattr(engine, "_prefix_cache", None)
+        entries: dict[str, Any] = {}
+        if cache is not None:
+            loop = asyncio.get_running_loop()
+
+            # peek, never get: serving a peer must not mutate this
+            # replica's LRU order or pop its host-tier copies
+            read = getattr(cache, "peek", None) or cache.get
+
+            def gather() -> dict[str, Any]:
+                out: dict[str, Any] = {}
+                for key in keys:
+                    value = read(str(key))
+                    if value is not None:
+                        out[str(key)] = encode_entry(value)
+                return out
+
+            # off the event loop: encode_entry materializes device
+            # arrays host-side (a sync) and base64s megabytes of slab
+            entries = await loop.run_in_executor(None, gather)
+        return {"entries": entries}
+
+    app.post(path, kv_fetch)
 
 
 def register_router_routes(app: Any, router: Any,

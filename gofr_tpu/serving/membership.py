@@ -72,6 +72,12 @@ class Heartbeat:
     kv_free_frac: float = 1.0   # paged-KV pool headroom (0..1)
     hbm_free_frac: float | None = None  # device HBM headroom, if known
     ts: float = 0.0             # publisher wall clock, informational only
+    # distributed prefix index (serving/prefix_index.py): a BOUNDED
+    # [key, tier] advertisement of this replica's cached prefixes —
+    # piggybacked here so the index rides the same idempotent per-replica
+    # seq discipline the membership table already enforces. None when
+    # the replica advertises nothing (no prefix cache wired).
+    prefix_keys: list | None = None
 
     def to_json(self) -> bytes:
         return json.dumps(dataclasses.asdict(self)).encode()
@@ -291,6 +297,7 @@ class ReplicaAnnouncer:
         interval_s: float = 1.0,
         logger: Any = None,
         hbm_headroom: Callable[[], float | None] | None = None,
+        advert_limit: int = 128,
     ) -> None:
         self.replica_id = replica_id
         self.engine = engine
@@ -299,6 +306,9 @@ class ReplicaAnnouncer:
         self.interval_s = interval_s
         self._logger = logger
         self._hbm_headroom = hbm_headroom
+        # prefix-index advertisement bound: a heartbeat must stay a
+        # heartbeat (0 disables advertising entirely)
+        self.advert_limit = advert_limit
         self._seq = 0
         self._seq_mu = threading.Lock()
         self._stop = threading.Event()
@@ -332,6 +342,16 @@ class ReplicaAnnouncer:
             # not a permanently-stubbed None
             poller = getattr(self.engine, "device_telemetry", None)
             hbm = poller.hbm_headroom() if poller is not None else None
+        prefix_keys = None
+        if self.advert_limit > 0:
+            advertise = getattr(self.engine, "prefix_advertisement", None)
+            if advertise is not None:
+                try:
+                    prefix_keys = advertise(self.advert_limit)
+                except Exception:
+                    prefix_keys = None  # the index is advisory: never
+                    # let it break the heartbeat the router's failure
+                    # detection depends on
         with self._seq_mu:
             self._seq += 1
             seq = self._seq
@@ -345,6 +365,7 @@ class ReplicaAnnouncer:
             kv_free_frac=kv_free,
             hbm_free_frac=hbm,
             ts=time.time(),
+            prefix_keys=prefix_keys,
         )
 
     def beat(self) -> bool:

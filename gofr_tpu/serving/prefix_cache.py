@@ -52,7 +52,8 @@ class PrefixCache:
     prompts would pin gigabytes beside the serving KV cache."""
 
     def __init__(self, max_entries: int = 32,
-                 max_bytes: int = 256 * 1024 * 1024) -> None:
+                 max_bytes: int = 256 * 1024 * 1024,
+                 on_evict: Any = None) -> None:
         self.max_entries = max(1, max_entries)
         self.max_bytes = max_bytes
         self._entries: "OrderedDict[Hashable, Any]" = OrderedDict()
@@ -61,6 +62,10 @@ class PrefixCache:
         self._lock = threading.Lock()
         self._hits = 0
         self._misses = 0
+        # capacity-eviction hook (the host-RAM spill tier,
+        # serving/kv_spill.py): called OUTSIDE the lock with each
+        # (key, value) the LRU dropped — the lock stays leaf-only
+        self.on_evict = on_evict
 
     def get(self, key: Hashable) -> Any | None:
         with self._lock:
@@ -78,6 +83,7 @@ class PrefixCache:
             # inserting-then-evicting would flush every useful entry to
             # make room for one that cannot fit anyway (code-review r4)
             return
+        evicted: list[tuple[Hashable, Any]] = []
         with self._lock:
             if key in self._entries:
                 self._total_bytes -= self._sizes.get(key, 0)
@@ -89,13 +95,26 @@ class PrefixCache:
                 len(self._entries) > self.max_entries
                 or self._total_bytes > self.max_bytes
             ):
-                old_key, _ = self._entries.popitem(last=False)
+                old_key, old_value = self._entries.popitem(last=False)
                 self._total_bytes -= self._sizes.pop(old_key, 0)
+                evicted.append((old_key, old_value))
+        if self.on_evict is not None:
+            for old_key, old_value in evicted:
+                try:
+                    self.on_evict(old_key, old_value)
+                except Exception:
+                    pass  # the spill tier is advisory: a failed spill is a miss later
 
     def evict(self, key: Hashable) -> None:
         with self._lock:
             if self._entries.pop(key, None) is not None:
                 self._total_bytes -= self._sizes.pop(key, 0)
+
+    def keys(self) -> list[Hashable]:
+        """Resident keys, least-recently-used first — the distributed
+        prefix index advertises these (serving/prefix_index.py)."""
+        with self._lock:
+            return list(self._entries.keys())
 
     def clear(self) -> None:
         with self._lock:

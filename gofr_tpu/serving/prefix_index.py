@@ -1,0 +1,306 @@
+"""The distributed prefix index + warm KV page migration.
+
+Before this module, every prefix-cache hit stopped at the replica
+boundary: the router's consistent-hash affinity was the only
+cross-replica reuse, and it collapsed into a cold re-prefill on failover
+or load spill. This is the cluster tier (ROADMAP item 3, AIBrix
+multi-tier KV pooling arXiv:2504.03648), in two halves:
+
+- :class:`PrefixIndex` — who has what. Replicas piggyback a bounded
+  ``prefix_keys`` advertisement ([key, tier] pairs straight out of their
+  :class:`TieredPrefixCache`) on the existing membership heartbeat
+  (serving/membership.py), riding the same idempotent per-replica ``seq``
+  discipline: the at-least-once pubsub layer may redeliver or reorder
+  beats, and a stale advertisement must never overwrite a newer one.
+  Lookups are **advisory** — a stale entry degrades to a compute miss on
+  the fetch path, never an error.
+
+- :class:`KVMigrator` — go get it. When a replica admits a request whose
+  prefix is cached elsewhere (affinity spill, pre-first-token failover),
+  the migrator locates the peer with the longest advertised
+  chunk-boundary chain and fetches the slabs: direct cache-to-cache when
+  the peer is colocated in-process (:func:`local_engine_fetcher` — the
+  slabs are already device arrays), serialized page transfer over the
+  HTTP surface otherwise (``/kv/fetch``, serving/handlers.py +
+  ``HTTPReplica.fetch_kv``). Fetched entries admit through the existing
+  chunk-prefix commit path (``kv_cache.write_span`` /
+  ``batch_ops.insert_chunk``) and land in the local cache, so the
+  migration pays once per replica, not once per request.
+
+The ``kv.migrate`` chaos point sits on every peer fetch: a fault there
+IS a source replica dying mid-transfer — the migrator returns whatever
+contiguous prefix it already fetched and the engine computes the rest
+(tests/test_router_chaos.py pins that this degrades to re-prefill,
+never corrupts KV or double-serves).
+
+Lock discipline: the index lock is LEAF-ONLY (never held across a fetch
+or any call out); the migrator itself is lock-free — peers are
+registered before serving starts and the dict is read-only after.
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+from gofr_tpu import chaos
+
+__all__ = [
+    "PrefixIndex",
+    "KVMigrator",
+    "local_engine_fetcher",
+    "encode_entry",
+    "decode_entry",
+]
+
+
+# -- slab serialization (the remote page-transfer wire format) -----------------
+
+def _np_dtype(name: str) -> Any:
+    """Resolve a dtype name, including the ml_dtypes extended set
+    (bfloat16 — the KV slab dtype on every bf16 layout)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # ships with jax
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_entry(value: Any) -> dict[str, Any]:
+    """Serialize one prefix-cache entry — a (last_logits, k_slab,
+    v_slab) tuple of arrays — to a JSON-safe dict. The caller owns the
+    device→host materialization cost (np.asarray on each leaf): this
+    runs on an HTTP worker thread, never the engine thread."""
+    leaves = []
+    for leaf in value:
+        arr = np.asarray(leaf)
+        leaves.append({
+            "shape": list(arr.shape),
+            "dtype": arr.dtype.name,
+            "data": base64.b64encode(arr.tobytes()).decode("ascii"),
+        })
+    return {"leaves": leaves}
+
+
+def decode_entry(payload: dict[str, Any]) -> tuple:
+    """Inverse of :func:`encode_entry`: host numpy arrays, ready for an
+    async ``jnp.asarray`` upload at the commit site."""
+    out = []
+    for leaf in payload["leaves"]:
+        arr = np.frombuffer(
+            base64.b64decode(leaf["data"]), dtype=_np_dtype(leaf["dtype"])
+        ).reshape(leaf["shape"])
+        out.append(arr)
+    return tuple(out)
+
+
+# -- the cluster-wide index ----------------------------------------------------
+
+class PrefixIndex:
+    """digest → (replica, tier) advertisements, replica-versioned.
+
+    ``observe`` REPLACES a replica's advertised set (each beat carries
+    the replica's current bounded view, not a delta) and drops stale
+    ``seq``s — the same idempotency discipline MembershipTable.observe
+    applies to the beats these advertisements ride on."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        # replica_id -> (seq, {key: tier})
+        self._by_replica: dict[str, tuple[int, dict[str, str]]] = {}
+
+    def observe(self, replica_id: str, seq: int,
+                entries: list[Any] | None) -> bool:
+        """Ingest one replica's advertisement. Returns False for
+        stale/duplicate seqs (pubsub redelivery or reorder)."""
+        if entries is None:
+            return False
+        keyed: dict[str, str] = {}
+        for item in entries:
+            try:
+                key, tier = item[0], item[1]
+            except (TypeError, IndexError, KeyError):
+                continue  # malformed advertisement rows are dropped
+            keyed[str(key)] = str(tier)
+        with self._mu:
+            prev = self._by_replica.get(replica_id)
+            if prev is not None and seq <= prev[0]:
+                return False
+            self._by_replica[replica_id] = (int(seq), keyed)
+            return True
+
+    def drop_replica(self, replica_id: str) -> None:
+        with self._mu:
+            self._by_replica.pop(replica_id, None)
+
+    def locate(self, key: str,
+               exclude: str | None = None) -> list[tuple[str, str]]:
+        """Replicas advertising ``key``, as (replica_id, tier) pairs —
+        device tier first (a device-resident slab serves the transfer
+        without its owner touching host RAM)."""
+        out: list[tuple[str, str]] = []
+        with self._mu:
+            for rid, (_seq, entries) in self._by_replica.items():
+                if rid == exclude:
+                    continue
+                tier = entries.get(key)
+                if tier is not None:
+                    out.append((rid, tier))
+        out.sort(key=lambda rt: (rt[1] != "device", rt[0]))
+        return out
+
+    def longest_chain(self, keys: list[str],
+                      exclude: str | None = None) -> tuple[str | None, int]:
+        """The replica advertising the longest CONTIGUOUS leading run of
+        ``keys`` (the chunk-boundary chain of one prompt) — the router's
+        and the migrator's shared question: where does the warmest copy
+        of this prefix live?"""
+        best: tuple[str | None, int] = (None, 0)
+        with self._mu:
+            for rid, (_seq, entries) in self._by_replica.items():
+                if rid == exclude:
+                    continue
+                n = 0
+                for key in keys:
+                    if key not in entries:
+                        break
+                    n += 1
+                if n > best[1]:
+                    best = (rid, n)
+        return best
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._mu:
+            return {
+                rid: {"seq": seq, "advertised": len(entries)}
+                for rid, (seq, entries) in self._by_replica.items()
+            }
+
+
+# -- warm page migration -------------------------------------------------------
+
+def local_engine_fetcher(engine: Any) -> Callable[[list[str]], dict[str, tuple]]:
+    """Peer fetcher for a COLOCATED in-process replica: reads the
+    owning engine's prefix cache directly — the slabs are already
+    device arrays, so the 'transfer' is a reference (device-to-device
+    by construction, zero copies)."""
+
+    def fetch(keys: list[str]) -> dict[str, tuple]:
+        cache = getattr(engine, "_prefix_cache", None)
+        if cache is None:
+            return {}
+        # peek, never get: a peer read must not mutate the owner's LRU
+        # order, promote host-tier entries into its device budget, or
+        # destructively pop its only host copy (TieredPrefixCache.peek)
+        read = getattr(cache, "peek", None) or cache.get
+        out: dict[str, tuple] = {}
+        for key in keys:
+            value = read(key)
+            if value is not None:
+                out[key] = value
+        return out
+
+    return fetch
+
+
+class KVMigrator:
+    """The admitting replica's pull side of warm KV migration.
+
+    ``peers`` maps replica_id → ``fetch(keys) -> {key: (logits, k, v)}``
+    (device arrays from a local peer, host numpy from a remote one —
+    the engine's commit path uploads either asynchronously). Every
+    failure mode — peer gone, stale advertisement, chaos fault, torn
+    transfer — degrades to a compute miss: the migrator returns the
+    contiguous prefix it DID fetch and the engine prefills the rest.
+
+    A FAILED fetch suppresses that peer for ``failure_backoff_s``: a
+    dead replica's advertisements can outlive it (mark-down keeps no
+    fresh beats coming, but nothing retracts the old ones), and without
+    negative caching every cache-miss admission would block the engine
+    thread up to the transport timeout, per request, forever. The
+    single-caller discipline (one engine's admission thread) keeps the
+    migrator lock-free.
+    """
+
+    def __init__(self, replica_id: str, index: PrefixIndex, *,
+                 logger: Any = None, metrics: Any = None,
+                 failure_backoff_s: float = 5.0) -> None:
+        self.replica_id = replica_id
+        self.index = index
+        self._logger = logger
+        self._metrics = metrics
+        self.failure_backoff_s = failure_backoff_s
+        self._peers: dict[str, Callable[[list[str]], dict[str, tuple]]] = {}
+        self._suppressed_until: dict[str, float] = {}
+        self.migrations_total = 0
+        self.failed_fetches_total = 0
+
+    def add_peer(self, replica_id: str,
+                 fetch: Callable[[list[str]], dict[str, tuple]]) -> None:
+        self._peers[replica_id] = fetch
+
+    def remove_peer(self, replica_id: str) -> None:
+        self._peers.pop(replica_id, None)
+
+    def fetch_chain(
+        self, boundaries: list[tuple[int, int, str]]
+    ) -> list[tuple[int, int, tuple]]:
+        """Fetch the longest advertised contiguous run of chunk-boundary
+        entries for ``boundaries`` ([(start, end, key), ...], the
+        engine's remaining un-cached chain). Returns [(start, end,
+        value), ...], contiguous from the first boundary — possibly
+        empty, never raising."""
+        if not boundaries or not self._peers:
+            return []
+        keys = [key for _s, _e, key in boundaries]
+        rid, n = self.index.longest_chain(keys, exclude=self.replica_id)
+        if rid is None or n == 0:
+            return []
+        fetch = self._peers.get(rid)
+        if fetch is None:
+            return []  # advertised by a replica we hold no transport to
+        until = self._suppressed_until.get(rid)
+        if until is not None and time.monotonic() < until:
+            return []  # peer recently failed a fetch: don't stall
+            # admission behind its transport timeout again yet
+        want = boundaries[:n]
+        try:
+            chaos.maybe_fail("kv.migrate")
+            fetched = fetch([key for _s, _e, key in want])
+        except Exception as exc:
+            # the source died mid-transfer (or the chaos point fired):
+            # nothing was committed — a clean degrade to re-prefill,
+            # and the peer goes quiet for failure_backoff_s
+            self.failed_fetches_total += 1
+            self._suppressed_until[rid] = (
+                time.monotonic() + self.failure_backoff_s
+            )
+            if self._logger is not None:
+                self._logger.warn(
+                    f"KV migration fetch from {rid} failed; "
+                    f"re-prefilling: {exc}"
+                )
+            return []
+        self._suppressed_until.pop(rid, None)
+        out: list[tuple[int, int, tuple]] = []
+        for start, end, key in want:
+            value = fetched.get(key)
+            if value is None:
+                break  # stale advertisement: keep the contiguous prefix
+            out.append((start, end, value))
+        if out:
+            self.migrations_total += 1
+            if self._metrics is not None:
+                self._metrics.increment_counter("app_kv_migrations_total")
+        return out
+
+    def fetch_one(self, key: str) -> tuple | None:
+        """Single-entry fetch (the whole-prompt/monolithic prefill
+        path). Same advisory contract as :meth:`fetch_chain`."""
+        got = self.fetch_chain([(0, 0, key)])
+        return got[0][2] if got else None

@@ -54,6 +54,7 @@ from gofr_tpu.http.errors import (
 )
 from gofr_tpu.metrics.register import Histogram
 from gofr_tpu.serving import membership as ms
+from gofr_tpu.serving.prefix_index import PrefixIndex, decode_entry
 from gofr_tpu.service.options import (
     CircuitBreakerError,
     retry_after_from_headers,
@@ -314,6 +315,28 @@ class HTTPReplica:
     def cancel(self, request_id: int) -> None:
         pass  # no remote cancel wire yet; the deadline bounds the work
 
+    def fetch_kv(self, keys: list[str],
+                 timeout: float = 2.0) -> dict[str, tuple]:
+        """Warm KV page migration, remote half (serving/prefix_index.py):
+        fetch serialized prefix-cache slabs from this replica's
+        ``/kv/fetch`` surface. Returns {key: (logits, k, v)} as HOST
+        numpy arrays — the admitting engine uploads them asynchronously.
+        Raises on transport failure; the migrator's fetch contract turns
+        any raise into a clean compute miss."""
+        resp = self._svc.post(
+            "/kv/fetch", json={"keys": list(keys)}, timeout=timeout,
+        )
+        if not resp.ok:
+            raise ConnectionError(
+                f"replica {self.replica_id}: /kv/fetch HTTP {resp.status_code}"
+            )
+        body = resp.json()
+        data = body.get("data") or body
+        out: dict[str, tuple] = {}
+        for key, payload in (data.get("entries") or {}).items():
+            out[key] = decode_entry(payload)
+        return out
+
     def health_check(self) -> dict[str, Any]:
         return self._svc.health_check()
 
@@ -390,6 +413,12 @@ class Router:
             suspect_after_s=self.config.suspect_after_s or 3.0,
             down_after_s=self.config.down_after_s or 10.0,
         )
+        # cluster-wide KV reuse (serving/prefix_index.py): per-replica
+        # prefix advertisements ride the heartbeats this router already
+        # consumes — the router (and any replica handed this index) can
+        # locate the longest cached prefix anywhere in the tier. Purely
+        # advisory: a stale entry degrades to a compute miss downstream.
+        self.prefix_index = PrefixIndex()
         self._handles: dict[str, Any] = {}
         self._handles_mu = threading.Lock()
         self._ring: _HashRing | None = None
@@ -448,11 +477,16 @@ class Router:
             self._handles.pop(replica_id, None)
             self._ring = None
         self.membership.forget(replica_id)
+        self.prefix_index.drop_replica(replica_id)
 
     def mark_replica_down(self, replica_id: str,
                           reason: str = "breaker-open") -> None:
-        """The breaker's fast path into membership."""
+        """The breaker's fast path into membership. Also retracts the
+        replica's prefix advertisements: a dead replica's entries would
+        otherwise keep sending migrators into its transport timeout (a
+        fresh UP beat re-advertises along with clearing the mark)."""
         self.membership.mark_down(replica_id, reason)
+        self.prefix_index.drop_replica(replica_id)
         self._export_states()
 
     def _ring_for(self, ids: list[str]) -> _HashRing:
@@ -504,7 +538,7 @@ class Router:
                     self._stop.wait(self.config.heartbeat_s)
             if msg is not None:
                 try:
-                    self.membership.observe(ms.Heartbeat.from_json(msg.value))
+                    self.observe_heartbeat(ms.Heartbeat.from_json(msg.value))
                 except (ValueError, KeyError, TypeError):
                     pass  # malformed beat: drop, never crash the loop
                 try:
@@ -521,6 +555,15 @@ class Router:
             if now - last_export >= min(self.config.heartbeat_s, 0.5):
                 last_export = now
                 self._export_states()
+
+    def observe_heartbeat(self, hb: ms.Heartbeat) -> bool:
+        """Commit one heartbeat into membership AND the prefix index —
+        both idempotent under the at-least-once pubsub contract (the
+        beat's per-replica ``seq`` gates each)."""
+        fresh = self.membership.observe(hb)
+        if hb.prefix_keys is not None:
+            self.prefix_index.observe(hb.replica_id, hb.seq, hb.prefix_keys)
+        return fresh
 
     def _export_states(self) -> None:
         if self._metrics is None:
@@ -1027,6 +1070,7 @@ class Router:
             "aggregate_queue_wait_s": round(
                 self.membership.aggregate_queue_wait(), 4
             ),
+            "prefix_index": self.prefix_index.snapshot(),
             "counters": self._counters(),
             "config": {
                 "heartbeat_s": self.config.heartbeat_s,

@@ -52,8 +52,8 @@ class RequestTimeline:
     __slots__ = (
         "request_id", "trace_id", "created_unix", "prompt_tokens",
         "phases", "decode_blocks", "decode_tokens", "last_block_at",
-        "prefill_chunks", "finish_reason", "terminal_at", "terminal_marks",
-        "spans", "_t0",
+        "prefill_chunks", "prefix_tier", "finish_reason", "terminal_at",
+        "terminal_marks", "spans", "_t0",
     )
 
     def __init__(self, request_id: int, prompt_tokens: int = 0,
@@ -72,6 +72,11 @@ class RequestTimeline:
         # monolithic (single-bucket) prefill leaves this empty; the
         # prefill_start→prefill_end stamps cover it either way.
         self.prefill_chunks: list[dict[str, Any]] = []
+        # warmest KV source that served this request's cached prefix:
+        # device | host | remote | miss (None until admission walks the
+        # cache; docs/performance.md "KV reuse tiers"). First stamp wins
+        # — a requeued admission keeps its original attribution.
+        self.prefix_tier: str | None = None
         self.finish_reason: str | None = None
         self.terminal_at: float | None = None
         # how many times a terminal state was recorded for this request —
@@ -212,6 +217,8 @@ class RequestTimeline:
             # snapshot (list() of the live list): the engine thread may
             # append a chunk while /requestz serializes an in-flight row
             out["prefill_chunks"] = list(self.prefill_chunks)
+        if self.prefix_tier is not None:
+            out["prefix_tier"] = self.prefix_tier
         for key, value in (
             ("queue_wait_ms", self.queue_wait_s()),
             ("ttft_ms", self.ttft_s()),

@@ -365,3 +365,103 @@ def test_draining_replica_quiesces_cleanly():
         )
     finally:
         tier.stop()
+
+
+# -- KV reuse tier: migration interrupted mid-transfer -------------------------
+#
+# Chaos points exercised here: ``kv.migrate`` (the cross-replica fetch —
+# a fault IS the source dying mid-transfer) and ``kv.spill`` (the
+# host-RAM spill worker — a fault drops the demoted entry). The
+# invariant extends the PR 10 double-prefill audit across replicas: a
+# request whose migration tears must re-prefill cleanly — committed
+# chunk spans contiguous, covering the prompt exactly once, tokens
+# identical to the cold path, exactly one terminal — never corrupt KV,
+# never double-serve.
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_migration_interrupted_degrades_to_reprefill(seed):
+    import threading
+
+    import jax
+
+    from gofr_tpu.models import llama
+    from gofr_tpu.serving import (
+        ByteTokenizer,
+        EngineConfig,
+        KVMigrator,
+        PrefixIndex,
+        ServingEngine,
+        local_engine_fetcher,
+    )
+    from gofr_tpu.chaos.injector import ChaosInjector
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    def mk(migrator=None):
+        return ServingEngine(
+            cfg, params,
+            EngineConfig(
+                max_slots=6, max_seq_len=128, prefill_buckets=(16,),
+                max_queue=64, prefill_chunk_tokens=16,
+                prefix_cache_entries=4,      # tiny device tier: spills fire
+                kv_spill_bytes=1 << 22,
+            ),
+            ByteTokenizer(), kv_migrator=migrator,
+        )
+
+    index = PrefixIndex()
+    source = mk()
+    migrator = KVMigrator("B", index)
+    admitting = mk(migrator=migrator)
+    source_dead = threading.Event()
+    inner_fetch = local_engine_fetcher(source)
+
+    def dying_fetch(keys):
+        if source_dead.is_set():
+            raise ConnectionError("source replica died mid-transfer")
+        return inner_fetch(keys)
+
+    migrator.add_peer("A", dying_fetch)
+    source.start()
+    admitting.start()
+    try:
+        prompt = "migration under chaos " * 3   # 4+ chunks of 16
+        reference = source.submit(
+            prompt, max_new_tokens=4, temperature=0.0
+        ).result(timeout=300)
+        assert index.observe("A", 1, source.prefix_advertisement())
+        results = []
+        with chaos.active(ChaosInjector(
+            seed, {"kv.migrate": 0.6, "kv.spill": 0.4}, max_faults=4,
+        )):
+            for i in range(4):
+                results.append(admitting.submit(
+                    prompt, max_new_tokens=4, temperature=0.0,
+                ).result(timeout=300))
+            source_dead.set()   # the source dies for good mid-run
+            for i in range(4):
+                results.append(admitting.submit(
+                    prompt, max_new_tokens=4, temperature=0.0,
+                ).result(timeout=300))
+        for r in results:
+            # never corrupt KV: every admission — migrated, torn, or
+            # fully re-prefilled — produces the cold path's tokens
+            assert r.token_ids == reference.token_ids
+            tl = admitting.timeline.get(r.request_id)
+            assert tl is not None and tl.terminal_marks == 1  # never double-serve
+            spans = sorted(
+                (c["start"], c["start"] + c["tokens"])
+                for c in tl.prefill_chunks
+            )
+            pos = 0
+            for start, end in spans:   # the cross-replica double-prefill audit
+                assert start == pos, (r.request_id, tl.prefill_chunks)
+                pos = end
+            assert pos == r.prompt_tokens, (r.request_id, tl.prefill_chunks)
+            assert tl.prefix_tier in ("device", "host", "remote", "miss")
+    finally:
+        source.stop()
+        admitting.stop()
