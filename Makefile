@@ -18,8 +18,9 @@ check: import-check lint test native-asan bench-smoke
 # full suite.
 ci: lint bench-check
 	$(PY) -m gofr_tpu.analysis --chaos-coverage
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_analysis.py tests/test_shardcheck.py tests/test_lockcheck.py -q -m 'not slow' \
-	  --deselect tests/test_lockcheck.py::test_runtime_graph_is_subgraph_of_static
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_analysis.py tests/test_shardcheck.py tests/test_lockcheck.py tests/test_leakcheck.py -q -m 'not slow' \
+	  --deselect tests/test_lockcheck.py::test_runtime_graph_is_subgraph_of_static \
+	  --deselect tests/test_leakcheck.py::test_runtime_pairs_covered_by_static_table
 	$(MAKE) chaos
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
 	@echo "CI OK"
@@ -45,14 +46,15 @@ ci: lint bench-check
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py tests/test_supervisor.py tests/test_pubsub_chaos.py tests/test_router_chaos.py -q -m chaos
 
-# gofrlint (docs/static-analysis.md): framework-invariant AST lints over
-# the whole package (incl. the lockcheck concurrency families) + the
-# extern-C vs ctypes FFI signature cross-check, then the
-# stale-suppression audit (a suppression matching no raw finding fails —
-# rules drift, code moves). Exits non-zero on any unsuppressed finding.
+# gofrlint (docs/static-analysis.md): the unified front door — the
+# framework-invariant AST lints, the shardcheck SPMD family, the
+# lockcheck concurrency families, the leakcheck resource-lifecycle
+# families, the extern-C vs ctypes FFI signature cross-check, AND the
+# stale-suppression audit, in ONE shared SourceFile walk with one
+# baseline load (`--format sarif` emits SARIF 2.1.0 for CI annotation).
+# Exits non-zero on any unsuppressed finding.
 lint:
-	$(PY) -m gofr_tpu.analysis gofr_tpu/
-	$(PY) -m gofr_tpu.analysis --check-suppressions
+	$(PY) -m gofr_tpu.analysis --all
 
 # lock-order tier: run the concurrency tests with every Python lock
 # instrumented; any cyclic acquisition order (potential deadlock) fails.

@@ -31,9 +31,27 @@ C++ TUs already run under ASan/UBSan/TSan (``make native-asan`` /
   cross-thread attribute writes (``guarded-by``); exports the static
   graph (``--lock-graph``) that the runtime tier's observed graph is
   asserted a subgraph of.
+- :mod:`gofr_tpu.analysis.leakcheck` — whole-program resource-lifecycle
+  analysis: acquire/release pairing over a table of paired resources
+  with cross-file factory resolution and ``# leakcheck:
+  transfer(<recipient>)`` ownership annotations (``leak-unreleased``,
+  ``leak-exception-path``), settlement-reachability of raise edges
+  after a future/timeline registration (``settle-on-raise``), and
+  retirement gates between blocking fetches and state commits
+  (``retire-gate-missing``); exports the static resource table
+  (``--leak-table``) the runtime reclaim tracer's observed pairs are
+  asserted a subset of (``--check-leak-table``).
+- :mod:`gofr_tpu.analysis.leaktrace` — the runtime reclaim tracer:
+  instruments the allocator/scheduler/paged-slot/timeline lifecycles
+  during the chaos tier, fails on anything left live after drain, and
+  exports observed acquire/release pairs (``GOFR_LEAK_EXPORT``) for
+  the static coverage cross-check.
+- :mod:`gofr_tpu.analysis.sarif` — SARIF 2.1.0 output for the unified
+  ``--all`` front door (``--format sarif``), for CI annotation.
 - :mod:`gofr_tpu.analysis.audit` — the stale-suppression audit
-  (``--check-suppressions``): inline suppressions that match no raw
-  finding fail CI instead of silently swallowing the next real one.
+  (``--check-suppressions``, folded into the ``--all`` pass): inline
+  suppressions that match no raw finding fail CI instead of silently
+  swallowing the next real one.
 - :mod:`gofr_tpu.analysis.chaoscov` — chaos-coverage check
   (``--chaos-coverage``): every injection point registered in
   ``gofr_tpu/chaos/injector.py`` must be exercised by a ``make chaos``

@@ -32,25 +32,13 @@ def _default_repo_root() -> str:
 
 
 def _list_rules() -> None:
+    from gofr_tpu.analysis import leakcheck as lk
     from gofr_tpu.analysis import rules as rules_mod
     from gofr_tpu.analysis import shardcheck as sc
+    from gofr_tpu.analysis.sarif import RULE_DESCRIPTIONS
 
-    print("blocking-call        blocking primitives in dispatch/decode zones")
-    print("host-sync            host-device syncs in the decode hot path")
-    print("metric-unregistered  metric name used but never registered")
-    print("metric-dynamic-name  computed metric name at a call site")
-    print("metric-label-cardinality  unbounded metric label key/value")
-    print("ctypes-unchecked     native status code discarded")
-    print("ffi-mismatch/ffi-unbound/ffi-stale  extern-C vs ctypes drift")
-    print("mesh-axis-unknown    axis literal not declared by the mesh")
-    print("collective-unmapped  literal-axis collective outside shard_map/pmap")
-    print("use-after-donation   donated jit buffer read before rebinding")
-    print("retrace-hazard       per-request recompiles in the decode hot path")
-    print("lock-order-static    cycle in the whole-program lock graph")
-    print("hold-and-block       blocking op executed while a lock is held")
-    print("guarded-by           write skips the attribute's inferred guard")
-    print("stale-suppression    suppression matching no current finding")
-    print("bad-suppression      gofrlint suppression without a reason")
+    for rule in sorted(RULE_DESCRIPTIONS):
+        print(f"{rule:<25} {RULE_DESCRIPTIONS[rule]}")
     print()
     print("dispatch zones:", ", ".join(sorted(rules_mod.DISPATCH_ZONES)))
     print("backoff zones: ", ", ".join(sorted(rules_mod.BACKOFF_ZONES)))
@@ -58,6 +46,7 @@ def _list_rules() -> None:
         "retrace zones: ",
         ", ".join(sorted(sc.RETRACE_ZONE_FILES + sc.RETRACE_ZONE_DIRS)),
     )
+    print("retire-gate zones:", ", ".join(sorted(lk.RETIRE_GATE_ZONES)))
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -82,8 +71,16 @@ def main(argv: list[str] | None = None) -> int:
         "--ffi-only", action="store_true", help="run only the FFI cross-check"
     )
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text",
-        help="output format (json: stable finding ids for CI/editors)",
+        "--all", action="store_true",
+        help="unified front door: gofrlint+shardcheck+lockcheck+leakcheck "
+        "+ the FFI cross-check + the stale-suppression audit in ONE "
+        "shared SourceFile walk with one baseline load (make lint runs "
+        "this)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json", "sarif"), default="text",
+        help="output format (json: stable finding ids for CI/editors; "
+        "sarif: SARIF 2.1.0 for CI annotation)",
     )
     parser.add_argument(
         "--baseline", default=None, metavar="PATH",
@@ -121,6 +118,18 @@ def main(argv: list[str] | None = None) -> int:
         "(GOFR_LOCK_ORDER_EXPORT) is a subgraph of the static graph; "
         "`make lock-order` runs this on its export",
     )
+    parser.add_argument(
+        "--leak-table", action="store_true",
+        help="emit leakcheck's static resource table as JSON (the "
+        "runtime reclaim tracer's observed pairs must be a subset)",
+    )
+    parser.add_argument(
+        "--check-leak-table", metavar="PATH", default=None,
+        help="verify a runtime reclaim export (GOFR_LEAK_EXPORT / "
+        "gofr_tpu.analysis.leaktrace) is covered by the static resource "
+        "table: every observed acquire/release site must be statically "
+        "known",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -146,9 +155,13 @@ def main(argv: list[str] | None = None) -> int:
             return 1
         return 0
 
-    if args.lock_graph or args.check_lock_graph:
+    if (
+        args.lock_graph or args.check_lock_graph
+        or args.leak_table or args.check_leak_table or args.all
+    ):
         # same path validation as the lint modes: a typo'd directory must
-        # be a usage error, not an empty graph that vacuously verifies
+        # be a usage error, not an empty graph/table that vacuously
+        # verifies
         paths = args.paths or [os.path.join(repo_root, "gofr_tpu")]
         for p in paths:
             if not os.path.exists(p):
@@ -197,6 +210,114 @@ def main(argv: list[str] | None = None) -> int:
             f"lockcheck: runtime graph is a subgraph of the static graph "
             f"({len(runtime.get('edges', []))} observed edge(s) checked)"
         )
+        return 0
+
+    if args.leak_table:
+        from gofr_tpu.analysis.leakcheck import (
+            build_resource_table,
+            render_table_json,
+        )
+
+        print(render_table_json(build_resource_table(paths)))
+        return 0
+
+    if args.check_leak_table:
+        import json as _json
+
+        from gofr_tpu.analysis.leakcheck import (
+            build_resource_table,
+            check_coverage,
+        )
+
+        try:
+            with open(args.check_leak_table, encoding="utf-8") as fp:
+                runtime = _json.load(fp)
+        except (OSError, ValueError) as exc:
+            print(
+                f"error: cannot read runtime reclaim export "
+                f"{args.check_leak_table}: {exc}",
+                file=sys.stderr,
+            )
+            return 2
+        divergences = check_coverage(runtime, build_resource_table(paths))
+        for d in divergences:
+            print(d)
+        unreclaimed = runtime.get("unreclaimed", [])
+        for u in unreclaimed:
+            print(f"unreclaimed at runtime: {u}")
+        if divergences or unreclaimed:
+            print(
+                f"leakcheck: {len(divergences)} coverage divergence(s), "
+                f"{len(unreclaimed)} unreclaimed resource(s) — analyzer "
+                "blind spot or a real runtime leak "
+                "(docs/static-analysis.md#leakcheck)",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"leakcheck: runtime pairs covered by the static table "
+            f"({len(runtime.get('events', []))} observed event(s) checked)"
+        )
+        return 0
+
+    if args.all:
+        # the unified front door: ONE SourceFile walk serves the rule
+        # pass AND the stale-suppression audit, one baseline load gates
+        # the result; stale suppressions are never baselined (they cost
+        # nothing to delete)
+        from gofr_tpu.analysis.core import run_unified
+        from gofr_tpu.analysis.sarif import render_sarif
+
+        if args.update_baseline:
+            print(
+                "error: --update-baseline uses the classic mode "
+                "(without --all)",
+                file=sys.stderr,
+            )
+            return 2
+        findings, stale = run_unified(paths, default_rules())
+        if not args.no_ffi:
+            if os.path.isdir(os.path.join(repo_root, "native")):
+                findings.extend(check_ffi(repo_root))
+            else:
+                print(
+                    f"note: {repo_root}/native not found; FFI cross-check "
+                    "skipped",
+                    file=sys.stderr,
+                )
+        baselined = 0
+        if not args.no_baseline:
+            baseline_path = args.baseline or baseline_io.default_baseline_path()
+            findings, baselined = baseline_io.apply_baseline(
+                findings, baseline_io.load_baseline(baseline_path)
+            )
+        blocking = sorted(
+            findings + stale, key=lambda f: (f.path, f.line, f.rule)
+        )
+        if args.format == "sarif":
+            print(render_sarif(blocking))
+            return 1 if blocking else 0
+        if args.format == "json":
+            print(baseline_io.render_json(blocking))
+            return 1 if blocking else 0
+        for f in blocking:
+            print(f.render())
+        if baselined:
+            print(
+                f"gofrlint: {baselined} pre-existing finding(s) covered "
+                "by the baseline",
+                file=sys.stderr,
+            )
+        if blocking:
+            print(
+                f"\ngofrlint: {len(blocking)} finding(s) across the "
+                "unified pass. Fix, or justify with "
+                "'# gofrlint: disable=<rule> -- <reason>' "
+                "(docs/static-analysis.md).",
+                file=sys.stderr,
+            )
+            return 1
+        print("gofrlint: clean (unified pass incl. suppression audit)")
         return 0
 
     if args.check_suppressions:
@@ -286,6 +407,11 @@ def main(argv: list[str] | None = None) -> int:
             findings, baseline_io.load_baseline(baseline_path)
         )
 
+    if args.format == "sarif":
+        from gofr_tpu.analysis.sarif import render_sarif
+
+        print(render_sarif(findings))
+        return 1 if findings else 0
     if args.format == "json":
         print(baseline_io.render_json(findings))
         return 1 if findings else 0

@@ -19,54 +19,17 @@ the baseline; FFI findings have no inline-suppression form).
 
 from __future__ import annotations
 
-from gofr_tpu.analysis.core import (
-    Finding,
-    iter_python_files,
-    iter_suppression_records,
-    run_rules,
-)
+from gofr_tpu.analysis.core import Finding, run_unified
 
 
 def stale_suppressions(paths: list[str]) -> list[Finding]:
     """Return a ``stale-suppression`` finding for every inline
-    suppression under ``paths`` that matches no raw finding."""
-    import os
-
+    suppression under ``paths`` that matches no raw finding. One
+    implementation: this delegates to :func:`core.run_unified` — the
+    same shared-walk pass the ``--all`` front door runs — so the audit
+    and the front door can never drift (on a file-only subset
+    cross-file suppressions are preserved, same reasoning as the
+    baseline updater's partial-run preservation)."""
     from gofr_tpu.analysis.rules import default_rules
 
-    raw = run_rules(paths, default_rules(), honor_suppressions=False)
-    hits: dict[str, dict[int, set[str]]] = {}
-    for f in raw:
-        hits.setdefault(f.path, {}).setdefault(f.line, set()).add(f.rule)
-    # on a file-only subset run_rules skips finalize(), so cross-file
-    # rules produced no raw findings — their suppressions were not
-    # re-observed and must not be called stale (same reasoning as the
-    # baseline updater's partial-run preservation)
-    full_tree = any(os.path.isdir(p) for p in paths)
-    cross_file_rules = {r.name for r in default_rules() if r.cross_file}
-    out: list[Finding] = []
-    for full, rel in iter_python_files(paths):
-        with open(full, encoding="utf-8") as fp:
-            source = fp.read()
-        records, _bad = iter_suppression_records(source, rel)
-        for rec in records:
-            if not full_tree and rec.rules & cross_file_rules:
-                continue
-            file_hits = hits.get(rel, {})
-            used = any(
-                rule in file_hits.get(line, ())
-                for line in rec.covered
-                for rule in rec.rules
-            )
-            if not used:
-                out.append(
-                    Finding(
-                        "stale-suppression", rel, rec.line,
-                        f"suppression for {sorted(rec.rules)} matches no "
-                        "current finding — the rule drifted or the code "
-                        "moved; delete the comment (a stale suppression "
-                        "would silently swallow the NEXT real finding)",
-                    )
-                )
-    out.sort(key=lambda f: (f.path, f.line))
-    return out
+    return run_unified(paths, default_rules())[1]

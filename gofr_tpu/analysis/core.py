@@ -129,7 +129,15 @@ class SourceFile:
         self.rel_path = rel_path  # slash-normalized, relative to the walk root
         self.source = source
         self.tree = ast.parse(source, filename=path)
-        self.suppressions, self.bad_suppressions = parse_suppressions(source, rel_path)
+        # one tokenize pass serves both the live suppression table and
+        # the stale-suppression audit (run_unified reads the records)
+        self.suppression_records, self.bad_suppressions = (
+            iter_suppression_records(source, rel_path)
+        )
+        self.suppressions: dict[int, set[str]] = {}
+        for rec in self.suppression_records:
+            for line in rec.covered:
+                self.suppressions.setdefault(line, set()).update(rec.rules)
 
     def is_suppressed(self, rule: str, line: int) -> bool:
         return rule in self.suppressions.get(line, ())
@@ -224,3 +232,79 @@ def run_rules(
                     findings.append(finding)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
+
+
+_STALE_MESSAGE = (
+    "suppression for {rules} matches no current finding — the rule "
+    "drifted or the code moved; delete the comment (a stale suppression "
+    "would silently swallow the NEXT real finding)"
+)
+
+
+def run_unified(
+    paths: list[str], rules: list[Rule]
+) -> tuple[list[Finding], list[Finding]]:
+    """The ``--all`` front door's single shared walk: every file is read,
+    tokenized, and parsed ONCE; the rules run against the RAW (no
+    inline-suppression) view; the live findings are recovered by
+    post-filtering the raw set through the saved suppression tables —
+    equivalent to :func:`run_rules`, which consults the same
+    ``(rule, path, line)`` table — and the stale-suppression audit is
+    computed from the identical raw set. Returns
+    ``(live findings, stale-suppression findings)``."""
+    full_tree = any(os.path.isdir(p) for p in paths)
+    raw: list[Finding] = []
+    unfiltered: list[Finding] = []  # bad-suppression: never suppressible
+    tables: dict[str, dict[int, set[str]]] = {}
+    file_records: list[tuple[str, list[SuppressionRecord]]] = []
+    for full, rel in iter_python_files(paths):
+        with open(full, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            sf = SourceFile(full, rel, source)
+        except SyntaxError as exc:
+            unfiltered.append(
+                Finding("syntax-error", rel, exc.lineno or 0, str(exc.msg))
+            )
+            continue
+        tables[rel] = {ln: set(rs) for ln, rs in sf.suppressions.items()}
+        file_records.append((rel, sf.suppression_records))
+        sf.suppressions.clear()  # rules see the raw view
+        unfiltered.extend(sf.bad_suppressions)
+        for rule in rules:
+            raw.extend(rule.visit_file(sf))
+    if full_tree:
+        for rule in rules:
+            raw.extend(rule.finalize())
+    live = unfiltered + [
+        f for f in raw
+        if f.rule not in tables.get(f.path, {}).get(f.line, set())
+    ]
+    live.sort(key=lambda f: (f.path, f.line, f.rule))
+    # stale audit over the SAME raw set (audit.stale_suppressions
+    # semantics: a record none of whose covered lines carries a raw
+    # finding for any of its named rules is stale)
+    hits: dict[str, dict[int, set[str]]] = {}
+    for f in raw:
+        hits.setdefault(f.path, {}).setdefault(f.line, set()).add(f.rule)
+    cross_file_rules = {r.name for r in rules if r.cross_file}
+    stale: list[Finding] = []
+    for rel, records in file_records:
+        for rec in records:
+            if not full_tree and rec.rules & cross_file_rules:
+                continue
+            file_hits = hits.get(rel, {})
+            used = any(
+                rule in file_hits.get(line, ())
+                for line in rec.covered
+                for rule in rec.rules
+            )
+            if not used:
+                stale.append(
+                    Finding(
+                        "stale-suppression", rel, rec.line,
+                        _STALE_MESSAGE.format(rules=sorted(rec.rules)),
+                    )
+                )
+    stale.sort(key=lambda f: (f.path, f.line))
+    return live, stale

@@ -453,14 +453,58 @@ class MetricsRule(Rule):
     def __init__(self) -> None:
         self._registered: set[str] = set()
         self._register_sites: dict[str, set[str]] = {}  # name -> rel paths
+        # name -> first container-catalog registration (path, line): the
+        # anchor for the inverse metric-never-emitted finding
+        self._catalog_lines: dict[str, tuple[str, int]] = {}
         self._container_seen = False
         self._usages: list[tuple[str, str, int]] = []  # (name, path, line)
+        # names wired to a callback gauge: `g = m.get("name")` +
+        # `g.observe_with(...)` — emitted every scrape, no .set site
+        self._observed: set[str] = set()
 
     def visit_file(self, sf: SourceFile) -> list[Finding]:
-        if sf.rel_path.endswith("container/container.py"):
+        in_container = sf.rel_path.endswith("container/container.py")
+        if in_container:
             self._container_seen = True
         inline: list[Finding] = []
-        for node in ast.walk(sf.tree):
+        # (scope, var) -> metric name from `var = m.get("x")`, joined
+        # against observe_with receivers AFTER the walk (ast order does
+        # not guarantee the Assign is visited first). Keyed per
+        # enclosing function: two callback gauges wired through the
+        # same idiomatic local name (`g`) in different functions must
+        # not collide
+        get_bound: dict[tuple[int, str], str] = {}
+        observe_vars: set[tuple[int, str]] = set()
+
+        def scoped_nodes(root, scope):
+            for child in ast.iter_child_nodes(root):
+                child_scope = (
+                    id(child)
+                    if isinstance(
+                        child,
+                        (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                    )
+                    else scope
+                )
+                yield child, child_scope
+                yield from scoped_nodes(child, child_scope)
+
+        for node, scope in scoped_nodes(sf.tree, 0):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr == "get"
+                and node.value.args
+                and isinstance(node.value.args[0], ast.Constant)
+                and isinstance(node.value.args[0].value, str)
+            ):
+                get_bound[(scope, node.targets[0].id)] = (
+                    node.value.args[0].value
+                )
+                continue
             if not isinstance(node, ast.Call) or not isinstance(
                 node.func, ast.Attribute
             ):
@@ -473,10 +517,33 @@ class MetricsRule(Rule):
                     self._register_sites.setdefault(first.value, set()).add(
                         sf.rel_path
                     )
+                    if in_container:
+                        self._catalog_lines.setdefault(
+                            first.value, (sf.rel_path, node.lineno)
+                        )
             elif method in METRIC_USE_METHODS:
                 inline.extend(
                     self._check_usage(sf, node, METRIC_USE_METHODS[method])
                 )
+            elif method == "observe_with":
+                recv = node.func.value
+                if isinstance(recv, ast.Name):
+                    observe_vars.add((scope, recv.id))
+                elif isinstance(recv, ast.Call):
+                    # chained m.get("x").observe_with(...)
+                    f = recv.func
+                    args = recv.args
+                    if (
+                        isinstance(f, ast.Attribute) and f.attr == "get"
+                        and args
+                        and isinstance(args[0], ast.Constant)
+                        and isinstance(args[0].value, str)
+                    ):
+                        self._observed.add(args[0].value)
+        for key in observe_vars:
+            name = get_bound.get(key)
+            if name is not None:
+                self._observed.add(name)
         return [f for f in inline if not sf.is_suppressed(f.rule, f.line)]
 
     @staticmethod
@@ -546,6 +613,26 @@ class MetricsRule(Rule):
         import posixpath
 
         out: list[Finding] = []
+        # the inverse rule (full-tree runs only, mirrors
+        # metric-register-site): a name in the container catalog with
+        # zero emission sites tree-wide — no .increment/.set/.record
+        # call, no observe_with-wired callback gauge — is a dead series
+        # every deployment registers and nobody ever feeds
+        if self._container_seen:
+            used_names = {name for name, _p, _l in self._usages}
+            for name, (path, line) in sorted(self._catalog_lines.items()):
+                if name in used_names or name in self._observed:
+                    continue
+                out.append(
+                    Finding(
+                        "metric-never-emitted", path, line,
+                        f"metric '{name}' is registered in the framework "
+                        "catalog but has zero emission sites tree-wide "
+                        "(no increment/set/record call, no observe_with "
+                        "wiring) — a dead series; delete the "
+                        "registration or wire the emitter",
+                    )
+                )
         for name, path, line in self._usages:
             if name not in self._registered:
                 out.append(
@@ -877,6 +964,7 @@ class RouterRetryTypedRule(Rule):
 
 
 def default_rules() -> list[Rule]:
+    from gofr_tpu.analysis.leakcheck import leakcheck_rules
     from gofr_tpu.analysis.lockcheck import lockcheck_rules
     from gofr_tpu.analysis.shardcheck import shardcheck_rules
 
@@ -886,4 +974,5 @@ def default_rules() -> list[Rule]:
         RouterRetryTypedRule(),
         *shardcheck_rules(),
         *lockcheck_rules(),
+        *leakcheck_rules(),
     ]

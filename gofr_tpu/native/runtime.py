@@ -141,7 +141,7 @@ class BlockAllocator:
             if self._lib is not None:
                 _check(self._lib.gofr_ba_destroy(self._h), "ba_destroy")
 
-    def leak(self) -> None:
+    def leak(self) -> None:  # leakcheck: transfer(quarantine)
         """Quarantine-leak: mark the allocator closed WITHOUT destroying
         the native handle. Used by the engine's warm restart when its loop
         thread failed to join — a hung thread may still be inside a native
@@ -278,7 +278,7 @@ class Scheduler:
             if self._lib is not None:
                 _check(self._lib.gofr_sched_destroy(self._h), "sched_destroy")
 
-    def leak(self) -> None:
+    def leak(self) -> None:  # leakcheck: transfer(quarantine)
         """Quarantine-leak the scheduler handle (see BlockAllocator.leak):
         closed-without-destroy for the warm-restart path where the engine
         thread may still be inside a scheduler call."""

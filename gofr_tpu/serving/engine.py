@@ -613,15 +613,17 @@ class ServingEngine:
                         "engine wedged; retry on another replica",
                         retry_after=1.0,
                     ))
+                # the host-side executors are still OURS under a wedged
+                # engine thread (leakcheck's sweep found this path) —
+                # only the NATIVE resources stay quarantined: those the
+                # hung thread may be inside.
+                self._shutdown_host_executors()
                 return
             self._thread = None
             self._wedged = False  # a later stop() that joins clean recovers
-        # the engine is terminal: stop accepting emissions. wait=False on
-        # purpose — already-queued detok/settle tasks still run to
-        # completion (ThreadPoolExecutor drains its queue), so no retired
-        # request's future is stranded, and stop() never blocks behind a
-        # client stream_cb
-        self._detok.shutdown(wait=False)
+        # the engine is terminal: stop accepting emissions BEFORE the
+        # sweep, so no settle task enqueues behind the shutdown
+        self._shutdown_host_executors()
         # the loop thread has exited: anything still registered can never
         # reach a terminal state through it (e.g. a submit that raced the
         # drain flag and enqueued after the loop's last scan) — fail it
@@ -634,20 +636,29 @@ class ServingEngine:
                 "engine stopped before the request was served; retry",
                 retry_after=1.0,
             ))
-        # the spill tier's worker executor (serving/kv_spill.py) is
-        # engine-lifetime: stop accepting device→host copies now —
-        # already-queued spills still settle. isinstance, NOT duck-typed:
-        # an injected container cache may expose close() with datasource
-        # semantics the engine must never invoke on a shared resource
-        from gofr_tpu.serving.kv_spill import TieredPrefixCache
-
-        if isinstance(self._prefix_cache, TieredPrefixCache):
-            self._prefix_cache.close()
         try:
             self._sched.close()  # fallible: destroy status is checked
         finally:
             if self.paged_cache is not None:
                 self.paged_cache.close()
+
+    def _shutdown_host_executors(self) -> None:
+        """Stop the engine's HOST-side workers accepting new work — the
+        one shutdown sequence shared by the clean stop and the wedged
+        stop (under a hung engine thread these are still ours; only the
+        native scheduler/pools get quarantined). ``wait=False`` on
+        purpose: already-queued detok/settle tasks and spills still run
+        to completion (ThreadPoolExecutor drains its queue), so no
+        retired request's future is stranded and stop() never blocks
+        behind a client stream_cb. The spill tier is matched by
+        isinstance, NOT duck-typed: an injected container cache may
+        expose close() with datasource semantics the engine must never
+        invoke on a shared resource."""
+        self._detok.shutdown(wait=False)
+        from gofr_tpu.serving.kv_spill import TieredPrefixCache
+
+        if isinstance(self._prefix_cache, TieredPrefixCache):
+            self._prefix_cache.close()
 
     def drain(self, deadline_s: float | None = None, *,
               join_timeout: float = 10.0) -> bool:

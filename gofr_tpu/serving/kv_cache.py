@@ -377,7 +377,7 @@ class PagedKVCache:
     def close(self) -> None:
         self.allocator.close()
 
-    def leak(self) -> None:
+    def leak(self) -> None:  # leakcheck: transfer(quarantine)
         """Quarantine-leak the native allocator (engine warm restart under
         a hung thread): the page pools are plain device arrays the GC can
         reclaim once the thread thaws, but the native handle must never be

@@ -278,7 +278,9 @@ def test_metric_register_site_enforced_with_container(tmp_path):
     the series."""
     findings = lint_tree(tmp_path, {
         "gofr_tpu/container/container.py": (
-            'def reg(m):\n    m.new_gauge("app_info", "d")\n'
+            "def reg(m):\n"
+            '    m.new_gauge("app_info", "d")\n'
+            '    m.set_gauge("app_info", 1)\n'
         ),
         "gofr_tpu/datasource/redis/client.py": (
             'def reg(m):\n    m.new_histogram("app_far_away", "d")\n'
@@ -305,6 +307,67 @@ def test_metric_register_site_clean_for_container_and_same_dir(tmp_path):
             "def use(m):\n"
             '    m.record_histogram("app_catalogued", 1.0)\n'
             '    m.record_histogram("app_grpc_local", 1.0)\n'
+        ),
+    })
+    assert findings == []
+
+
+def test_metric_never_emitted_flags_dead_catalog_series(tmp_path):
+    """The inverse rule (full-tree runs only, mirrors
+    metric-register-site): a name registered in container/container.py
+    with zero .increment/.set/.record sites tree-wide — and no
+    observe_with-wired callback gauge — is a dead series."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/container/container.py": (
+            "def reg(m):\n"
+            '    m.new_gauge("app_dead_series", "d")\n'
+            '    m.new_gauge("app_live_series", "d")\n'
+        ),
+        "gofr_tpu/serving/engine.py": (
+            'def use(m):\n    m.set_gauge("app_live_series", 1.0)\n'
+        ),
+    })
+    assert [f.rule for f in findings] == ["metric-never-emitted"]
+    assert "app_dead_series" in findings[0].message
+    assert findings[0].path.endswith("container/container.py")
+    assert findings[0].line == 2
+
+
+def test_metric_never_emitted_observe_with_wiring_counts(tmp_path):
+    """Negative: a callback gauge (`g = m.get(name)` +
+    `g.observe_with(...)`, or the chained form) emits on every scrape —
+    not a dead series. Names registered OUTSIDE the container catalog
+    are out of the rule's scope either way."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/container/container.py": (
+            "def reg(m):\n"
+            '    m.new_gauge("app_threads", "d")\n'
+            '    m.new_gauge("app_rss", "d")\n'
+            '    g = m.get("app_threads")\n'
+            "    g.observe_with(lambda: {})\n"
+            '    m.get("app_rss").observe_with(lambda: {})\n'
+        ),
+        "gofr_tpu/grpcx/server.py": (
+            'def reg(m):\n    m.new_histogram("app_subsystem_local", "d")\n'
+        ),
+    })
+    assert findings == []
+
+
+def test_metric_never_emitted_same_var_name_in_two_functions(tmp_path):
+    """Negative: two callback gauges wired through the same idiomatic
+    local name (`g`) in different functions must both count as emitted —
+    the binding join is per enclosing function, not file-wide."""
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/container/container.py": (
+            "def reg_a(m):\n"
+            '    m.new_gauge("app_aaa", "d")\n'
+            '    g = m.get("app_aaa")\n'
+            "    g.observe_with(lambda: {})\n"
+            "def reg_b(m):\n"
+            '    m.new_gauge("app_bbb", "d")\n'
+            '    g = m.get("app_bbb")\n'
+            "    g.observe_with(lambda: {})\n"
         ),
     })
     assert findings == []

@@ -18,6 +18,7 @@ rotate them — a seed that once caught a bug is a regression test.
 """
 
 import concurrent.futures as cf
+import os
 import threading
 import time
 
@@ -274,66 +275,90 @@ def _assert_reclaimed(eng: ServingEngine) -> None:
 @pytest.mark.parametrize("seed", CHAOS_SEEDS)
 @pytest.mark.parametrize("kv_layout", ["dense", "paged"])
 def test_lifecycle_invariant_under_faults(seed, kv_layout, monkeypatch):
+    from gofr_tpu.analysis import leaktrace
     from gofr_tpu.tracing import Tracer
 
     tracer = Tracer("chaos")  # no processor: pure open/close accounting
-    kw = dict(kv_layout=kv_layout)
-    if kv_layout == "paged":
-        kw.update(kv_page_size=8)
-    eng = make_engine(tracer=tracer, **kw)
-
-    # pin "expired requests are never prefilled": track born-dead requests
-    born_dead: set[int] = set()
-    real_submit = eng.submit
-
-    def tracking_submit(prompt, **skw):
-        fut = real_submit(prompt, **skw)
-        if skw.get("deadline") == 1e-9:
-            born_dead.add(fut.request_id)
-        return fut
-
-    monkeypatch.setattr(eng, "submit", tracking_submit)
-    real_prefill = eng._prefill_into
-    prefilled: set[int] = set()
-    monkeypatch.setattr(
-        eng, "_prefill_into",
-        lambda slot, req: (prefilled.add(req.id), real_prefill(slot, req))[1],
-    )
-
-    rates = {
-        "sched.submit": 0.08,
-        "sched.admit": 0.04,
-        "decode.dispatch": 0.04,
-    }
-    if kv_layout == "paged":
-        rates["kv.alloc"] = 0.10
-    inj = chaos.ChaosInjector(seed, rates, max_faults=3)
-
-    eng.start()
+    # the reclaim audit, observed directly at the acquire/release sites:
+    # leaktrace instruments allocator/scheduler/paged-slot/timeline
+    # lifecycles for this storm; after the drain the live ledger must be
+    # empty, and the observed pairs export for the static cross-check
+    # (GOFR_LEAK_EXPORT, docs/static-analysis.md#leakcheck)
+    leak_mon = leaktrace.install()
     try:
-        with chaos.active(inj):
-            outcomes = _run_workload(eng)
-            counts = _assert_terminal(outcomes)
-        assert counts, counts
-        assert not (born_dead & prefilled), "expired requests were prefilled"
-        # still servable after the storm
-        probe = eng.submit("probe", max_new_tokens=2).result(timeout=60)
-        assert probe.finish_reason in ("stop", "length")
-        _assert_reclaimed(eng)
-        # drain completes within its deadline, thread exits cleanly
-        assert eng.drain(deadline_s=60) is True
-        assert eng._thread is None or not eng._thread.is_alive()
-        assert eng.health_check()["status"] == "DOWN"  # no wedge
-        # observability invariants ride the same storm: every request
-        # left exactly one terminal timeline phase, and no lifecycle
-        # span leaked across a single fault path
-        _assert_timelines_terminal(eng)
-        assert tracer.open_spans() == 0, (
-            f"{tracer.open_spans()} span(s) leaked across the chaos run"
+        kw = dict(kv_layout=kv_layout)
+        if kv_layout == "paged":
+            kw.update(kv_page_size=8)
+        eng = make_engine(tracer=tracer, **kw)
+
+        # pin "expired requests are never prefilled": track born-dead
+        # requests
+        born_dead: set[int] = set()
+        real_submit = eng.submit
+
+        def tracking_submit(prompt, **skw):
+            fut = real_submit(prompt, **skw)
+            if skw.get("deadline") == 1e-9:
+                born_dead.add(fut.request_id)
+            return fut
+
+        monkeypatch.setattr(eng, "submit", tracking_submit)
+        real_prefill = eng._prefill_into
+        prefilled: set[int] = set()
+        monkeypatch.setattr(
+            eng, "_prefill_into",
+            lambda slot, req: (
+                prefilled.add(req.id), real_prefill(slot, req)
+            )[1],
         )
+
+        rates = {
+            "sched.submit": 0.08,
+            "sched.admit": 0.04,
+            "decode.dispatch": 0.04,
+        }
+        if kv_layout == "paged":
+            rates["kv.alloc"] = 0.10
+        inj = chaos.ChaosInjector(seed, rates, max_faults=3)
+
+        eng.start()
+        try:
+            with chaos.active(inj):
+                outcomes = _run_workload(eng)
+                counts = _assert_terminal(outcomes)
+            assert counts, counts
+            assert not (born_dead & prefilled), \
+                "expired requests were prefilled"
+            # still servable after the storm
+            probe = eng.submit("probe", max_new_tokens=2).result(timeout=60)
+            assert probe.finish_reason in ("stop", "length")
+            _assert_reclaimed(eng)
+            # drain completes within its deadline, thread exits cleanly
+            assert eng.drain(deadline_s=60) is True
+            assert eng._thread is None or not eng._thread.is_alive()
+            assert eng.health_check()["status"] == "DOWN"  # no wedge
+            # observability invariants ride the same storm: every request
+            # left exactly one terminal timeline phase, and no lifecycle
+            # span leaked across a single fault path
+            _assert_timelines_terminal(eng)
+            assert tracer.open_spans() == 0, (
+                f"{tracer.open_spans()} span(s) leaked across the chaos run"
+            )
+        finally:
+            if eng._running:
+                eng.stop()
     finally:
-        if eng._running:
-            eng.stop()
+        # the uninstall covers SETUP failures too (make_engine, injector
+        # construction, start) — a failed cell must not leave the global
+        # instrumentation installed, or every later parametrized cell
+        # dies on the install() guard instead of its real assertion
+        leaktrace.uninstall()
+    # the dynamic reclaim invariant at the resource sites themselves:
+    # every acquired allocator/scheduler/slot/timeline was released
+    leak_mon.check()
+    export_path = os.environ.get("GOFR_LEAK_EXPORT")
+    if export_path:
+        leaktrace.export_to(leak_mon, export_path)
 
 
 def _assert_chunk_spans_never_double_prefill(eng: ServingEngine) -> None:

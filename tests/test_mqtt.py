@@ -193,6 +193,23 @@ class TestSubscriberIntegration:
 
         t = threading.Thread(target=loop_main, daemon=True)
         t.start()
+        # the manager's SUBSCRIBE reaches the broker asynchronously:
+        # publishing before the filter is registered silently drops the
+        # first event (QoS-0 pub/sub semantics, not a delivery bug) —
+        # wait until the broker shows the subscription before publishing
+        import time as _time
+
+        deadline = _time.monotonic() + 10
+        subscribed = False
+        while _time.monotonic() < deadline and not subscribed:
+            with broker._mu:
+                sess = broker._sessions.get("app-sub")
+                subscribed = (
+                    sess is not None and "events" in sess.subscriptions
+                )
+            if not subscribed:
+                _time.sleep(0.02)
+        assert subscribed, "subscriber never registered with the broker"
         pub = make_client(broker, client_id="app-pub")
         try:
             for i in range(3):
