@@ -39,12 +39,16 @@ ci: lint bench-check
 # Deterministic: a red run reproduces with the same seed every time (seeds
 # live in tests/test_chaos.py::CHAOS_SEEDS,
 # tests/test_supervisor.py::CHAOS_SEEDS,
-# tests/test_pubsub_chaos.py::CHAOS_SEEDS and
-# tests/test_router_chaos.py::CHAOS_SEEDS), plus the router-plane replica
+# tests/test_pubsub_chaos.py::CHAOS_SEEDS,
+# tests/test_router_chaos.py::CHAOS_SEEDS and
+# tests/test_disagg.py::CHAOS_SEEDS), plus the router-plane replica
 # tier (kill / wedge / heartbeat-partition over ≥2 in-process replicas,
-# asserting exactly-one-terminal-state-on-exactly-one-replica).
+# asserting exactly-one-terminal-state-on-exactly-one-replica) and the
+# disaggregation plane (handoff-interrupted seeds: source death,
+# destination death, kv.handoff transport faults; autoscaler scale-down
+# drains under scale.decision faults).
 chaos:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py tests/test_supervisor.py tests/test_pubsub_chaos.py tests/test_router_chaos.py -q -m chaos
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py tests/test_supervisor.py tests/test_pubsub_chaos.py tests/test_router_chaos.py tests/test_disagg.py -q -m chaos
 
 # gofrlint (docs/static-analysis.md): the unified front door — the
 # framework-invariant AST lints, the shardcheck SPMD family, the

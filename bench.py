@@ -681,6 +681,119 @@ def _router_warm_prefix(cfg: Any, params: Any, on_tpu: bool) -> dict:
             eng.stop()
 
 
+def _remote_stream(cfg: Any, params: Any, on_tpu: bool) -> dict:
+    """Remote token-streaming TTFT (ROADMAP item 2, vLLM-vs-TGI
+    methodology arXiv:2511.17593): one engine behind the real HTTP
+    server, driven through ``HTTPReplica``'s streaming transport
+    (``POST /generate/stream``, serving/remote.py). The headline —
+    client-observed remote TTFT p50 — is CPU-verifiable and gated by the
+    direction:"min" floor ``remote_stream_ttft_ms_p50_*``: before this
+    transport existed, a remote replica's 'TTFT' WAS its completion
+    latency (unary /generate), so the floor pins the decoupling itself.
+    The phase also reports the same engine's unary e2e p50 as the
+    coupled baseline."""
+    import threading as _threading
+    import urllib.request
+
+    import gofr_tpu
+    from gofr_tpu.config import MapConfig
+    from gofr_tpu.serving import ByteTokenizer, EngineConfig, ServingEngine
+    from gofr_tpu.serving.handlers import register_generation_routes
+    from gofr_tpu.serving.router import HTTPReplica
+    from gofr_tpu.testutil import new_server_configs
+
+    engine = ServingEngine(
+        cfg, params,
+        EngineConfig(
+            max_slots=8,
+            max_seq_len=512 if on_tpu else 256,
+            prefill_buckets=(64,) if on_tpu else (16,),
+            prefill_chunk_tokens=64 if on_tpu else 16,
+            max_queue=64,
+        ),
+        ByteTokenizer(cfg.vocab_size),
+        metrics=_engine_metrics(),
+    )
+    ports = new_server_configs(set_env=False)
+    config = MapConfig(
+        {"HTTP_PORT": str(ports.http_port), "GRPC_PORT": str(ports.grpc_port),
+         "METRICS_PORT": str(ports.metrics_port),
+         "APP_NAME": "bench-remote-stream", "LOG_LEVEL": "ERROR"},
+        use_env=False,
+    )
+    app = gofr_tpu.App(config)
+    register_generation_routes(app, engine)
+    server = _threading.Thread(target=app.run, daemon=True)
+    server.start()
+    base = f"http://127.0.0.1:{ports.http_port}"
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            urllib.request.urlopen(base + "/.well-known/alive", timeout=1)
+            break
+        except OSError:
+            time.sleep(0.05)
+    replica = HTTPReplica("bench", base)
+    max_new = 64 if on_tpu else 48
+    try:
+        # warm the admission + decode executables off the clock
+        replica.submit("warm the caches", max_new_tokens=max_new,
+                       temperature=0.0).result(timeout=1200)
+        stream_ttfts: list[float] = []
+        stream_e2es: list[float] = []
+        for i in range(8):
+            first: list[float] = []
+            t0 = time.perf_counter()
+            fut = replica.submit(
+                f"stream probe {i}", max_new_tokens=max_new, temperature=0.0,
+                stream_cb=lambda t, p, d: (
+                    first.append(time.perf_counter() - t0)
+                    if not d and not first else None
+                ),
+            )
+            fut.result(timeout=1200)
+            stream_e2es.append(time.perf_counter() - t0)
+            if first:
+                stream_ttfts.append(first[0])
+        unary_e2es: list[float] = []
+        for i in range(4):
+            t0 = time.perf_counter()
+            replica.submit(
+                f"stream probe {i}", max_new_tokens=max_new, temperature=0.0,
+            ).result(timeout=1200)
+            unary_e2es.append(time.perf_counter() - t0)
+        if not stream_ttfts:
+            # a 0.0/empty result would trivially pass — and ratchet —
+            # the direction:"min" floor; the regression the gate exists
+            # for must surface as a phase error
+            raise RuntimeError(
+                "remote-stream phase observed no token frames "
+                "(streaming transport broken?)"
+            )
+        ttft = _percentiles(stream_ttfts)
+        e2e = _percentiles(stream_e2es)
+        unary = _percentiles(unary_e2es)
+        return {
+            "stream_ttft_ms_p50": ttft.get("p50_ms", 0.0),
+            "stream_ttft_ms_p99": ttft.get("p99_ms", 0.0),
+            "stream_e2e_ms_p50": e2e.get("p50_ms", 0.0),
+            "unary_e2e_ms_p50": unary.get("p50_ms", 0.0),
+            # the decoupling evidence: completion time over first-token
+            # time through the SAME remote transport
+            "e2e_over_ttft": round(
+                e2e.get("p50_ms", 0.0) / max(ttft.get("p50_ms", 1e-6), 1e-6),
+                2,
+            ),
+            "samples": len(stream_ttfts),
+            "max_new_tokens": max_new,
+        }
+    finally:
+        replica.close()
+        app.stop()
+        engine.stop()
+        server.join(timeout=15)
+
+
 def _http_generate_load(engine: Any, on_tpu: bool) -> dict:
     """The same engine behind the real HTTP server: closed-loop POST
     /generate, end-to-end latency measured at the client."""
@@ -1277,6 +1390,21 @@ def _run_benchmarks(platform: str, init_error: str | None, wall_start: float) ->
     print(json.dumps(warm_line), flush=True)
     if "error" not in warm_line:
         _append_local_record(warm_line)
+
+    # --- remote token-streaming TTFT (disaggregation plane, CPU-verifiable)
+    def run_remote_stream() -> dict:
+        if params is None:
+            raise RuntimeError("skipped: headline phase failed to build params")
+        return _remote_stream(cfg, params, on_tpu)
+
+    stream_line = _phase_line(
+        f"remote_stream_ttft_ms_p50_{model_kind}_{platform}", "ms",
+        run_remote_stream, value_key="stream_ttft_ms_p50",
+        on_tpu=on_tpu and not init_error, init_error=init_error,
+    )
+    print(json.dumps(stream_line), flush=True)
+    if "error" not in stream_line:
+        _append_local_record(stream_line)
 
     # --- framework-only phases (no TPU dependence at all) ------------------
     echo_line = _phase_line(

@@ -86,8 +86,16 @@ DISPATCH_ZONES: dict[str, set[str] | str] = {
         "_to_device", "advertised",
     },
     "gofr_tpu/serving/prefix_index.py": {
-        "fetch_chain", "fetch_one", "locate", "longest_chain", "observe",
+        "fetch_chain", "fetch_one", "fetch_handoff", "fetch_one_handoff",
+        "locate", "longest_chain", "observe",
     },
+    # disaggregation plane: the autoscaler's control loop must stay on
+    # interruptible Event.wait pacing, and the remote-stream transport's
+    # event parsing must never grow a named blocking call — the frame
+    # READS block by design (pool worker threads), but through the
+    # already-open streaming response, never a fresh urlopen/sleep
+    "gofr_tpu/serving/autoscaler.py": "*",
+    "gofr_tpu/serving/remote.py": "*",
 }
 
 # retry/backoff paths reachable from handlers: uninterruptible sleeps only
@@ -103,6 +111,13 @@ BACKOFF_ZONES: dict[str, set[str] | str] = {
 ROUTER_RETRY_ZONES: dict[str, set[str] | str] = {
     "gofr_tpu/serving/router.py": {
         "submit", "_submit_attempt", "_failover", "_hedge",
+        # the disaggregated two-phase path walks candidates exactly like
+        # submit does — its except clauses are pinned to the same set
+        "_submit_disagg", "_prefill_attempt", "_decode_phase",
+        # the remote transport workers settle the replica future: their
+        # deliberately-broad settle-on-anything catches carry reasoned
+        # suppressions (a narrow catch would strand the future)
+        "_run_unary", "_run_stream",
     },
 }
 ROUTER_RETRIABLE_NAMES = {

@@ -40,6 +40,7 @@ POINTS = (
     "kv.alloc",         # paged-KV pool allocation / extension
     "kv.spill",         # host-RAM spill worker (device→host copy drops)
     "kv.migrate",       # cross-replica KV page fetch (source dies mid-transfer)
+    "kv.handoff",       # prefill→decode KV handoff fetch (source/transport dies)
     "service.request",  # outbound HTTP service client
     "pubsub.publish",   # pubsub publish
     "pubsub.subscribe",  # consumer-loop poll (broker fetch)
@@ -47,6 +48,8 @@ POINTS = (
     "pubsub.handler",   # subscriber handler invocation
     "router.route",     # router submission to a replica (transport seam)
     "router.heartbeat",  # replica heartbeat publish (partition: beat drops)
+    "stream.remote",    # remote token-stream transport (tears mid-stream)
+    "scale.decision",   # autoscaler control-loop decision (skipped round)
 )
 
 

@@ -169,6 +169,23 @@ class Container:
             "app_kv_migrations_total",
             "Warm KV prefix migrations fetched from another replica",
         )
+        # disaggregated prefill/decode serving (docs/robustness.md "The
+        # disaggregation plane"): prefill→decode KV handoffs that passed
+        # the two-phase-commit contiguity audit, and the autoscaler's
+        # pool-sizing actions
+        m.new_counter(
+            "app_kv_handoffs_total",
+            "Prefill→decode KV handoff chains admitted complete "
+            "(contiguity-audited; a torn handoff re-prefills instead)",
+        )
+        m.new_gauge(
+            "app_autoscaler_replicas",
+            "Autoscaler's current replica count per pool (label role)",
+        )
+        m.new_counter(
+            "app_autoscaler_scale_events_total",
+            "Autoscaler scale actions taken (label direction=up|down)",
+        )
         m.new_histogram("app_ttft_seconds", "Time to first token")
         m.new_histogram(
             "app_tpot_seconds", "Time per output token",

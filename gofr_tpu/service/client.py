@@ -39,6 +39,38 @@ class ServiceResponse:
         return 200 <= self.status_code < 300
 
 
+class StreamingServiceResponse:
+    """A response whose body is consumed incrementally (SSE / chunked
+    transfer): status + headers up front, the body as a line iterator.
+    The caller owns the lifetime — iterate :meth:`lines` to the end or
+    :meth:`close` early (closing the socket is how a client aborts a
+    server-sent stream)."""
+
+    def __init__(self, status: int, headers: dict[str, str], raw: Any) -> None:
+        self.status_code = status
+        self.headers = headers
+        self._raw = raw
+
+    @property
+    def ok(self) -> bool:
+        return 200 <= self.status_code < 300
+
+    def lines(self) -> Any:
+        """Iterate decoded lines (newline-stripped) as they arrive."""
+        for line in self._raw:
+            yield line.decode("utf-8", "replace").rstrip("\r\n")
+
+    def read_body(self) -> bytes:
+        """Drain the remaining body (error responses carry JSON)."""
+        return self._raw.read()
+
+    def close(self) -> None:
+        try:
+            self._raw.close()
+        except Exception:
+            pass  # already torn down by the server side
+
+
 class ServiceLog:
     def __init__(self, method: str, url: str, status: int, duration_us: int) -> None:
         self.method, self.url, self.response_code, self.duration = method, url, status, duration_us
@@ -111,6 +143,51 @@ class HTTPService:
         finally:
             if span is not None:
                 span.end()
+
+    def stream(
+        self,
+        method: str,
+        path: str,
+        *,
+        json: Any = None,
+        headers: dict[str, str] | None = None,
+        timeout: float | None = None,
+    ) -> StreamingServiceResponse:
+        """Open a request whose response body streams (SSE / chunked):
+        returns a :class:`StreamingServiceResponse` once the response
+        HEAD arrives — the body is read incrementally by the caller, so
+        a token can be observed the moment the server emits it instead
+        of at completion. Error statuses return normally (status +
+        drainable body); transport failures raise. The caller must
+        close() or fully consume the stream."""
+        url = f"{self.address}/{path.lstrip('/')}" if path else self.address
+        hdrs = dict(headers or {})
+        body = None
+        if json is not None:
+            body = json_mod.dumps(json).encode("utf-8")
+            hdrs.setdefault("Content-Type", "application/json")
+        parent = current_span()
+        if parent is not None:
+            hdrs.setdefault("traceparent", format_traceparent(parent))
+        start = time.perf_counter()
+        try:
+            chaos.maybe_fail("service.request")
+            req = urllib.request.Request(
+                url, data=body, method=method.upper(), headers=hdrs
+            )
+            try:
+                resp = urllib.request.urlopen(
+                    req, timeout=timeout or self.timeout
+                )
+            except urllib.error.HTTPError as exc:
+                resp = exc  # HTTPError IS a readable response object
+            self._observe(method, url, resp.status, start)
+            return StreamingServiceResponse(
+                resp.status, dict(resp.headers), resp
+            )
+        except Exception:
+            self._observe(method, url, 0, start)
+            raise
 
     def _observe(self, method: str, url: str, status: int, start: float) -> None:
         duration_us = int((time.perf_counter() - start) * 1e6)

@@ -133,6 +133,28 @@ class CircuitBreaker(_Wrapper):
                 self._failures = 0
         return resp
 
+    def stream(self, method: str, path: str, **kw: Any) -> Any:
+        """Breaker-aware streaming open (the remote token-stream
+        transport, serving/remote.py). The breaker observes the CONNECT:
+        an open breaker refuses up front, a failed open or 5xx head
+        counts a failure, a streaming head that arrived resets the
+        count. Mid-stream tears are the router's failover problem — by
+        then tokens may have crossed, which is not an admission failure."""
+        with self._lock:
+            if self._open:
+                raise CircuitBreakerError(getattr(self._inner, "address", "?"))
+        try:
+            resp = self._inner.stream(method, path, **kw)
+        except Exception:
+            self._record_failure()
+            raise
+        if resp.status_code >= 500:
+            self._record_failure()
+        else:
+            with self._lock:
+                self._failures = 0
+        return resp
+
     def _record_failure(self) -> None:
         with self._lock:
             self._failures += 1

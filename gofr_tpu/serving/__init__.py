@@ -23,6 +23,11 @@ the unit of concurrency is the *slot*, not the thread. Components:
   host-RAM spill pool under the device prefix cache, heartbeat-gossiped
   distributed prefix index, warm KV page migration between replicas
   (docs/performance.md "KV reuse tiers").
+- remote.py / autoscaler.py: the disaggregation plane — the remote
+  token-stream transport (SSE over /generate/stream + the cancel wire)
+  and the headroom-driven per-role replica autoscaler with its
+  simulated pool driver (docs/robustness.md "The disaggregation
+  plane").
 - timeline.py / device_telemetry.py: the observability layer — per-request
   lifecycle timelines behind /requestz, and the TPU HBM / duty-cycle
   poller feeding health, metrics and membership heartbeats
@@ -47,6 +52,11 @@ from gofr_tpu.serving.prefix_index import (
     KVMigrator,
     PrefixIndex,
     local_engine_fetcher,
+)
+from gofr_tpu.serving.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    SimulatedPoolDriver,
 )
 from gofr_tpu.serving.supervisor import EngineSupervisor
 from gofr_tpu.serving.timeline import RequestTimeline, TimelineRecorder
@@ -74,4 +84,7 @@ __all__ = [
     "PrefixIndex",
     "KVMigrator",
     "local_engine_fetcher",
+    "Autoscaler",
+    "AutoscalerConfig",
+    "SimulatedPoolDriver",
 ]

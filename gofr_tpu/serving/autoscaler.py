@@ -1,0 +1,446 @@
+"""The headroom-driven replica autoscaler (ROADMAP item 2, AIBrix
+arXiv:2504.03648).
+
+The router tier (PR 7) watches every replica's queue-wait EWMA and HBM
+headroom ride the membership heartbeats — but until this module nothing
+ACTED on the signal: an operator read ``/routerz`` and resized the pool
+by hand. The :class:`Autoscaler` closes the loop, per role (a role-split
+tier sizes its prefill and decode pools independently — a prefill
+backlog must grow the prefill pool, not add decode replicas that would
+sit idle):
+
+- **signal**: the role pool's mean queue-wait EWMA
+  (``MembershipTable.aggregate_queue_wait`` — the same series
+  ``app_router_queue_wait_seconds`` exports) and its tightest reported
+  HBM headroom (``min_hbm_headroom``, fed by the PR 9 device-telemetry
+  poller);
+- **hysteresis**: pressure must PERSIST for ``up_stable_s`` before a
+  scale-up, idleness for ``down_stable_s`` before a scale-down, and
+  every action starts a per-role ``cooldown_s`` — a bursty signal must
+  not flap the pool (adding a replica costs a cold jit cache; removing
+  one costs its warm KV);
+- **the scale-down invariant** (chaos-tested,
+  tests/test_router_chaos.py): a victim is DRAINED, never killed — the
+  driver's ``begin_drain`` runs the replica's graceful-drain contract
+  (in-flight streams and handoffs finish, the DRAINING heartbeat stops
+  new routes) and the replica is reaped only once it reports idle.
+  Zero lost requests, whatever the scaler does.
+
+The **driver** is the deployment-shaped seam: :class:`ReplicaPoolDriver`
+is the k8s-shaped interface (scale a Deployment per role, cordon+drain a
+pod, reap it when idle); :class:`SimulatedPoolDriver` implements it over
+an in-process replica factory so the control loop's behavior is testable
+— and chaos-testable — without a cluster.
+
+The ``scale.decision`` chaos point sits on each per-role decision: a
+fault there skips the round's action (counted, never raised into the
+loop) — the control plane misfiring must degrade to "pool stays its
+current size", never to a kill.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable
+
+from gofr_tpu import chaos
+from gofr_tpu.serving import membership as ms
+
+__all__ = ["AutoscalerConfig", "Autoscaler", "SimulatedPoolDriver"]
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    """Every knob env-tunable, like RouterConfig (docs/robustness.md has
+    the table)."""
+
+    interval_s: float = 1.0
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # queue-wait EWMA above this → the pool is under pressure
+    scale_up_wait_s: float = 1.0
+    # queue-wait EWMA below this (with no HBM pressure) → the pool idles
+    scale_down_wait_s: float = 0.1
+    # tightest reported HBM headroom below this fraction → pressure
+    # (replicas that publish no device sample never trigger it)
+    hbm_floor_frac: float = 0.05
+    # hysteresis: how long the signal must persist before acting, and
+    # the per-role quiet period after every action
+    up_stable_s: float = 2.0
+    down_stable_s: float = 10.0
+    cooldown_s: float = 5.0
+
+    @classmethod
+    def from_config(cls, config: Any) -> "AutoscalerConfig":
+        return cls(
+            interval_s=float(
+                config.get_or_default("TPU_AUTOSCALE_INTERVAL_S", "1.0")
+            ),
+            min_replicas=int(
+                config.get_or_default("TPU_AUTOSCALE_MIN_REPLICAS", "1")
+            ),
+            max_replicas=int(
+                config.get_or_default("TPU_AUTOSCALE_MAX_REPLICAS", "8")
+            ),
+            scale_up_wait_s=float(
+                config.get_or_default("TPU_AUTOSCALE_UP_WAIT_S", "1.0")
+            ),
+            scale_down_wait_s=float(
+                config.get_or_default("TPU_AUTOSCALE_DOWN_WAIT_S", "0.1")
+            ),
+            hbm_floor_frac=float(
+                config.get_or_default("TPU_AUTOSCALE_HBM_FLOOR", "0.05")
+            ),
+            up_stable_s=float(
+                config.get_or_default("TPU_AUTOSCALE_UP_STABLE_S", "2.0")
+            ),
+            down_stable_s=float(
+                config.get_or_default("TPU_AUTOSCALE_DOWN_STABLE_S", "10.0")
+            ),
+            cooldown_s=float(
+                config.get_or_default("TPU_AUTOSCALE_COOLDOWN_S", "5.0")
+            ),
+        )
+
+
+class ReplicaPoolDriver:
+    """The deployment seam the autoscaler drives — k8s-shaped on
+    purpose: ``scale_up`` maps to growing a per-role Deployment,
+    ``begin_drain`` to cordoning a pod and invoking its graceful-drain
+    hook, ``reap`` to deleting it once idle. Implementations must make
+    ``begin_drain`` NON-DESTRUCTIVE: in-flight streams and handoffs on
+    the victim run to completion (the scale-down invariant)."""
+
+    def replica_ids(self, role: str) -> list[str]:
+        """Live (non-draining) replica ids of this role."""
+        raise NotImplementedError
+
+    def scale_up(self, role: str, n: int) -> list[str]:
+        """Add ``n`` replicas to the role's pool; returns their ids."""
+        raise NotImplementedError
+
+    def begin_drain(self, replica_id: str) -> None:
+        """Start the victim's graceful drain (never blocks the caller,
+        never kills in-flight work)."""
+        raise NotImplementedError
+
+    def reap(self, replica_id: str) -> bool:
+        """Remove a draining replica IF it is idle; False = still busy,
+        try again next tick."""
+        raise NotImplementedError
+
+
+class SimulatedPoolDriver(ReplicaPoolDriver):
+    """An in-process pool: ``factory(role, replica_id) -> handle`` builds
+    a replica (a LocalReplica-compatible handle over a real engine or a
+    stub), the driver registers it with the router and tracks its
+    lifecycle. Drains run the handle's (or its engine's) ``drain`` on a
+    daemon thread — an engine's drain blocks until its streams finish,
+    which is exactly the semantics the invariant wants — and ``reap``
+    removes the replica only once its health reports nothing in flight.
+    """
+
+    def __init__(self, router: Any,
+                 factory: Callable[[str, str], Any],
+                 *, on_reap: Callable[[Any], None] | None = None) -> None:
+        self.router = router
+        self.factory = factory
+        self._on_reap = on_reap
+        self._mu = threading.Lock()
+        self._handles: dict[str, Any] = {}
+        self._roles: dict[str, str] = {}
+        self._draining: set[str] = set()
+        self._drained: set[str] = set()  # drain call returned
+        self._next = 0
+
+    # -- driver surface --------------------------------------------------------
+    def replica_ids(self, role: str) -> list[str]:
+        with self._mu:
+            return [
+                rid for rid, r in self._roles.items()
+                if r == role and rid not in self._draining
+            ]
+
+    def scale_up(self, role: str, n: int) -> list[str]:
+        out = []
+        for _ in range(n):
+            with self._mu:
+                self._next += 1
+                rid = f"{role}-{self._next}"
+            handle = self.factory(role, rid)
+            with self._mu:
+                self._handles[rid] = handle
+                self._roles[rid] = role
+            self.router.add_replica(handle, role=role)
+            out.append(rid)
+        return out
+
+    def begin_drain(self, replica_id: str) -> None:
+        with self._mu:
+            if replica_id in self._draining:
+                return
+            handle = self._handles.get(replica_id)
+            if handle is None:
+                return
+            self._draining.add(replica_id)
+        drain = getattr(handle, "drain", None) or getattr(
+            getattr(handle, "engine", None), "drain", None
+        )
+
+        def run() -> None:
+            try:
+                if drain is not None:
+                    drain()  # blocks until in-flight work finished
+            finally:
+                with self._mu:
+                    self._drained.add(replica_id)
+
+        threading.Thread(
+            target=run, daemon=True, name=f"drain-{replica_id}"
+        ).start()
+
+    def _idle(self, handle: Any) -> bool:
+        try:
+            health = handle.health_check() or {}
+        except Exception:
+            return True  # a replica too dead to answer holds nothing
+        details = health.get("details") or {}
+        return (
+            int(details.get("slots_active", 0)) == 0
+            and int(details.get("queue_depth", 0)) == 0
+        )
+
+    def reap(self, replica_id: str) -> bool:
+        with self._mu:
+            handle = self._handles.get(replica_id)
+            drained = replica_id in self._drained
+        if handle is None:
+            return True
+        if not drained and not self._idle(handle):
+            return False  # in-flight streams/handoffs still running
+        self.router.remove_replica(replica_id)
+        with self._mu:
+            self._handles.pop(replica_id, None)
+            self._roles.pop(replica_id, None)
+            self._draining.discard(replica_id)
+            self._drained.discard(replica_id)
+        if self._on_reap is not None:
+            try:
+                self._on_reap(handle)
+            except Exception:
+                pass  # teardown hooks must not wedge the scaler
+        return True
+
+    def handle(self, replica_id: str) -> Any:
+        with self._mu:
+            return self._handles.get(replica_id)
+
+
+class _RoleState:
+    __slots__ = ("pressure_since", "idle_since", "last_action_at")
+
+    def __init__(self) -> None:
+        self.pressure_since: float | None = None
+        self.idle_since: float | None = None
+        self.last_action_at = 0.0
+
+
+class Autoscaler:
+    """The control loop: one decision per role per tick, hysteresis on
+    both edges, drain-then-reap on the way down."""
+
+    def __init__(
+        self,
+        router: Any,
+        driver: ReplicaPoolDriver,
+        config: AutoscalerConfig | None = None,
+        *,
+        roles: tuple[str, ...] = (ms.ROLE_UNIFIED,),
+        metrics: Any = None,
+        logger: Any = None,
+    ) -> None:
+        self.router = router
+        self.driver = driver
+        self.config = config or AutoscalerConfig()
+        self.roles = tuple(roles)
+        self._metrics = metrics
+        self._logger = logger
+        self._states: dict[str, _RoleState] = {
+            role: _RoleState() for role in self.roles
+        }
+        self._reaping: set[str] = set()
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.scale_ups_total = 0
+        self.scale_downs_total = 0
+        self.decisions_skipped_total = 0  # scale.decision chaos faults
+        self.decisions: list[dict[str, Any]] = []  # bounded action log
+
+    # -- lifecycle -------------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="autoscaler"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=2.0)
+        self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.interval_s):
+            try:
+                self.tick()
+            except Exception as exc:
+                # the control plane misfiring must never take the data
+                # plane with it: log, keep ticking
+                if self._logger is not None:
+                    self._logger.error(f"autoscaler tick failed: {exc}")
+
+    # -- the decision ----------------------------------------------------------
+    def tick(self, now: float | None = None) -> None:
+        """One control round: reap any draining victims, then one
+        decision per role. Public for deterministic tests (the loop just
+        calls it on the interval)."""
+        now = time.monotonic() if now is None else now
+        with self._mu:
+            reaping = list(self._reaping)
+        for rid in reaping:
+            if self.driver.reap(rid):
+                with self._mu:
+                    self._reaping.discard(rid)
+        for role in self.roles:
+            self._decide(role, now)
+        if self._metrics is not None:
+            for role in self.roles:
+                self._metrics.set_gauge(
+                    "app_autoscaler_replicas",
+                    float(len(self.driver.replica_ids(role))),
+                    role=role,
+                )
+
+    def _decide(self, role: str, now: float) -> None:
+        cfg = self.config
+        state = self._states[role]
+        try:
+            chaos.maybe_fail("scale.decision")
+        except Exception:
+            # a faulted decision round: the pool keeps its size — the
+            # failure mode is "no action", never a kill
+            self.decisions_skipped_total += 1
+            return
+        wait = self.router.membership.aggregate_queue_wait(role)
+        hbm = self.router.membership.min_hbm_headroom(role)
+        current = len(self.driver.replica_ids(role))
+        pressure = wait > cfg.scale_up_wait_s or (
+            hbm is not None and hbm < cfg.hbm_floor_frac
+        )
+        idle = not pressure and wait < cfg.scale_down_wait_s
+        # hysteresis edges: the signal must persist
+        if pressure:
+            state.idle_since = None
+            if state.pressure_since is None:
+                state.pressure_since = now
+        elif idle:
+            state.pressure_since = None
+            if state.idle_since is None:
+                state.idle_since = now
+        else:
+            state.pressure_since = None
+            state.idle_since = None
+        in_cooldown = now - state.last_action_at < cfg.cooldown_s
+        if in_cooldown:
+            return
+        if (
+            pressure
+            and state.pressure_since is not None
+            and now - state.pressure_since >= cfg.up_stable_s
+            and current < cfg.max_replicas
+        ):
+            added = self.driver.scale_up(role, 1)
+            state.last_action_at = now
+            state.pressure_since = None
+            self.scale_ups_total += 1
+            self._record(role, "up", added, wait, hbm, current + 1)
+            return
+        if (
+            idle
+            and state.idle_since is not None
+            and now - state.idle_since >= cfg.down_stable_s
+            and current > cfg.min_replicas
+        ):
+            victim = self._pick_victim(role)
+            if victim is None:
+                return
+            # DRAIN, never kill: the victim finishes its in-flight
+            # streams and handoffs, stops receiving routes via its
+            # DRAINING heartbeat, and is reaped only once idle
+            self.driver.begin_drain(victim)
+            with self._mu:
+                self._reaping.add(victim)
+            state.last_action_at = now
+            state.idle_since = None
+            self.scale_downs_total += 1
+            self._record(role, "down", [victim], wait, hbm, current - 1)
+
+    def _pick_victim(self, role: str) -> str | None:
+        """Least-loaded live replica of the role — draining the emptiest
+        pod loses the least warm KV and finishes fastest."""
+        ids = self.driver.replica_ids(role)
+        if not ids:
+            return None
+        loads = [
+            (self.router.membership.load_of(rid), rid) for rid in ids
+        ]
+        loads.sort()
+        return loads[0][1]
+
+    def _record(self, role: str, direction: str, ids: list[str],
+                wait: float, hbm: float | None, target: int) -> None:
+        entry = {
+            "role": role, "direction": direction, "replicas": ids,
+            "queue_wait_s": round(wait, 4),
+            "hbm_free_frac": round(hbm, 4) if hbm is not None else None,
+            "target": target, "t": time.time(),
+        }
+        self.decisions.append(entry)
+        del self.decisions[:-64]  # bounded
+        if self._metrics is not None:
+            self._metrics.increment_counter(
+                "app_autoscaler_scale_events_total", direction=direction,
+            )
+        if self._logger is not None:
+            self._logger.info(
+                f"autoscaler: {role} scale-{direction} → {target} "
+                f"(queue_wait={wait:.3f}s hbm={hbm})"
+            )
+
+    # -- observability ---------------------------------------------------------
+    def snapshot(self) -> dict[str, Any]:
+        with self._mu:
+            reaping = sorted(self._reaping)
+        return {
+            "roles": {
+                role: {
+                    "replicas": self.driver.replica_ids(role),
+                    "queue_wait_s": round(
+                        self.router.membership.aggregate_queue_wait(role), 4
+                    ),
+                }
+                for role in self.roles
+            },
+            "draining": reaping,
+            "scale_ups_total": self.scale_ups_total,
+            "scale_downs_total": self.scale_downs_total,
+            "decisions_skipped_total": self.decisions_skipped_total,
+            "decisions": list(self.decisions[-16:]),
+        }

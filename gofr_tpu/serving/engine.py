@@ -115,6 +115,14 @@ class EngineConfig:
     # graceful drain: how long in-flight generations get to finish before
     # the remainder is failed with a retriable error
     drain_deadline_s: float = 30.0
+    # disaggregation role (serving/membership.py ROLES): "unified" serves
+    # whole generations; "prefill" computes prompt KV and hands it off;
+    # "decode" admits handed-off KV chains and streams. The role rides
+    # the membership heartbeat (ReplicaAnnouncer reads engine.role) and
+    # drives the router's role-split policy — the engine itself stays
+    # capable of both phases (the crash-safety degrade path re-prefills
+    # on a decode replica when a handoff source dies).
+    role: str = "unified"
 
     @classmethod
     def from_config(cls, config: Any) -> "EngineConfig":
@@ -175,6 +183,7 @@ class EngineConfig:
             drain_deadline_s=float(
                 config.get_or_default("TPU_DRAIN_DEADLINE_S", "30")
             ),
+            role=config.get_or_default("TPU_REPLICA_ROLE", "unified"),
         )
 
 
@@ -209,7 +218,8 @@ class _Request:
         "id", "prompt_ids", "max_new_tokens", "temperature", "top_k", "top_p",
         "stream_cb", "future", "created", "first_token_at", "tokens", "slot",
         "canceled", "stop_ids", "priority", "dispatched", "deadline",
-        "kv_exhausted", "timeline", "trace_ctx",
+        "kv_exhausted", "timeline", "trace_ctx", "prefill_only",
+        "handoff_from",
     )
 
     def __init__(self, rid: int, prompt_ids: list[int], max_new_tokens: int,
@@ -240,6 +250,14 @@ class _Request:
         # the caller's trace context (a Span the lifecycle spans hang off)
         self.timeline: Any = None
         self.trace_ctx: Any = None
+        # disaggregated serving (docs/robustness.md "The disaggregation
+        # plane"): a prefill_only request retires at the first-token
+        # commit with finish_reason "handoff" (its prompt KV stays in the
+        # prefix cache for the decode replica to pull); handoff_from
+        # names the prefill replica whose cache this request's admission
+        # should pull its KV chain from, under the kv.handoff 2PC fetch.
+        self.prefill_only = False
+        self.handoff_from: str | None = None
         # absolute perf_counter time the caller stops caring; None = forever
         self.deadline = (self.created + deadline) if deadline else None
 
@@ -307,6 +325,13 @@ class ServingEngine:
         self.model_cfg = cfg
         self.params = params
         self.config = engine_config or EngineConfig()
+        if self.config.role not in ("prefill", "decode", "unified"):
+            raise ValueError(
+                f"TPU_REPLICA_ROLE={self.config.role!r}: must be "
+                "prefill, decode or unified"
+            )
+        # read by the membership announcer (heartbeat role) and /routerz
+        self.role = self.config.role
         self.tokenizer: Tokenizer = tokenizer or ByteTokenizer(cfg.vocab_size)
         self._metrics = metrics
         self._logger = logger
@@ -325,6 +350,9 @@ class ServingEngine:
                     max_bytes=self.config.prefix_cache_bytes,
                     spill_bytes=self.config.kv_spill_bytes,
                     metrics=metrics,
+                    # demotion by timeline-observed reuse, not raw LRU:
+                    # late-bound closure — self.timeline is built below
+                    reuse_score=lambda key: self.timeline.reuse_count(key),
                 )
             else:
                 from gofr_tpu.serving.prefix_cache import PrefixCache
@@ -1007,6 +1035,8 @@ class ServingEngine:
         deadline: float | None = None,
         stream_cb: Callable[[int, str, bool], None] | None = None,
         trace_ctx: Any = None,
+        prefill_only: bool = False,
+        handoff_from: str | None = None,
     ) -> Any:
         """Thread-safe submit. Returns a concurrent Future resolving to
         GenerationResult. ``stream_cb(token_id, text_piece, done)`` fires per
@@ -1082,6 +1112,8 @@ class ServingEngine:
             stop_ids={self.tokenizer.eos_id}, deadline=deadline,
         )
         req.priority = priority
+        req.prefill_only = bool(prefill_only)
+        req.handoff_from = handoff_from
         # flight-recorder timeline + the queue span, BEFORE any admission
         # gate that can still reject: a shed/stopped request leaves a
         # terminal timeline too (the chaos tier audits exactly-one-
@@ -1486,9 +1518,16 @@ class ServingEngine:
         cache = self._prefix_cache
         tiered = getattr(cache, "get_with_tier", None)
         if tiered is not None:
-            return tiered(key)
-        value = cache.get(key)
-        return value, ("device" if value is not None else "miss")
+            value, tier = tiered(key)
+        else:
+            value = cache.get(key)
+            tier = "device" if value is not None else "miss"
+        if value is not None:
+            # feed the spill tier's demotion scorer: the flight recorder
+            # keeps the per-key reuse counts the byte-pressure eviction
+            # orders by (host dict write, zero device work)
+            self.timeline.observe_prefix_reuse(key)
+        return value, tier
 
     def _record_prefix_tier(self, req: _Request, tier: str) -> None:
         """Stamp the request's warmest-source attribution — the
@@ -1572,10 +1611,18 @@ class ServingEngine:
             cache_key = f"prefill:{bucket}:{len(req.prompt_ids)}:{digest}"
             cached, prefix_tier = self._cache_lookup(cache_key)
             if cached is None and self._kv_migrator is not None:
-                # cluster tier: another replica advertises this exact
-                # prefill — migrate its slabs instead of recomputing
-                # (advisory: any failure stays a compute miss)
-                fetched = self._kv_migrator.fetch_one(cache_key)
+                # disaggregated handoff first (the router named the
+                # prefill source — no heartbeat-advertisement wait), then
+                # the advisory cluster tier: another replica advertises
+                # this exact prefill — migrate its slabs instead of
+                # recomputing (either failure stays a compute miss)
+                fetched = None
+                if req.handoff_from is not None:
+                    fetched = self._kv_migrator.fetch_one_handoff(
+                        cache_key, req.handoff_from
+                    )
+                if fetched is None:
+                    fetched = self._kv_migrator.fetch_one(cache_key)
                 # the fetch can block (remote transport timeout): a warm
                 # restart may have retired this thread meanwhile — the
                 # put below would poison the cache the restart just
@@ -1747,14 +1794,28 @@ class ServingEngine:
                 tiers.add(tier)
                 pos = end
             if pos < total and self._kv_migrator is not None:
-                # cluster tier: migrate the longest advertised
-                # chunk-boundary chain from the owning replica. The
-                # fetch is advisory and contiguous-from-pos by contract
-                # — a torn transfer keeps the fetched prefix and the
-                # planner's chunk grants compute the rest (never a
-                # double-prefill: committed spans stay contiguous).
+                # disaggregated handoff first: the router named the
+                # prefill source, and the fetch runs under the kv.handoff
+                # two-phase-commit discipline — a COMPLETE, contiguity-
+                # audited chain or nothing (a torn handoff must never
+                # commit a partial chain it believed complete). A source
+                # or transport failure returns [] and the normal
+                # advisory tiers below degrade to re-prefill.
                 remaining = [b for b in boundaries if b[0] >= pos]
-                fetched = self._kv_migrator.fetch_chain(remaining)
+                fetched = []
+                if req.handoff_from is not None:
+                    fetched = self._kv_migrator.fetch_handoff(
+                        remaining, req.handoff_from
+                    )
+                if not fetched:
+                    # cluster tier: migrate the longest advertised
+                    # chunk-boundary chain from the owning replica. The
+                    # fetch is advisory and contiguous-from-pos by
+                    # contract — a torn transfer keeps the fetched prefix
+                    # and the planner's chunk grants compute the rest
+                    # (never a double-prefill: committed spans stay
+                    # contiguous).
+                    fetched = self._kv_migrator.fetch_chain(remaining)
                 # the fetch can block (remote transport timeout): a
                 # retired thread must not put dead slabs into the
                 # replacement engine's freshly-reset cache
@@ -2618,6 +2679,15 @@ class ServingEngine:
             self._metrics.record_histogram(
                 "app_request_ttft_seconds", ttft, source="engine",
             )
+        if req.prefill_only:
+            # disaggregated prefill phase: the prompt KV (and the cached
+            # last-position logits) are what the caller wanted — they sit
+            # in the prefix cache for the decode replica's handoff fetch.
+            # Retire NOW, before any decode step or token emission: the
+            # DECODE replica samples the identical first token from the
+            # migrated logits, so emitting here would double-serve it.
+            self._retire(slot, "handoff")
+            return
         self._emit_token(req, first_id)
         self._check_retired()  # stream_cb may have blocked across a restart
         if first_id in req.stop_ids:

@@ -37,6 +37,12 @@ class GenerateRequest:
     top_k: int = 0
     top_p: float = 1.0
     stream: bool = False
+    # disaggregated serving (docs/robustness.md "The disaggregation
+    # plane"): prefill_only runs the prompt KV into the prefix cache and
+    # retires (finish_reason "handoff"); handoff_from names the prefill
+    # replica this request's admission pulls its KV chain from
+    prefill_only: bool = False
+    handoff_from: str = ""
 
 
 def _shutdown_hook(engine: Any) -> Any:
@@ -116,7 +122,38 @@ def register_generation_routes(app: Any, engine: Any, prefix: str = "",
             ]
         }
 
+    async def generate_stream(ctx: Any):
+        """The remote token-stream transport (docs/serving.md): always
+        streams, whatever the body's ``stream`` flag says — a router's
+        HTTPReplica needs a surface whose FIRST byte is the request id
+        frame and whose tokens arrive as they decode, so remote TTFT is
+        decoupled from completion time."""
+        body = ctx.bind(GenerateRequest)
+        kw = _validated_generate_kwargs(body)
+        kw["deadline"] = deadline_from_ctx(ctx)
+        kw["trace_ctx"] = current_span()
+        return _sse_response(engine, body.prompt, kw)
+
+    async def generate_cancel(ctx: Any):
+        """The remote cancel wire: ``{"id": N}`` marks the request
+        canceled — a running row frees its slot at the next block sync
+        (its stream ends with finish_reason "cancel"), a queued one
+        resolves at admission. Idempotent; an unknown id is a no-op
+        (the request may have finished while the cancel was in flight)."""
+        body = ctx.bind(dict) or {}
+        rid = body.get("id")
+        if rid is None:
+            raise ErrorMissingParam("id")
+        try:
+            rid = int(rid)
+        except (TypeError, ValueError):
+            raise ErrorInvalidParam("id") from None
+        engine.cancel(rid)
+        return {"canceled": rid}
+
     app.post(prefix + "/generate", generate)
+    app.post(prefix + "/generate/stream", generate_stream)
+    app.post(prefix + "/generate/cancel", generate_cancel)
     app.get(prefix + "/v1/models", models)
     register_requestz_routes(app, engine, prefix + "/requestz")
     register_kv_fetch_routes(app, engine, prefix + "/kv/fetch")
@@ -137,6 +174,13 @@ def _sse_response(engine: Any, prompt: str, kw: dict) -> WireResponse:
 
     async def gen():
         try:
+            # id frame FIRST (docs/serving.md wire format): the remote
+            # cancel wire needs the request id before any token arrives —
+            # a client that hedges/aborts pre-first-token must be able to
+            # name what it is canceling
+            yield (
+                "data: " + json.dumps({"id": future.request_id}) + "\n\n"
+            ).encode()
             while True:
                 token_id, piece, done = await q.get()
                 if done:
@@ -194,12 +238,19 @@ def _validated_generate_kwargs(body: GenerateRequest) -> dict:
         raise ErrorMissingParam("prompt")
     if body.temperature < 0 or body.top_p <= 0 or body.top_p > 1:
         raise ErrorInvalidParam("temperature", "top_p")
-    return dict(
+    kw = dict(
         max_new_tokens=body.max_tokens or None,
         temperature=body.temperature,
         top_k=body.top_k,
         top_p=body.top_p,
     )
+    # disaggregation flags ride only when set: engines without the
+    # disaggregation plane (injected doubles) keep their old signature
+    if body.prefill_only:
+        kw["prefill_only"] = True
+    if body.handoff_from:
+        kw["handoff_from"] = body.handoff_from
+    return kw
 
 
 def register_generation_ws(app: Any, engine: Any, path: str = "/ws/generate",

@@ -85,10 +85,21 @@ def _host_bytes(value: Any) -> int:
 
 class HostSpillTier:
     """Byte-bounded LRU of host (numpy) KV pytrees. Thread-safe; the
-    lock is leaf-only (never held across a call out)."""
+    lock is leaf-only (never held across a call out — ``score`` is read
+    BEFORE taking it).
 
-    def __init__(self, max_bytes: int) -> None:
+    ``score`` (optional) upgrades the byte-pressure eviction order from
+    raw LRU to timeline-observed reuse (serving/timeline.py
+    ``TimelineRecorder.reuse_count``): among resident entries the LOWEST
+    (score, LRU-age) evicts first, so a hot system prompt's slabs
+    outlive a one-shot prompt's even when the one-shot was touched more
+    recently — demotion follows what the request timelines actually
+    observed being reused, not access recency alone."""
+
+    def __init__(self, max_bytes: int,
+                 score: Any = None) -> None:
         self.max_bytes = max_bytes
+        self._score = score  # Callable[[key], number] | None
         self._entries: "OrderedDict[Hashable, Any]" = OrderedDict()
         self._sizes: dict[Hashable, int] = {}
         self._total_bytes = 0
@@ -100,6 +111,21 @@ class HostSpillTier:
         size = _host_bytes(host_value)
         if size > self.max_bytes:
             return  # cannot ever fit: don't flush the tier for it
+        scores: dict[Hashable, float] = {}
+        if self._score is not None:
+            # snapshot the resident keys UNDER the tier lock (engine
+            # threads pop/touch the dict concurrently — iterating it
+            # unlocked can raise mid-iteration), then score OUTSIDE it
+            # (the scorer takes the timeline recorder's own leaf lock).
+            # Keys racing in behind the snapshot default to 0 — a
+            # brand-new entry has no observed reuse yet by definition.
+            with self._mu:
+                resident = list(self._entries.keys())
+            for k in resident:
+                try:
+                    scores[k] = float(self._score(k))
+                except Exception:
+                    scores[k] = 0.0
         with self._mu:
             if key in self._entries:
                 self._total_bytes -= self._sizes.get(key, 0)
@@ -108,7 +134,21 @@ class HostSpillTier:
             self._total_bytes += size
             self._entries.move_to_end(key)
             while self._entries and self._total_bytes > self.max_bytes:
-                old_key, _ = self._entries.popitem(last=False)
+                if scores:
+                    # reuse-scored demotion: lowest observed reuse goes
+                    # first; ties fall back to LRU order (dict order is
+                    # LRU; min() keeps the first == oldest on ties). The
+                    # just-inserted key is exempt — evicting what we are
+                    # inserting would thrash.
+                    victims = [k for k in self._entries if k != key]
+                    if not victims:
+                        break
+                    old_key = min(
+                        victims, key=lambda k: scores.get(k, 0.0)
+                    )
+                    self._entries.pop(old_key, None)
+                else:
+                    old_key, _ = self._entries.popitem(last=False)
                 self._total_bytes -= self._sizes.pop(old_key, 0)
 
     def get(self, key: Hashable) -> Any | None:
@@ -182,11 +222,15 @@ class TieredPrefixCache:
         spill_bytes: int = 1024 * 1024 * 1024,
         *,
         metrics: Any = None,
+        reuse_score: Any = None,
     ) -> None:
         self._device = PrefixCache(
             max_entries, max_bytes=max_bytes, on_evict=self._offer
         )
-        self._host = HostSpillTier(spill_bytes)
+        # reuse_score (Callable[[key], number], typically the timeline
+        # recorder's reuse_count) upgrades host-tier demotion from raw
+        # LRU to timeline-observed reuse ordering
+        self._host = HostSpillTier(spill_bytes, score=reuse_score)
         self._metrics = metrics
         self._exec = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="kv-spill"

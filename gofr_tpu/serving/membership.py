@@ -54,6 +54,17 @@ STATE_VALUES = {
 # states that may receive new routes (SUSPECT only as a last resort)
 _NEVER_ROUTE = (DRAINING, WEDGED, RESTARTING, DOWN)
 
+# replica roles (disaggregated prefill/decode serving, ROADMAP item 2,
+# AIBrix arXiv:2504.03648): a UNIFIED replica serves whole generations;
+# a PREFILL replica only computes prompt KV (handed off to a decode
+# replica over the PR 11 transfer machinery); a DECODE replica admits
+# handed-off KV chains and streams tokens. Roles ride the heartbeat so
+# the router's policy follows the pool's actual shape, live.
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLE_UNIFIED = "unified"
+ROLES = (ROLE_PREFILL, ROLE_DECODE, ROLE_UNIFIED)
+
 
 @dataclasses.dataclass
 class Heartbeat:
@@ -66,6 +77,10 @@ class Heartbeat:
     replica_id: str
     seq: int
     state: str = UP
+    # disaggregation role (prefill/decode/unified). Part of the beat, not
+    # static registration: a pool driver can repurpose a replica between
+    # roles and the router's policy follows within one heartbeat.
+    role: str = ROLE_UNIFIED
     queue_wait_s: float = 0.0   # shed EWMA estimate (serving/shed.py)
     queue_depth: int = 0
     slots_free: int = 0
@@ -92,10 +107,11 @@ class Heartbeat:
 class _ReplicaView:
     """The membership table's record of one replica."""
 
-    def __init__(self, replica_id: str) -> None:
+    def __init__(self, replica_id: str, role: str = ROLE_UNIFIED) -> None:
         self.replica_id = replica_id
         self.seq = -1
         self.reported_state = UP
+        self.role = role
         self.last_seen: float | None = None  # monotonic arrival time
         self.queue_wait_s = 0.0
         self.queue_depth = 0
@@ -124,6 +140,7 @@ class _ReplicaView:
         out: dict[str, Any] = {
             "state": self.effective_state(now, suspect_after, down_after),
             "reported_state": self.reported_state,
+            "role": self.role,
             "seq": self.seq,
             "queue_wait_s": round(self.queue_wait_s, 4),
             "queue_depth": self.queue_depth,
@@ -154,11 +171,17 @@ class MembershipTable:
         self._mu = threading.Lock()
         self._replicas: dict[str, _ReplicaView] = {}
 
-    def register(self, replica_id: str) -> None:
+    def register(self, replica_id: str, role: str = ROLE_UNIFIED) -> None:
         """Pre-register a replica (the router knows its handles up front);
-        it stays SUSPECT until its first heartbeat arrives."""
+        it stays SUSPECT until its first heartbeat arrives. ``role`` is
+        the registration-time default — the replica's own heartbeats are
+        authoritative and overwrite it."""
         with self._mu:
-            self._replicas.setdefault(replica_id, _ReplicaView(replica_id))
+            view = self._replicas.setdefault(
+                replica_id, _ReplicaView(replica_id, role)
+            )
+            if view.last_seen is None:
+                view.role = role  # never heard from: registration decides
 
     def forget(self, replica_id: str) -> None:
         with self._mu:
@@ -176,6 +199,11 @@ class MembershipTable:
                 return False
             view.seq = hb.seq
             view.reported_state = hb.state
+            if hb.role in ROLES:
+                view.role = hb.role  # the beat is authoritative; an
+                # unknown role string keeps the last known one (a newer
+                # announcer gossiping a role this router predates must
+                # not un-route the replica)
             view.last_seen = now
             view.queue_wait_s = float(hb.queue_wait_s)
             view.queue_depth = int(hb.queue_depth)
@@ -227,17 +255,34 @@ class MembershipTable:
                 return (1.0, None)
             return (view.kv_free_frac, view.hbm_free_frac)
 
-    def candidates(self, now: float | None = None) -> list[str]:
+    def candidates(self, now: float | None = None, *,
+                   role: str | None = None) -> list[str]:
         """Replica ids eligible for NEW work: every UP replica (least
         estimated wait first); when no UP replica exists, SUSPECT
         replicas (same order) — a tier-wide heartbeat blip must degrade
         to best-effort routing, not a total outage. DRAINING / WEDGED /
-        RESTARTING / DOWN are never returned."""
+        RESTARTING / DOWN are never returned.
+
+        ``role`` filters by disaggregation phase: ``role="decode"``
+        returns decode + unified replicas, ``role="prefill"`` returns
+        prefill + unified — a role-split replica is NEVER handed the
+        other phase's work (role-mismatch rejection happens here, at
+        candidate assembly, so no later path can route around it).
+        ``None`` asks for whole-generation routing: prefill specialists
+        are excluded (they must never stream tokens), while decode and
+        unified replicas both qualify — a decode replica CAN compute its
+        own prefill (role is policy, not capability), which is exactly
+        the degrade path a dead handoff source falls back on."""
         now = time.monotonic() if now is None else now
         up: list[_ReplicaView] = []
         suspect: list[_ReplicaView] = []
         with self._mu:
             for view in self._replicas.values():
+                if role is None:
+                    if view.role == ROLE_PREFILL:
+                        continue  # a prefill specialist never streams
+                elif view.role not in (role, ROLE_UNIFIED):
+                    continue
                 state = view.effective_state(
                     now, self.suspect_after_s, self.down_after_s
                 )
@@ -248,6 +293,27 @@ class MembershipTable:
         pool = up if up else suspect
         pool.sort(key=lambda v: (v.queue_wait_s, -v.slots_free, v.replica_id))
         return [v.replica_id for v in pool]
+
+    def role_of(self, replica_id: str) -> str:
+        with self._mu:
+            view = self._replicas.get(replica_id)
+            return view.role if view is not None else ROLE_UNIFIED
+
+    def roles_present(self, now: float | None = None) -> set[str]:
+        """Roles with at least one routable (UP/SUSPECT) replica — the
+        router's disaggregation switch: a prefill AND a decode pool both
+        present means requests split into a prefill phase + a KV handoff
+        + a decode phase."""
+        now = time.monotonic() if now is None else now
+        out: set[str] = set()
+        with self._mu:
+            for view in self._replicas.values():
+                state = view.effective_state(
+                    now, self.suspect_after_s, self.down_after_s
+                )
+                if state in (UP, SUSPECT):
+                    out.add(view.role)
+        return out
 
     def snapshot(self, now: float | None = None) -> dict[str, Any]:
         now = time.monotonic() if now is None else now
@@ -260,19 +326,48 @@ class MembershipTable:
             for v in views
         }
 
-    def aggregate_queue_wait(self) -> float:
+    def aggregate_queue_wait(self, role: str | None = None) -> float:
         """Mean reported queue-wait across live (UP/SUSPECT) replicas —
         the tier-level autoscaling signal (scale up when the whole tier
-        is waiting, not when one replica hiccups)."""
+        is waiting, not when one replica hiccups). ``role`` narrows the
+        mean to one pool — the SAME pool ``candidates(role=)`` routes
+        to, unified replicas included: they absorb that role's traffic,
+        and a signal blind to them would read a saturated mixed pool as
+        idle and scale it down. The autoscaler sizes prefill and decode
+        pools independently (a prefill backlog must grow the prefill
+        pool, not add decode replicas that would sit idle)."""
         now = time.monotonic()
         with self._mu:
             waits = [
                 v.queue_wait_s for v in self._replicas.values()
-                if v.effective_state(
+                if (role is None or v.role in (role, ROLE_UNIFIED))
+                and v.effective_state(
                     now, self.suspect_after_s, self.down_after_s
                 ) in (UP, SUSPECT)
             ]
         return sum(waits) / len(waits) if waits else 0.0
+
+    def min_hbm_headroom(self, role: str | None = None) -> float | None:
+        """The tightest reported HBM headroom across live replicas (of
+        ``role``'s pool — unified replicas included, matching
+        ``candidates(role=)`` — or all) — the autoscaler's
+        memory-pressure signal. None when no live replica publishes a
+        device-telemetry sample."""
+        now = time.monotonic()
+        best: float | None = None
+        with self._mu:
+            for v in self._replicas.values():
+                if role is not None and v.role not in (role, ROLE_UNIFIED):
+                    continue
+                if v.effective_state(
+                    now, self.suspect_after_s, self.down_after_s
+                ) not in (UP, SUSPECT):
+                    continue
+                if v.hbm_free_frac is None:
+                    continue
+                if best is None or v.hbm_free_frac < best:
+                    best = v.hbm_free_frac
+        return best
 
 
 class ReplicaAnnouncer:
@@ -298,6 +393,7 @@ class ReplicaAnnouncer:
         logger: Any = None,
         hbm_headroom: Callable[[], float | None] | None = None,
         advert_limit: int = 128,
+        role: str | None = None,
     ) -> None:
         self.replica_id = replica_id
         self.engine = engine
@@ -306,6 +402,11 @@ class ReplicaAnnouncer:
         self.interval_s = interval_s
         self._logger = logger
         self._hbm_headroom = hbm_headroom
+        # disaggregation role carried on every beat: explicit param wins,
+        # else the engine's own declared role, else unified. A plain
+        # string attribute — a pool driver repurposing the replica flips
+        # it and the next beat reroutes the tier.
+        self.role = role or getattr(engine, "role", None) or ROLE_UNIFIED
         # prefix-index advertisement bound: a heartbeat must stay a
         # heartbeat (0 disables advertising entirely)
         self.advert_limit = advert_limit
@@ -359,6 +460,7 @@ class ReplicaAnnouncer:
             replica_id=self.replica_id,
             seq=seq,
             state=str(health.get("status", UP)),
+            role=self.role,
             queue_wait_s=waves * ewma,
             queue_depth=depth,
             slots_free=max(int(slots_total) - int(slots_active), 0),

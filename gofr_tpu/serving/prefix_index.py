@@ -238,6 +238,7 @@ class KVMigrator:
         self._peers: dict[str, Callable[[list[str]], dict[str, tuple]]] = {}
         self._suppressed_until: dict[str, float] = {}
         self.migrations_total = 0
+        self.handoffs_total = 0
         self.failed_fetches_total = 0
 
     def add_peer(self, replica_id: str,
@@ -303,4 +304,70 @@ class KVMigrator:
         """Single-entry fetch (the whole-prompt/monolithic prefill
         path). Same advisory contract as :meth:`fetch_chain`."""
         got = self.fetch_chain([(0, 0, key)])
+        return got[0][2] if got else None
+
+    # -- disaggregated prefill→decode handoff ----------------------------------
+    def fetch_handoff(
+        self, boundaries: list[tuple[int, int, str]], source: str
+    ) -> list[tuple[int, int, tuple]]:
+        """The prefill→decode KV handoff fetch (docs/robustness.md "The
+        disaggregation plane"): pull ``boundaries`` from the NAMED
+        prefill replica under a two-phase-commit discipline — phase one
+        fetches every span into host staging, phase two hands the chain
+        to the engine's commit path ONLY when it is complete and
+        contiguity-audited (every boundary present, spans abutting,
+        covering the request exactly). Anything less returns ``[]`` and
+        the decode replica re-prefills: a torn handoff must degrade, not
+        commit a partial chain the admission believed complete.
+
+        The ``kv.handoff`` chaos point models the source dying (or the
+        transport tearing) mid-handoff; a failed source is suppressed
+        for ``failure_backoff_s`` exactly like the advisory tier."""
+        if not boundaries:
+            return []
+        fetch = self._peers.get(source)
+        if fetch is None:
+            return []  # no transport to the named source: re-prefill
+        until = self._suppressed_until.get(source)
+        if until is not None and time.monotonic() < until:
+            return []
+        try:
+            chaos.maybe_fail("kv.handoff")
+            fetched = fetch([key for _s, _e, key in boundaries])
+        except Exception as exc:
+            self.failed_fetches_total += 1
+            self._suppressed_until[source] = (
+                time.monotonic() + self.failure_backoff_s
+            )
+            if self._logger is not None:
+                self._logger.warn(
+                    f"KV handoff fetch from {source} failed; "
+                    f"re-prefilling: {exc}"
+                )
+            return []
+        self._suppressed_until.pop(source, None)
+        out: list[tuple[int, int, tuple]] = []
+        pos = boundaries[0][0]
+        for start, end, key in boundaries:
+            value = fetched.get(key)
+            # the audit: every span present, well-formed, and abutting
+            # the previous one — the prefill side evicting a chunk
+            # mid-handoff (or a codec tearing a leaf) fails the WHOLE
+            # chain, never admits a gap
+            if (value is None or len(value) != 3 or start != pos
+                    or end <= start):
+                self.failed_fetches_total += 1
+                return []
+            out.append((start, end, value))
+            pos = end
+        self.handoffs_total += 1
+        if self._metrics is not None:
+            self._metrics.increment_counter("app_kv_handoffs_total")
+        return out
+
+    def fetch_one_handoff(self, key: str, source: str) -> tuple | None:
+        """Monolithic-prompt handoff: the single whole-prompt prefill
+        entry from the named source — present and well-formed, or None
+        (re-prefill). Same 2PC/backoff contract as :meth:`fetch_handoff`."""
+        got = self.fetch_handoff([(0, 1, key)], source)
         return got[0][2] if got else None

@@ -1,0 +1,154 @@
+"""The remote token-stream transport, client half (docs/serving.md).
+
+Before this module, a remote replica behind ``HTTPReplica`` served unary
+``POST /generate``: the router could not see a single token until the
+WHOLE generation finished, so remote TTFT was capped at completion
+latency, failover/hedging lost their pre-first-token semantics across
+the wire, and a canceled hedge twin kept burning decode steps to the
+end. This is the fix's transport layer (ROADMAP item 2; the
+vLLM-vs-TGI methodology, arXiv:2511.17593, makes token-level streaming
+latency the measurable axis):
+
+- the server side is ``POST /generate/stream`` (serving/handlers.py):
+  Server-Sent Events over chunked transfer — an ``{"id": N}`` frame
+  first (the cancel wire's name for the request), one
+  ``{"token", "text"}`` frame per decoded token, a terminal
+  ``{"finish_reason", "usage"}`` frame, then ``data: [DONE]``;
+- this module drives the client side over
+  ``HTTPService.stream`` (service/client.py), dispatching each frame
+  the moment it arrives, and maps the wire's terminal/error frames back
+  to the same typed errors the in-process engine raises — the router's
+  failover machinery cannot tell a remote replica from a local one;
+- ``POST /generate/cancel {"id": N}`` stops the remote decode: the
+  engine retires the row at the next block sync (within one block),
+  and the stream ends with finish_reason ``cancel``.
+
+The ``stream.remote`` chaos point sits on every frame read: a fault
+there IS the transport tearing mid-stream — the reader raises
+``ConnectionError`` and the router decides (pre-first-token: failover;
+after: the typed error reaches the client, a stream is not idempotent).
+
+This module runs on HTTPReplica's worker pool threads, never the event
+loop — the frame reads BLOCK by design, exactly like the engine's
+stream_cb contract expects.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable
+
+from gofr_tpu import chaos
+from gofr_tpu.http.errors import (
+    ErrorDeadlineExceeded,
+    ErrorServiceUnavailable,
+    ErrorTooManyRequests,
+)
+from gofr_tpu.service.options import retry_after_from_headers
+
+__all__ = ["iter_events", "run_stream", "error_from_status"]
+
+STREAM_PATH = "/generate/stream"
+CANCEL_PATH = "/generate/cancel"
+
+
+def error_from_status(status: int, detail: str,
+                      headers: dict[str, str] | None = None) -> Exception:
+    """Map a remote replica's HTTP status (response head or in-stream
+    error frame) to the typed error the router's RETRIABLE_ERRORS set
+    keys on — the wire must not demote a retriable 503 to an opaque
+    RuntimeError."""
+    if status == 503:
+        return ErrorServiceUnavailable(
+            detail, retry_after=retry_after_from_headers(headers or {})
+        )
+    if status == 429:
+        return ErrorTooManyRequests(
+            retry_after=retry_after_from_headers(headers or {})
+        )
+    if status == 504:
+        return ErrorDeadlineExceeded(detail)
+    return RuntimeError(detail)
+
+
+def iter_events(resp: Any) -> Any:
+    """Parse SSE ``data:`` frames off a streaming response, yielding
+    each decoded JSON event as it arrives; returns at ``[DONE]`` or
+    stream end. Unparseable frames are skipped (forward compatibility:
+    a newer server may interleave event types this client predates)."""
+    for line in resp.lines():
+        if not line.startswith("data:"):
+            continue  # SSE comments / keepalives
+        payload = line[5:].strip()
+        if payload == "[DONE]":
+            return
+        # the mid-stream tear seam: a fault here is the transport dying
+        # between two frames
+        chaos.maybe_fail("stream.remote")
+        try:
+            event = json.loads(payload)
+        except ValueError:
+            continue
+        if isinstance(event, dict):
+            yield event
+
+
+def run_stream(
+    svc: Any,
+    payload: dict[str, Any],
+    *,
+    headers: dict[str, str] | None = None,
+    timeout: float | None = None,
+    on_id: Callable[[int], None] | None = None,
+    on_token: Callable[[int, str], None] | None = None,
+    path: str = STREAM_PATH,
+) -> dict[str, Any]:
+    """Drive one remote streaming generation to its terminal frame.
+
+    Opens ``POST {path}`` through the (breaker-aware) service client's
+    ``stream``, dispatches ``on_id`` with the remote request id (the
+    cancel wire's handle) and ``on_token`` per token frame, and returns
+    the terminal event (``finish_reason`` + ``usage``). Raises the
+    typed error for admission-time statuses (503/429/504 — real
+    statuses, the head was not 200), for in-stream error frames
+    (late deadline/drain, delivered as events because the 200 head was
+    already on the wire), and ``ConnectionError`` for a stream that
+    tore before its terminal frame."""
+    resp = svc.stream(
+        "POST", path, json=payload, headers=headers, timeout=timeout,
+    )
+    if not resp.ok:
+        try:
+            detail = resp.read_body().decode("utf-8", "replace")[:200]
+        except Exception:
+            detail = ""
+        finally:
+            resp.close()
+        raise error_from_status(
+            resp.status_code,
+            f"remote stream: HTTP {resp.status_code} {detail}".strip(),
+            resp.headers,
+        )
+    terminal: dict[str, Any] | None = None
+    try:
+        for event in iter_events(resp):
+            if "error" in event:
+                raise error_from_status(
+                    int(event.get("status") or 0), str(event["error"])
+                )
+            if "finish_reason" in event:
+                terminal = event
+            elif "token" in event:
+                if on_token is not None:
+                    on_token(int(event["token"]), str(event.get("text", "")))
+            elif "id" in event:
+                if on_id is not None:
+                    on_id(int(event["id"]))
+    finally:
+        resp.close()
+    if terminal is None:
+        # the transport died between frames (or the server aborted
+        # without its terminal): a retriable transport error — the
+        # router knows whether tokens already crossed
+        raise ConnectionError("remote stream ended without a terminal frame")
+    return terminal

@@ -55,10 +55,7 @@ from gofr_tpu.http.errors import (
 from gofr_tpu.metrics.register import Histogram
 from gofr_tpu.serving import membership as ms
 from gofr_tpu.serving.prefix_index import PrefixIndex, decode_entry
-from gofr_tpu.service.options import (
-    CircuitBreakerError,
-    retry_after_from_headers,
-)
+from gofr_tpu.service.options import CircuitBreakerError
 from gofr_tpu.tracing.trace import current_span, format_traceparent
 
 # The typed-retriable error set: ONLY these may trigger a failover
@@ -199,9 +196,13 @@ class LocalReplica:
     contract: ``submit(prompt, **kw) -> Future``, ``cancel(request_id)``,
     ``health_check()``."""
 
-    def __init__(self, replica_id: str, engine: Any) -> None:
+    def __init__(self, replica_id: str, engine: Any,
+                 role: str | None = None) -> None:
         self.replica_id = replica_id
         self.engine = engine
+        # disaggregation role seed for membership registration; the
+        # engine's announcer remains authoritative via heartbeats
+        self.role = role or getattr(engine, "role", None) or ms.ROLE_UNIFIED
 
     def submit(self, prompt: str | list[int], **kw: Any) -> Any:
         return self.engine.submit(prompt, **kw)
@@ -226,12 +227,14 @@ class HTTPReplica:
     def __init__(self, replica_id: str, address: str, *, logger: Any = None,
                  metrics: Any = None, breaker_threshold: int = 3,
                  breaker_interval: float = 5.0,
-                 on_breaker_open: Callable[[str], None] | None = None) -> None:
+                 on_breaker_open: Callable[[str], None] | None = None,
+                 role: str | None = None) -> None:
         from gofr_tpu.service.client import new_http_service
         from gofr_tpu.service.options import CircuitBreakerConfig
 
         self.replica_id = replica_id
         self.address = address
+        self.role = role or ms.ROLE_UNIFIED  # membership registration seed
         self._svc = new_http_service(
             address, logger, metrics, None,
             CircuitBreakerConfig(breaker_threshold, breaker_interval),
@@ -245,6 +248,29 @@ class HTTPReplica:
         )
         self._rid_mu = threading.Lock()
         self._next_rid = 0
+        # local rid -> remote engine rid, learned from the stream's id
+        # frame; the cancel wire posts the REMOTE id. None = id frame not
+        # seen yet; a cancel arriving first parks in _cancel_early and
+        # fires the moment the id lands.
+        self._remote_ids: dict[int, int | None] = {}
+        self._cancel_early: set[int] = set()
+
+    def _build_payload(
+        self, prompt: Any, kw: dict[str, Any]
+    ) -> dict[str, Any]:
+        payload: dict[str, Any] = {"prompt": prompt}
+        if kw.get("max_new_tokens"):
+            payload["max_tokens"] = kw["max_new_tokens"]
+        for key in ("temperature", "top_k", "top_p"):
+            if kw.get(key):
+                payload[key] = kw[key]
+        # disaggregation plane: ride the wire only when set (an older
+        # replica's handler ignores unknown fields either way)
+        if kw.get("prefill_only"):
+            payload["prefill_only"] = True
+        if kw.get("handoff_from"):
+            payload["handoff_from"] = kw["handoff_from"]
+        return payload
 
     def submit(self, prompt: str | list[int], *, deadline: float | None = None,
                stream_cb: Any = None, trace_ctx: Any = None,
@@ -254,12 +280,7 @@ class HTTPReplica:
             rid = self._next_rid
         future: Any = concurrent.futures.Future()
         future.request_id = rid
-        payload: dict[str, Any] = {"prompt": prompt}
-        if kw.get("max_new_tokens"):
-            payload["max_tokens"] = kw["max_new_tokens"]
-        for key in ("temperature", "top_k", "top_p"):
-            if kw.get(key):
-                payload[key] = kw[key]
+        payload = self._build_payload(prompt, kw)
         headers: dict[str, str] = {}
         if deadline:
             headers["X-Request-Timeout"] = f"{deadline:.3f}"
@@ -269,51 +290,157 @@ class HTTPReplica:
             # HTTP middleware continues this trace, so the cross-process
             # span tree stays connected
             headers["traceparent"] = format_traceparent(ctx_span)
-        if not headers:
-            headers = None
-
-        def run() -> None:
-            try:
-                resp = self._svc.post(
-                    "/generate", json=payload, headers=headers,
-                    timeout=deadline,
-                )
-                if resp.status_code in (503, 429):
-                    err_cls = (
-                        ErrorServiceUnavailable if resp.status_code == 503
-                        else ErrorTooManyRequests
-                    )
-                    raise err_cls(
-                        f"replica {self.replica_id}: {resp.status_code}",
-                        retry_after=retry_after_from_headers(resp.headers),
-                    )
-                if resp.status_code == 504:
-                    raise ErrorDeadlineExceeded()
-                if not resp.ok:
-                    raise RuntimeError(
-                        f"replica {self.replica_id}: HTTP {resp.status_code}"
-                    )
-                body = resp.json()
-                data = body.get("data") or body
-                if stream_cb is not None:
-                    stream_cb(0, data.get("text", ""), False)
-                    stream_cb(0, "", True)
-                future.set_result(_RemoteResult(rid, data))
-            # gofrlint: disable=router-retry-untyped -- settles the future
-            # with the error (no retry happens here); a narrow catch would
-            # strand the client future forever on an unexpected failure
-            except BaseException as exc:
-                if isinstance(exc, OSError) and not isinstance(
-                    exc, ConnectionError
-                ):
-                    exc = ConnectionError(str(exc))
-                future.set_exception(exc)
-
-        self._pool.submit(run)
+        if stream_cb is not None:
+            # token-level streaming (serving/remote.py): tokens reach the
+            # router's stream claim the moment the replica decodes them —
+            # remote TTFT decouples from completion time, and failover/
+            # hedging keep their pre-first-token semantics over the wire
+            with self._rid_mu:
+                # cancelable from this instant: a cancel landing before
+                # the id frame parks in _cancel_early (see cancel())
+                self._remote_ids[rid] = None
+            self._pool.submit(
+                self._run_stream, rid, future, payload, headers or None,
+                deadline, stream_cb,
+            )
+        else:
+            self._pool.submit(
+                self._run_unary, rid, future, payload, headers or None,
+                deadline, stream_cb,
+            )
         return future
 
+    def _run_unary(self, rid: int, future: Any, payload: dict,
+                   headers: dict | None, deadline: float | None,
+                   stream_cb: Any) -> None:
+        from gofr_tpu.serving.remote import error_from_status
+
+        try:
+            resp = self._svc.post(
+                "/generate", json=payload, headers=headers,
+                timeout=deadline,
+            )
+            if not resp.ok:
+                raise error_from_status(
+                    resp.status_code,
+                    f"replica {self.replica_id}: HTTP {resp.status_code}",
+                    resp.headers,
+                )
+            body = resp.json()
+            data = body.get("data") or body
+            if stream_cb is not None:
+                stream_cb(0, data.get("text", ""), False)
+                stream_cb(0, "", True)
+            future.set_result(_RemoteResult(rid, data))
+        # gofrlint: disable=router-retry-untyped -- settles the future
+        # with the error (no retry happens here); a narrow catch would
+        # strand the client future forever on an unexpected failure
+        except BaseException as exc:
+            if isinstance(exc, OSError) and not isinstance(
+                exc, ConnectionError
+            ):
+                exc = ConnectionError(str(exc))
+            future.set_exception(exc)
+
+    def _run_stream(self, rid: int, future: Any, payload: dict,
+                    headers: dict | None, deadline: float | None,
+                    stream_cb: Any) -> None:
+        """One remote streaming generation, on a pool worker (the frame
+        reads block by design — never the event loop). Mirrors the
+        engine's settlement contract: tokens stream, the done frame
+        fires, THEN the future resolves; failures settle the future
+        FIRST (the router's claim guard reads that ordering)."""
+        from gofr_tpu.serving.remote import run_stream
+
+        state: dict[str, Any] = {
+            "ids": [], "pieces": [], "first_ms": 0.0, "t0": time.monotonic(),
+        }
+
+        def on_id(remote_id: int) -> None:
+            fire = False
+            with self._rid_mu:
+                self._remote_ids[rid] = remote_id
+                if rid in self._cancel_early:
+                    self._cancel_early.discard(rid)
+                    fire = True
+            if fire:
+                self._post_cancel(remote_id)
+
+        def on_token(token_id: int, text: str) -> None:
+            if not state["ids"]:
+                state["first_ms"] = (
+                    time.monotonic() - state["t0"]
+                ) * 1e3
+            state["ids"].append(token_id)
+            state["pieces"].append(text)
+            stream_cb(token_id, text, False)
+
+        try:
+            terminal = run_stream(
+                self._svc, payload, headers=headers, timeout=deadline,
+                on_id=on_id, on_token=on_token,
+            )
+            data = dict(terminal)
+            usage = dict(data.get("usage") or {})
+            # the replica reports prompt/completion; TTFT as OBSERVED
+            # through this transport is what the router's hedge floor
+            # must key on. The token stream itself is the result body —
+            # rebuild it so a streamed remote result carries the same
+            # token_ids/text a unary one does.
+            usage.setdefault("ttft_ms", round(state["first_ms"], 3))
+            data["usage"] = usage
+            data.setdefault("token_ids", list(state["ids"]))
+            data.setdefault("text", "".join(state["pieces"]))
+            stream_cb(-1, "", True)
+            future.set_result(_RemoteResult(rid, data))
+        # gofrlint: disable=router-retry-untyped -- settles the future
+        # with the error (no retry happens here); a narrow catch would
+        # strand the client future forever on an unexpected failure
+        except BaseException as exc:
+            if isinstance(exc, OSError) and not isinstance(
+                exc, ConnectionError
+            ):
+                exc = ConnectionError(str(exc))
+            future.set_exception(exc)
+            # trailing done frame AFTER the failed settlement, mirroring
+            # ServingEngine._settle_future — the router's claim guard
+            # refuses terminal frames of already-failed attempts
+            try:
+                stream_cb(-1, "", True)
+            # gofrlint: disable=router-retry-untyped -- no retry here: a
+            # client callback failing on the courtesy done frame must not
+            # mask the already-settled transport error
+            except Exception:
+                pass
+        finally:
+            with self._rid_mu:
+                self._remote_ids.pop(rid, None)
+                self._cancel_early.discard(rid)
+
+    def _post_cancel(self, remote_id: int) -> None:
+        from gofr_tpu.serving.remote import CANCEL_PATH
+
+        try:
+            self._svc.post(CANCEL_PATH, json={"id": remote_id}, timeout=2.0)
+        except Exception:
+            pass  # the replica may be gone; its supervisor reclaims
+
     def cancel(self, request_id: int) -> None:
-        pass  # no remote cancel wire yet; the deadline bounds the work
+        """The remote cancel wire: POST the replica's own request id (from
+        the stream's id frame) to ``/generate/cancel`` — the engine
+        retires the row at the next block sync, so a canceled hedge twin
+        stops burning decode steps within one block instead of running to
+        completion. A cancel racing the id frame parks and fires when the
+        frame lands; unary submissions have no wire to cancel (bounded by
+        their deadline, as before)."""
+        with self._rid_mu:
+            if request_id not in self._remote_ids:
+                return  # unary, already finished, or never streamed
+            remote_id = self._remote_ids.get(request_id)
+            if remote_id is None:
+                self._cancel_early.add(request_id)
+                return
+        self._post_cancel(remote_id)
 
     def fetch_kv(self, keys: list[str],
                  timeout: float = 2.0) -> dict[str, tuple]:
@@ -384,6 +511,15 @@ class _RouterRequest:
         self.failovers = 0
         self.hedge_timer: threading.Timer | None = None
         self.canceled = False
+        # disaggregation (docs/robustness.md "The disaggregation plane"):
+        # the role this request's GENERATION attempts must route to
+        # (decode when the tier is role-split, None for unified routing —
+        # failover and hedge re-walks read it so a re-route can never
+        # land generation work on a prefill-only replica), and the live
+        # prefill-phase attempt (replica_id, future, span) so cancel
+        # reaches a handoff in flight
+        self.phase_role: str | None = None
+        self.prefill_attempt: tuple[str, Any, Any] | None = None
 
     def remaining(self) -> float | None:
         if self.deadline_abs is None:
@@ -421,8 +557,13 @@ class Router:
         self.prefix_index = PrefixIndex()
         self._handles: dict[str, Any] = {}
         self._handles_mu = threading.Lock()
-        self._ring: _HashRing | None = None
-        self._ring_ids: tuple[str, ...] = ()
+        # hash rings cached per candidate-set (sorted id tuple): a
+        # disaggregated tier alternates prefill-pool and decode-pool
+        # walks every request — a single-slot cache would rebuild the
+        # 64-vnode ring twice per request, forever. Bounded (the
+        # distinct routable sets of a tier are few); cleared on
+        # membership-shape changes.
+        self._rings: dict[tuple[str, ...], _HashRing] = {}
         self._requests: dict[int, _RouterRequest] = {}
         self._req_mu = threading.Lock()
         self._next_rid = 0
@@ -448,6 +589,8 @@ class Router:
         self.hedges_total = 0
         self.spills_total = 0
         self.no_replica_total = 0
+        self.handoffs_total = 0           # prefill→decode KV handoffs hinted
+        self.handoff_degraded_total = 0   # handoffs degraded to re-prefill
         self.routes_by_replica: dict[str, int] = {}
 
     # -- provider pattern (lets the container own the router) ------------------
@@ -464,18 +607,23 @@ class Router:
         pass
 
     # -- replica management ----------------------------------------------------
-    def add_replica(self, handle: Any) -> None:
+    def add_replica(self, handle: Any, role: str | None = None) -> None:
         """Register a replica handle (LocalReplica / HTTPReplica). The
-        replica stays SUSPECT until its first heartbeat lands."""
+        replica stays SUSPECT until its first heartbeat lands. ``role``
+        (or the handle's own ``role`` attribute) seeds its disaggregation
+        role; the replica's heartbeats are authoritative after that."""
         with self._handles_mu:
             self._handles[handle.replica_id] = handle
-            self._ring = None  # rebuilt lazily against the new set
-        self.membership.register(handle.replica_id)
+            self._rings.clear()  # rebuilt lazily against the new set
+        self.membership.register(
+            handle.replica_id,
+            role or getattr(handle, "role", None) or ms.ROLE_UNIFIED,
+        )
 
     def remove_replica(self, replica_id: str) -> None:
         with self._handles_mu:
             self._handles.pop(replica_id, None)
-            self._ring = None
+            self._rings.clear()
         self.membership.forget(replica_id)
         self.prefix_index.drop_replica(replica_id)
 
@@ -492,10 +640,13 @@ class Router:
     def _ring_for(self, ids: list[str]) -> _HashRing:
         key = tuple(sorted(ids))
         with self._handles_mu:
-            if self._ring is None or self._ring_ids != key:
-                self._ring = _HashRing(list(key), self.config.vnodes)
-                self._ring_ids = key
-            return self._ring
+            ring = self._rings.get(key)
+            if ring is None:
+                if len(self._rings) >= 16:  # candidate-set churn bound
+                    self._rings.clear()
+                ring = _HashRing(list(key), self.config.vnodes)
+                self._rings[key] = ring
+            return ring
 
     # -- lifecycle -------------------------------------------------------------
     def start(self) -> None:
@@ -581,12 +732,16 @@ class Router:
         )
 
     # -- routing ---------------------------------------------------------------
-    def _candidates_for(self, prompt: Any) -> tuple[list[str], bool]:
+    def _candidates_for(self, prompt: Any,
+                        role: str | None = None) -> tuple[list[str], bool]:
         """Ordered candidate replicas for a new request: the prefix-
         affine replica first (when healthy and under the spill bound),
         then every other routable replica by least estimated wait.
+        ``role`` restricts the pool to one disaggregation phase (the
+        affinity ring is built over that pool, so shared prefixes keep
+        landing on the same prefill replica's chunk cache).
         Returns (candidates, spilled)."""
-        routable = self.membership.candidates()
+        routable = self.membership.candidates(role=role)
         if not routable:
             return [], False
         key = prefix_affinity_key(prompt, self.config.affinity_prefix_tokens)
@@ -640,6 +795,13 @@ class Router:
             rid, prompt, dict(kw), stream_cb, deadline_abs,
             trace_ctx=trace_ctx if trace_ctx is not None else current_span(),
         )
+        # disaggregated tier (a prefill pool AND a decode pool are both
+        # live): the request splits into a prefill phase + KV handoff +
+        # decode phase — a separate path because the prefill future must
+        # be awaited asynchronously, never on this caller thread
+        present = self.membership.roles_present()
+        if ms.ROLE_PREFILL in present and ms.ROLE_DECODE in present:
+            return self._submit_disagg(req)
         candidates, spilled = self._candidates_for(prompt)
         if not candidates:
             with self._stats_mu:
@@ -682,12 +844,63 @@ class Router:
                 with self._req_mu:
                     self._requests.pop(rid, None)
 
-    def _submit_attempt(self, req: _RouterRequest, replica_id: str,
-                        kind: str = "primary") -> Any:
-        """One submission to one replica. Raises the replica's admission
-        error; the callers decide whether it is retriable (submit's
-        candidate loop / the failover path). ``kind`` annotates the
-        attempt span: primary, failover, or hedge."""
+    # -- disaggregated prefill/decode routing ----------------------------------
+    def _submit_disagg(self, req: _RouterRequest) -> Any:
+        """Two-phase routing for a role-split tier (ROADMAP item 2,
+        AIBrix arXiv:2504.03648): admit the prompt on a PREFILL replica
+        with ``prefill_only`` (it computes the prompt KV into its prefix
+        cache and retires — no decode slots burned), then, when the
+        prefill future settles, admit the generation on a DECODE replica
+        with ``handoff_from`` naming the prefill source — the decode
+        engine pulls the KV chain over the PR 11 transfer machinery
+        under the two-phase-commit handoff discipline (kv.handoff).
+
+        Crash-safety is the headline invariant: EVERY failure in the
+        prefill phase — no prefill candidate, admission refused, the
+        source dying mid-prefill — degrades to the decode phase without
+        a handoff hint, where the decode replica simply re-prefills
+        (roles are policy, not capability). The client future settles
+        exactly once either way."""
+        req.phase_role = ms.ROLE_DECODE  # generation attempts (primary,
+        # failover, hedge) must never land on a prefill-only replica
+        with self._req_mu:
+            self._requests[req.rid] = req
+        registered = True
+        try:
+            candidates, _ = self._candidates_for(
+                req.prompt, role=ms.ROLE_PREFILL
+            )
+            prefill_fut = None
+            for replica_id in candidates:
+                try:
+                    prefill_fut = self._prefill_attempt(req, replica_id)
+                except RETRIABLE_ERRORS:
+                    continue
+                break
+            if prefill_fut is None:
+                # no prefill replica would take it: the decode pool
+                # serves the whole generation (one replica, no handoff)
+                self._degrade_handoff(req, "no-prefill-candidate")
+                self._failover_pool.submit(self._decode_phase, req)
+            registered = False  # a phase now owns the request
+            return req.future
+        finally:
+            if registered:
+                # nothing owns this request (the prefill walk raised
+                # non-retriably, or the pool rejected the phase task):
+                # unregister before the raise reaches the caller
+                with self._req_mu:
+                    self._requests.pop(req.rid, None)
+
+    def _open_attempt(
+        self, req: _RouterRequest, replica_id: str, kind: str
+    ) -> tuple[Any, Any, float | None]:
+        """The admission prologue shared by EVERY attempt kind (primary/
+        failover/hedge/prefill): deadline gate, handle lookup, the
+        ``router.route`` chaos seam and the attempt span. One
+        implementation so the two attempt paths cannot drift. Returns
+        (handle, span, remaining_deadline); raises the typed admission
+        errors."""
         remaining = req.remaining()
         if remaining is not None and remaining <= 0:
             raise ErrorDeadlineExceeded(
@@ -709,6 +922,154 @@ class Router:
             span.set_attribute("request.id", req.rid)
             span.set_attribute("replica.id", replica_id)
             span.set_attribute("attempt.kind", kind)
+        return handle, span, remaining
+
+    def _count_route(self, replica_id: str) -> None:
+        with self._stats_mu:
+            self.routed_total += 1
+            self.routes_by_replica[replica_id] = (
+                self.routes_by_replica.get(replica_id, 0) + 1
+            )
+
+    def _prefill_attempt(self, req: _RouterRequest, replica_id: str) -> Any:
+        """Admit the prefill phase on one prefill replica. Raises the
+        replica's admission error (the caller's candidate walk decides);
+        once admitted, the settlement callback drives the decode phase."""
+        handle, span, remaining = self._open_attempt(req, replica_id, "prefill")
+        submitted = False
+        try:
+            kw = {
+                k: v for k, v in req.kw.items()
+                if k in ("temperature", "top_k", "top_p", "priority")
+            }
+            prefill_fut = handle.submit(
+                req.prompt, deadline=remaining, prefill_only=True,
+                max_new_tokens=1,
+                trace_ctx=span if span is not None else req.trace_ctx,
+                **kw,
+            )
+            submitted = True
+        finally:
+            if not submitted and span is not None:
+                span.set_attribute("attempt.outcome", "admission-failed")
+                span.end()
+        with req.mu:
+            req.tried.append(replica_id)
+            req.prefill_attempt = (replica_id, prefill_fut, span)
+        self._count_route(replica_id)
+        prefill_fut.add_done_callback(
+            lambda f: self._on_prefill_done(req, replica_id, f)
+        )
+        return prefill_fut
+
+    def _on_prefill_done(self, req: _RouterRequest, replica_id: str,
+                         prefill_fut: Any) -> None:
+        """The prefill phase settled (on the prefill replica's settlement
+        thread): record the outcome, then hand the decode phase to the
+        failover pool — never submit into a replica from a settlement
+        callback."""
+        exc = prefill_fut.exception()
+        with req.mu:
+            attempt = req.prefill_attempt
+            span = attempt[2] if attempt is not None else None
+            req.prefill_attempt = None
+        if span is not None:
+            span.set_attribute(
+                "attempt.outcome",
+                "ok" if exc is None else f"failed:{type(exc).__name__}",
+            )
+            span.end()
+        if req.future.done():
+            return  # canceled / expired while prefilling: settled already
+        if req.canceled:
+            # canceled during the prefill phase: the decode phase must
+            # never run (it would serve a full generation the client
+            # already walked away from). Mirror the unified path's
+            # semantics — the client future settles with the cancel
+            # result; a result still labeled "handoff" (cancel raced the
+            # prefill's completion) is relabeled, it must not leak the
+            # internal phase marker.
+            if exc is None:
+                result = prefill_fut.result()
+                try:
+                    if getattr(result, "finish_reason", None) == "handoff":
+                        result.finish_reason = "cancel"
+                except Exception:
+                    pass  # frozen result types settle unlabeled
+                self._settle(req, result=result, replica_id=replica_id)
+            else:
+                self._settle(req, error=exc, replica_id=replica_id)
+            return
+        if exc is None:
+            # the handoff hint: the decode replica pulls the KV chain
+            # directly from this source (no heartbeat-advertisement wait)
+            req.kw["handoff_from"] = replica_id
+            with self._stats_mu:
+                self.handoffs_total += 1
+        else:
+            # source died mid-prefill (or refused late): the decode
+            # replica re-prefills from the prompt — degraded, never lost
+            self._degrade_handoff(req, f"prefill-failed:{type(exc).__name__}")
+        try:
+            self._failover_pool.submit(self._decode_phase, req)
+        except RuntimeError:
+            self._settle(req, error=ErrorServiceUnavailable(
+                "router stopped during handoff; retry", retry_after=1.0,
+            ), replica_id=None)
+
+    def _degrade_handoff(self, req: _RouterRequest, reason: str) -> None:
+        req.kw.pop("handoff_from", None)
+        with self._stats_mu:
+            self.handoff_degraded_total += 1
+        if self._logger is not None:
+            self._logger.debug(
+                f"request {req.rid}: handoff degraded to re-prefill ({reason})"
+            )
+
+    def _decode_phase(self, req: _RouterRequest) -> None:
+        """Admit the generation on the decode pool (runs on the failover
+        pool). Mirrors submit's candidate walk; every exit settles the
+        client future or hands ownership to the attempt machinery."""
+        try:
+            if req.future.done():
+                return
+            candidates, _ = self._candidates_for(
+                req.prompt, role=req.phase_role
+            )
+            with req.mu:
+                tried = set(req.tried)
+            # prefer untried decode replicas, but a handoff source that
+            # is ALSO the only decode candidate may serve (tried only
+            # covers this request's prefill walk, not failures)
+            ordered = [c for c in candidates if c not in tried] or candidates
+            last_error: Exception = ErrorServiceUnavailable(
+                "no routable decode replica", retry_after=self.config.heartbeat_s,
+            )
+            for replica_id in ordered:
+                try:
+                    self._submit_attempt(req, replica_id)
+                except RETRIABLE_ERRORS as exc:
+                    last_error = exc
+                    continue
+                except ErrorDeadlineExceeded as exc:
+                    self._settle(req, error=exc, replica_id=None)
+                    return
+                self._arm_hedge(req)
+                return
+            self._settle(req, error=last_error, replica_id=None)
+        # gofrlint: disable=router-retry-untyped -- no retry happens here:
+        # an unexpected raise would vanish into the failover pool and
+        # strand the client future forever; settle it instead
+        except BaseException as exc:
+            self._settle(req, error=exc, replica_id=None)
+
+    def _submit_attempt(self, req: _RouterRequest, replica_id: str,
+                        kind: str = "primary") -> Any:
+        """One submission to one replica. Raises the replica's admission
+        error; the callers decide whether it is retriable (submit's
+        candidate loop / the failover path). ``kind`` annotates the
+        attempt span: primary, failover, or hedge."""
+        handle, span, remaining = self._open_attempt(req, replica_id, kind)
         cb = self._attempt_cb(req, replica_id)
         submitted = False
         try:
@@ -730,11 +1091,15 @@ class Router:
             req.live[replica_id] = replica_future
             if span is not None:
                 req.spans[replica_id] = span
-        with self._stats_mu:
-            self.routed_total += 1
-            self.routes_by_replica[replica_id] = (
-                self.routes_by_replica.get(replica_id, 0) + 1
-            )
+        self._count_route(replica_id)
+        if req.canceled:
+            # a cancel that landed in the async gap before this attempt
+            # registered (the disaggregated decode phase runs off the
+            # prefill settlement, after the caller already holds the
+            # rid): nothing was live to cancel then — cancel NOW, and
+            # the replica's cancel contract settles the attempt with the
+            # cancel result through the normal done-callback
+            self._cancel_attempt(replica_id, replica_future)
         replica_future.add_done_callback(
             lambda f: self._on_attempt_done(req, replica_id, f)
         )
@@ -823,6 +1188,13 @@ class Router:
             self._settle(req, result=result, replica_id=replica_id)
             return
         # failed attempt —
+        if req.future.done():
+            # the request already concluded — the winner settled the
+            # client future (or cancel/deadline did). A canceled hedge
+            # twin's transport failing AFTER that is bookkeeping, not a
+            # failover: it must bump no counter and schedule no re-route
+            # (its span was ended above — nothing leaks).
+            return
         if winner == replica_id:
             # the client-visible stream died mid-flight: this attempt
             # claimed the stream (tokens crossed the wire), so a silent
@@ -869,7 +1241,10 @@ class Router:
         (a replica that just failed this request does not get it back
         before the untried ones)."""
         try:
-            candidates, _ = self._candidates_for(req.prompt)
+            # phase_role restricts the re-route to the decode pool on a
+            # disaggregated tier: a failover must never land generation
+            # work on a prefill-only replica
+            candidates, _ = self._candidates_for(req.prompt, role=req.phase_role)
             with req.mu:
                 tried = set(req.tried)
             ordered = [c for c in candidates if c not in tried] or candidates
@@ -962,7 +1337,7 @@ class Router:
             ):
                 return
             tried = set(req.tried)
-        candidates, _ = self._candidates_for(req.prompt)
+        candidates, _ = self._candidates_for(req.prompt, role=req.phase_role)
         for replica_id in candidates:
             if replica_id in tried:
                 continue
@@ -993,8 +1368,19 @@ class Router:
             req.live = {}
             stray_spans = list(req.spans.values())
             req.spans = {}
+            prefill_attempt = req.prefill_attempt
+            req.prefill_attempt = None
         if timer is not None:
             timer.cancel()
+        if prefill_attempt is not None:
+            # a handoff prefill still in flight when the request settles
+            # (canceled / expired): stop it burning prefill compute, and
+            # end its span (its done-callback no-ops once the future is
+            # settled here)
+            prid, pfut, pspan = prefill_attempt
+            self._cancel_attempt(prid, pfut)
+            if pspan is not None:
+                pspan.end()
         for span in stray_spans:
             # normally ended by each attempt's done-callback; a handle
             # whose future never settles must not leak its span
@@ -1022,8 +1408,11 @@ class Router:
         with req.mu:
             req.canceled = True
             live = list(req.live.items())
+            prefill_attempt = req.prefill_attempt
         for replica_id, replica_future in live:
             self._cancel_attempt(replica_id, replica_future)
+        if prefill_attempt is not None:
+            self._cancel_attempt(prefill_attempt[0], prefill_attempt[1])
 
     # -- observability ---------------------------------------------------------
     def health_check(self) -> dict[str, Any]:
@@ -1057,6 +1446,8 @@ class Router:
                 "hedges_total": self.hedges_total,
                 "spills_total": self.spills_total,
                 "no_replica_total": self.no_replica_total,
+                "handoffs_total": self.handoffs_total,
+                "handoff_degraded_total": self.handoff_degraded_total,
                 "routes_by_replica": dict(self.routes_by_replica),
             }
 
@@ -1067,6 +1458,7 @@ class Router:
         return {
             "replicas": self.membership.snapshot(),
             "routable": self.membership.candidates(),
+            "roles_present": sorted(self.membership.roles_present()),
             "aggregate_queue_wait_s": round(
                 self.membership.aggregate_queue_wait(), 4
             ),

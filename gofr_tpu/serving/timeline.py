@@ -234,12 +234,40 @@ class TimelineRecorder:
     """The flight recorder: all in-flight timelines plus a bounded ring
     of the last ``capacity`` completed ones."""
 
+    # bounded prefix-reuse observation map (the spill tier's demotion
+    # scorer reads it): far larger than any prefix cache so a hot key's
+    # count survives its slabs moving between tiers
+    REUSE_KEYS = 4096
+
     def __init__(self, capacity: int = 256) -> None:
         self._mu = threading.Lock()
         self._inflight: dict[int, RequestTimeline] = {}
         self._done: collections.deque[RequestTimeline] = collections.deque(
             maxlen=max(1, int(capacity))
         )
+        # prefix-cache key -> observed reuse count, LRU-bounded. Fed by
+        # the engine's admission-time cache hits; consumed by the spill
+        # tier's demotion policy (serving/kv_spill.py) — a prefix the
+        # timelines show being reused must outlive a one-shot prefix
+        # under host-RAM byte pressure, whatever the raw LRU order says.
+        self._reuse: "collections.OrderedDict[Any, int]" = (
+            collections.OrderedDict()
+        )
+
+    def observe_prefix_reuse(self, key: Any) -> None:
+        """Record one admission-time hit on a prefix-cache key (engine
+        thread; one dict write under the leaf lock)."""
+        with self._mu:
+            self._reuse[key] = self._reuse.get(key, 0) + 1
+            self._reuse.move_to_end(key)
+            while len(self._reuse) > self.REUSE_KEYS:
+                self._reuse.popitem(last=False)
+
+    def reuse_count(self, key: Any) -> int:
+        """Observed reuse score for a prefix-cache key (0 = never seen
+        re-used) — the spill tier's demotion ordering signal."""
+        with self._mu:
+            return self._reuse.get(key, 0)
 
     def begin(self, request_id: int, prompt_tokens: int = 0,
               trace_id: str | None = None) -> RequestTimeline:

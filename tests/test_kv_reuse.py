@@ -554,3 +554,59 @@ def test_migrator_backs_off_a_failing_peer():
     migrator.add_peer("A", lambda keys: {})
     migrator.fetch_chain([(0, 16, "c0")])
     assert "A" not in migrator._suppressed_until
+
+
+def test_reuse_scored_demotion_hot_prefix_outlives_cold():
+    """ISSUE 14 satellite: spill-tier demotion orders by the
+    timeline-observed reuse score, not raw LRU — under byte pressure a
+    hot prefix's slabs outlive a one-shot prefix's even when the
+    one-shot was touched more recently."""
+    from gofr_tpu.serving.kv_spill import HostSpillTier
+    from gofr_tpu.serving.timeline import TimelineRecorder
+
+    rec = TimelineRecorder()
+    for _ in range(5):
+        rec.observe_prefix_reuse("hot")
+    assert rec.reuse_count("hot") == 5 and rec.reuse_count("cold") == 0
+
+    def val(x):
+        return (np.full((10, 10), float(x)),)  # 800 bytes/entry
+
+    scored = HostSpillTier(max_bytes=3 * 800, score=rec.reuse_count)
+    scored.put("hot", val(1))       # oldest in raw LRU order
+    scored.put("cold1", val(2))
+    scored.put("cold2", val(3))
+    scored.put("cold3", val(4))     # byte pressure: one entry must go
+    assert "hot" in scored.keys()   # the hot prefix survived
+    assert len(scored.keys()) == 3
+    # control: an unscored tier evicts by raw LRU and loses the hot one
+    lru = HostSpillTier(max_bytes=3 * 800)
+    lru.put("hot", val(1))
+    lru.put("cold1", val(2))
+    lru.put("cold2", val(3))
+    lru.put("cold3", val(4))
+    assert "hot" not in lru.keys()
+
+
+def test_tiered_cache_wires_reuse_score_through(engine_setup):
+    """The engine wires the recorder's reuse counts into the tiered
+    cache: admission-time hits feed the scorer."""
+    cfg, params = engine_setup
+    eng = make_engine(cfg, params, kv_spill_bytes=1 << 22)
+    eng.start()
+    try:
+        prompt = "reuse scored prompt " * 3
+        eng.submit(prompt, max_new_tokens=2, temperature=0.0).result(timeout=300)
+        assert all(
+            eng.timeline.reuse_count(k) == 0
+            for k, _t in eng.prefix_advertisement()
+        )
+        eng.submit(prompt, max_new_tokens=2, temperature=0.0).result(timeout=300)
+        # the second admission HIT the cached chunk chain: every boundary
+        # key it walked is now observed as reused
+        assert any(
+            eng.timeline.reuse_count(k) > 0
+            for k, _t in eng.prefix_advertisement()
+        )
+    finally:
+        eng.stop()

@@ -661,3 +661,211 @@ def test_http_replica_maps_transport_failure_to_retriable():
     assert exc is not None
     assert isinstance(exc, RETRIABLE_ERRORS)
     replica.close()
+
+
+# ------------------------------------------------- disaggregation roles
+
+
+def test_role_rides_heartbeat_and_transitions():
+    """A replica's role arrives on its beats and a pool driver
+    repurposing it re-routes the tier within one heartbeat."""
+    t = MembershipTable()
+    t.observe(Heartbeat("r1", 1, role="prefill"), now=0.0)
+    assert t.role_of("r1") == "prefill"
+    assert t.candidates(now=0.1, role="prefill") == ["r1"]
+    assert t.candidates(now=0.1, role="decode") == []
+    # repurposed: the next beat flips the role
+    t.observe(Heartbeat("r1", 2, role="decode"), now=0.2)
+    assert t.role_of("r1") == "decode"
+    assert t.candidates(now=0.3, role="prefill") == []
+    assert t.candidates(now=0.3, role="decode") == ["r1"]
+    # an unknown role string (a newer announcer this router predates)
+    # keeps the last known role instead of un-routing the replica
+    t.observe(Heartbeat("r1", 3, role="shiny-new-phase"), now=0.4)
+    assert t.role_of("r1") == "decode"
+
+
+def test_role_mismatch_rejected_at_candidate_assembly():
+    """A prefill specialist never receives generation work, a decode
+    specialist never receives the prefill phase — and unified replicas
+    serve either. The whole-generation pool (role=None) excludes prefill
+    specialists but keeps decode ones: role is policy, not capability,
+    and the degrade path re-prefills on a decode replica."""
+    t = MembershipTable()
+    t.observe(Heartbeat("p1", 1, role="prefill"), now=0.0)
+    t.observe(Heartbeat("d1", 1, role="decode"), now=0.0)
+    t.observe(Heartbeat("u1", 1, role="unified"), now=0.0)
+    assert set(t.candidates(now=0.1, role="prefill")) == {"p1", "u1"}
+    assert set(t.candidates(now=0.1, role="decode")) == {"d1", "u1"}
+    assert set(t.candidates(now=0.1)) == {"d1", "u1"}
+    assert t.roles_present(now=0.1) == {"prefill", "decode", "unified"}
+
+
+def test_registration_role_seed_until_first_beat():
+    """add_replica's role seeds membership (the router can route before
+    the first beat lands — SUSPECT last-resort), and the replica's own
+    heartbeat is authoritative after that."""
+    stub = StubReplicaEngine("p1")
+    router = Router(RouterConfig(heartbeat_s=0.05))
+    router.add_replica(LocalReplica("p1", stub, role="prefill"))
+    assert router.membership.role_of("p1") == "prefill"
+    assert router.membership.candidates(role="prefill") == ["p1"]
+    # the beat says unified: the replica's own view wins
+    router.membership.observe(Heartbeat("p1", 1, role="unified"))
+    assert router.membership.role_of("p1") == "unified"
+    router.stop()
+
+
+def test_routerz_surfaces_roles():
+    stub_p = StubReplicaEngine("p1")
+    stub_d = StubReplicaEngine("d1")
+    router = Router(RouterConfig(heartbeat_s=0.05))
+    router.add_replica(LocalReplica("p1", stub_p, role="prefill"))
+    router.add_replica(LocalReplica("d1", stub_d, role="decode"))
+    router.membership.observe(Heartbeat("p1", 1, role="prefill"))
+    router.membership.observe(Heartbeat("d1", 1, role="decode"))
+    view = router.routerz()
+    assert view["replicas"]["p1"]["role"] == "prefill"
+    assert view["replicas"]["d1"]["role"] == "decode"
+    assert view["roles_present"] == ["decode", "prefill"]
+    assert "handoffs_total" in view["counters"]
+    router.stop()
+
+
+def test_announcer_carries_engine_role():
+    """ReplicaAnnouncer reads the engine's declared role (explicit param
+    outranks it) and stamps every beat."""
+    stub = StubReplicaEngine("r1")
+    stub.role = "decode"
+    ann = ReplicaAnnouncer("r1", stub, publisher=None)
+    assert ann.compose().role == "decode"
+    ann2 = ReplicaAnnouncer("r1", stub, publisher=None, role="prefill")
+    assert ann2.compose().role == "prefill"
+
+
+def test_per_role_aggregate_queue_wait():
+    """The autoscaler's per-pool signal: a prefill backlog must not read
+    as decode pressure."""
+    t = MembershipTable()
+    t.observe(Heartbeat("p1", 1, role="prefill", queue_wait_s=4.0))
+    t.observe(Heartbeat("d1", 1, role="decode", queue_wait_s=0.0))
+    assert t.aggregate_queue_wait("prefill") == pytest.approx(4.0)
+    assert t.aggregate_queue_wait("decode") == pytest.approx(0.0)
+    assert t.aggregate_queue_wait() == pytest.approx(2.0)
+    # and the HBM floor signal
+    t.observe(Heartbeat("d1", 2, role="decode", hbm_free_frac=0.02))
+    assert t.min_hbm_headroom("decode") == pytest.approx(0.02)
+    assert t.min_hbm_headroom("prefill") is None
+
+
+def test_draining_during_scale_down_gets_zero_new_routes():
+    """The autoscaler's scale-down path: begin_drain flips the victim
+    DRAINING (its final beat reaches the router) — in-flight streams
+    finish, zero new routes land on it, and the reap waits for idle."""
+    from gofr_tpu.serving.autoscaler import SimulatedPoolDriver
+
+    router = Router(RouterConfig(heartbeat_s=0.05))
+    made = {}
+
+    def factory(role, rid):
+        stub = StubReplicaEngine(rid, tokens=4, token_interval_s=0.02)
+        made[rid] = stub
+        return LocalReplica(rid, stub, role=role)
+
+    driver = SimulatedPoolDriver(router, factory)
+    a_id, b_id = driver.scale_up("unified", 2)
+    for rid in (a_id, b_id):
+        router.membership.observe(Heartbeat(rid, 1))
+    # a stream in flight on the victim
+    stream: list = []
+    fut = made[a_id].submit(
+        "held", max_new_tokens=4,
+        stream_cb=lambda t_, p, d: stream.append((t_, d)),
+    )
+    driver.begin_drain(a_id)
+    router.membership.observe(Heartbeat(a_id, 2, state=DRAINING))
+    # zero new routes to the draining victim
+    assert router.membership.candidates() == [b_id]
+    # the reap refuses while the stream runs, then succeeds once idle
+    deadline = time.monotonic() + 5.0
+    reaped = False
+    while time.monotonic() < deadline and not reaped:
+        reaped = driver.reap(a_id)
+        time.sleep(0.02)
+    assert reaped
+    result = fut.result(timeout=5)
+    assert result.finish_reason == "length"  # drained, never killed
+    assert a_id not in router.membership.candidates()
+    router.stop()
+
+
+# ------------------------------------------------- hedge accounting
+
+
+def test_canceled_hedge_twin_failure_after_settle_is_not_a_failover():
+    """ISSUE 14 satellite regression: a hedge twin canceled pre-stream
+    whose transport then fails (the remote streaming cancel path tears
+    the connection) must not increment failovers_total, schedule a
+    re-route, or leave an open router.attempt span once the winner has
+    settled the request."""
+    import concurrent.futures
+
+    from gofr_tpu.tracing import Tracer
+
+    class ManualHandle:
+        def __init__(self, rid):
+            self.replica_id = rid
+            self.futures = []
+            self.cancels = []
+
+        def submit(self, prompt, **kw):
+            fut = concurrent.futures.Future()
+            fut.request_id = len(self.futures) + 1
+            self.futures.append((fut, kw))
+            return fut
+
+        def cancel(self, request_id):
+            self.cancels.append(request_id)
+
+        def health_check(self):
+            return {"status": UP, "details": {}}
+
+    tracer = Tracer("hedge-acct")  # no processor: open/close accounting
+    router = Router(RouterConfig(heartbeat_s=0.05), tracer=tracer)
+    a, b = ManualHandle("a"), ManualHandle("b")
+    router.add_replica(a)
+    router.add_replica(b)
+    router.membership.observe(Heartbeat("a", 1))
+    router.membership.observe(Heartbeat("b", 1))
+    try:
+        tokens = []
+        fut = router.submit(
+            "prompt", stream_cb=lambda t_, p, d: tokens.append((t_, d)),
+        )
+        with router._req_mu:
+            req = router._requests[fut.request_id]
+        primary = req.tried[0]
+        twin = "b" if primary == "a" else "a"
+        handles = {"a": a, "b": b}
+        # the hedge twin admits, then the primary streams + settles
+        router._submit_attempt(req, twin, kind="hedge")
+        pfut, pkw = handles[primary].futures[0]
+        pkw["stream_cb"](7, "tok", False)       # primary claims the stream
+        assert handles[twin].cancels, "loser must be canceled pre-stream"
+
+        class _R:
+            finish_reason = "stop"
+
+        pfut.set_result(_R())
+        assert fut.result(timeout=5).finish_reason == "stop"
+        before = router.failovers_total
+        # NOW the canceled twin's transport tears (streaming cancel path)
+        tfut, _ = handles[twin].futures[0]
+        tfut.set_exception(ConnectionError("canceled stream torn"))
+        time.sleep(0.05)  # any (wrong) failover would be scheduled async
+        assert router.failovers_total == before == 0
+        assert tracer.open_spans() == 0, "router.attempt span leaked"
+        with router._req_mu:
+            assert fut.request_id not in router._requests
+    finally:
+        router.stop()

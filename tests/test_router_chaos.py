@@ -465,3 +465,121 @@ def test_migration_interrupted_degrades_to_reprefill(seed):
     finally:
         source.stop()
         admitting.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_scale_down_during_active_streams_drains_not_kills(seed):
+    """The autoscaler's scale-down invariant under chaos (ISSUE 14):
+    scale-downs fired while streams are in flight must DRAIN their
+    victims — every accepted request completes (zero lost, zero
+    failed-retriable terminals from a kill), no double settlement, and
+    the pool still shrinks. The ``scale.decision`` chaos point fires
+    through the run: a faulted control round degrades to no action,
+    never to a kill."""
+    from gofr_tpu.chaos.injector import ChaosInjector
+    from gofr_tpu.serving.autoscaler import (
+        Autoscaler,
+        AutoscalerConfig,
+        SimulatedPoolDriver,
+    )
+
+    broker = InMemoryBroker(consumer_group="router")
+    router = Router(
+        RouterConfig(
+            heartbeat_s=HEARTBEAT_S,
+            suspect_after_s=6 * HEARTBEAT_S,
+            down_after_s=30 * HEARTBEAT_S,
+            max_failovers=3,
+        ),
+        broker=broker,
+    )
+    stubs: dict[str, StubReplicaEngine] = {}
+    announcers: dict[str, ReplicaAnnouncer] = {}
+
+    def factory(role, rid):
+        stub = StubReplicaEngine(
+            rid, tokens=8, token_interval_s=0.01, first_token_delay_s=0.005,
+        )
+        stubs[rid] = stub
+        ann = ReplicaAnnouncer(rid, stub, broker, interval_s=HEARTBEAT_S,
+                               role=role)
+        ann.start()
+        announcers[rid] = ann
+        return LocalReplica(rid, stub, role=role)
+
+    def on_reap(handle):
+        ann = announcers.pop(handle.replica_id, None)
+        if ann is not None:
+            ann.stop(final_beat=True)
+
+    driver = SimulatedPoolDriver(router, factory, on_reap=on_reap)
+    # an aggressively-idle config: every un-faulted control round wants
+    # to drain a replica — maximum scale-down pressure against the
+    # in-flight streams
+    scaler = Autoscaler(
+        router, driver,
+        AutoscalerConfig(
+            interval_s=0.02, min_replicas=1, max_replicas=3,
+            scale_up_wait_s=100.0, scale_down_wait_s=100.0,
+            up_stable_s=0.0, down_stable_s=0.0, cooldown_s=0.05,
+        ),
+        roles=("unified",),
+    )
+    router.start()
+    driver.scale_up("unified", 3)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if len(router.membership.candidates()) == 3:
+            break
+        time.sleep(0.005)
+    futures = []
+    try:
+        with chaos.active(ChaosInjector(
+            seed, {"scale.decision": 0.4}, max_faults=6,
+        )):
+            for i in range(N_REQUESTS):
+                futures.append(router.submit(
+                    f"req-{i % N_PREFIXES} shared prefix body",
+                    deadline=DEADLINE_S, max_new_tokens=8,
+                ))
+                scaler.tick()
+                time.sleep(0.01)
+            # keep ticking until the streams settle: the scaler keeps
+            # trying to drain the pool down while they run
+            settle = time.monotonic() + DEADLINE_S
+            while time.monotonic() < settle and not all(
+                f.done() for f in futures
+            ):
+                scaler.tick()
+                time.sleep(0.01)
+        # zero lost requests: EVERY accepted request completes — drained
+        # replicas finished their in-flight streams, refused admissions
+        # failed over to live replicas
+        for fut in futures:
+            result = fut.result(timeout=DEADLINE_S)
+            assert result.finish_reason == "length", result.finish_reason
+        for rid, stub in stubs.items():
+            assert stub.double_terminals == [], (rid, stub.double_terminals)
+            killed = [
+                r for r, reason in stub.terminals.items()
+                if reason == "failed_retriable"
+            ]
+            assert killed == [], (rid, killed)  # drained, never killed
+        # the pool DID shrink (the invariant is drain-not-kill, not
+        # never-scale)
+        assert scaler.scale_downs_total >= 1
+        # reaps complete once their victims idle
+        settle = time.monotonic() + 5.0
+        while time.monotonic() < settle and len(
+            driver.replica_ids("unified")
+        ) + len(scaler.snapshot()["draining"]) > max(
+            1, len(driver.replica_ids("unified"))
+        ):
+            scaler.tick()
+            time.sleep(0.01)
+    finally:
+        scaler.stop()
+        for ann in list(announcers.values()):
+            ann.stop(final_beat=False)
+        router.stop()
