@@ -526,6 +526,84 @@ def _engine_mixed_load(cfg: Any, params: Any, on_tpu: bool) -> dict:
         engine.stop()
 
 
+def _tenant_storm(cfg: Any, params: Any, on_tpu: bool) -> dict:
+    """High-priority TTFT under a low-priority tenant storm (ROADMAP
+    item 4, AIBrix arXiv:2504.03648): batch-class generations flood a
+    small engine at several times decode capacity while interactive-
+    class probes arrive; the preemption ladder (docs/serving.md
+    "Multi-tenancy") pages low-priority KV out so the probes admit
+    immediately. The headline — hi-priority TTFT p50 under contention —
+    is CPU-verifiable: the direction:"min" floor
+    (tenant_storm_hi_ttft_ms_p50_*) gates it without a TPU run."""
+    from gofr_tpu.serving import ByteTokenizer, EngineConfig, ServingEngine
+    from gofr_tpu.serving.tenancy import TenantPolicy, TenantRegistry
+
+    tenants = TenantRegistry()
+    tenants.set_policy(TenantPolicy(
+        name="gold", deadline_class="interactive", deadline_s=600.0,
+    ))
+    tenants.set_policy(TenantPolicy(
+        name="bulk", deadline_class="batch", deadline_s=600.0,
+    ))
+    chunk = 64 if on_tpu else 16
+    engine = ServingEngine(
+        cfg,
+        params,
+        EngineConfig(
+            max_slots=2,
+            max_seq_len=512 if on_tpu else 128,
+            prefill_buckets=(64,) if on_tpu else (16,),
+            prefill_chunk_tokens=chunk,
+            max_queue=64,
+            prefix_cache_entries=64,
+        ),
+        ByteTokenizer(cfg.vocab_size),
+        metrics=_engine_metrics(),
+        tenants=tenants,
+    )
+    engine.start()
+    try:
+        engine.submit("warm", max_new_tokens=4, temperature=0.0).result(timeout=1200)
+        engine.submit(
+            "w" * (chunk * 3), max_new_tokens=4, temperature=0.0
+        ).result(timeout=1200)
+        # the storm: 8 batch-class generations against 2 slots (4x decode
+        # capacity), refilled as they retire
+        flood = [
+            engine.submit(f"bulk row {i}", max_new_tokens=48,
+                          temperature=0.0, tenant="bulk")
+            for i in range(8)
+        ]
+        hi_ttfts: list[float] = []
+        preempted = 0
+        for i in range(10):
+            res = engine.submit(
+                f"gold probe {i}", max_new_tokens=2, temperature=0.0,
+                tenant="gold",
+            ).result(timeout=1200)
+            hi_ttfts.append(res.ttft_s)
+            flood.append(engine.submit(
+                f"bulk refill {i}", max_new_tokens=48, temperature=0.0,
+                tenant="bulk",
+            ))
+            time.sleep(0.01)
+        for f in flood:
+            f.result(timeout=1200)
+        for tl in engine.timeline.all():
+            if any(p.startswith("preempted") for p in tl.phases):
+                preempted += 1
+        hi = _percentiles(hi_ttfts)
+        return {
+            "hi_ttft_ms_p50": hi.get("p50_ms", 0.0),
+            "hi_ttft_ms_p99": hi.get("p99_ms", 0.0),
+            "flood_requests": len(flood),
+            "rows_preempted": preempted,
+            **_timeline_stats(engine),
+        }
+    finally:
+        engine.stop()
+
+
 def _router_warm_prefix(cfg: Any, params: Any, on_tpu: bool) -> dict:
     """Warm-prefix TTFT at multi-replica scale (ROADMAP item 3, AIBrix
     multi-tier KV pooling arXiv:2504.03648): two in-process replicas
@@ -1405,6 +1483,21 @@ def _run_benchmarks(platform: str, init_error: str | None, wall_start: float) ->
     print(json.dumps(stream_line), flush=True)
     if "error" not in stream_line:
         _append_local_record(stream_line)
+
+    # --- hi-priority TTFT under a tenant storm (CPU-verifiable) ------------
+    def run_tenant_storm() -> dict:
+        if params is None:
+            raise RuntimeError("skipped: headline phase failed to build params")
+        return _tenant_storm(cfg, params, on_tpu)
+
+    storm_line = _phase_line(
+        f"tenant_storm_hi_ttft_ms_p50_{model_kind}_{platform}", "ms",
+        run_tenant_storm, value_key="hi_ttft_ms_p50",
+        on_tpu=on_tpu and not init_error, init_error=init_error,
+    )
+    print(json.dumps(storm_line), flush=True)
+    if "error" not in storm_line:
+        _append_local_record(storm_line)
 
     # --- framework-only phases (no TPU dependence at all) ------------------
     echo_line = _phase_line(

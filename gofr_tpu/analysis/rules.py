@@ -96,6 +96,15 @@ DISPATCH_ZONES: dict[str, set[str] | str] = {
     # already-open streaming response, never a fresh urlopen/sleep
     "gofr_tpu/serving/autoscaler.py": "*",
     "gofr_tpu/serving/remote.py": "*",
+    # multi-tenant plane: tenancy policy runs on the submit path; the
+    # adapter registry's engine/submit-facing surface must never block
+    # unbounded (the lora-upload WORKER — _upload — is off-thread by
+    # design, like the kv-spill worker, and stays out of the zone)
+    "gofr_tpu/serving/tenancy.py": "*",
+    "gofr_tpu/serving/lora.py": {
+        "acquire", "release", "tables", "slot_factors", "prefetch",
+        "register", "deregister", "known", "residency",
+    },
 }
 
 # retry/backoff paths reachable from handlers: uninterruptible sleeps only
@@ -138,9 +147,20 @@ HOT_SYNC_ZONES: dict[str, set[str] | str] = {
         "_emit_async", "_block_sync", "_slot_in_flight",
         "_make_device_state", "_retire", "_plan_step", "_cursor_health",
         "_cache_lookup", "_record_prefix_tier",
+        # multi-tenant plane: the preemption ladder and the adapter
+        # plumbing all run on the engine thread — the KV page-out in
+        # _preempt must stay pure device reads (read_span/slices), and
+        # the adapter delta must never materialize anything host-side
+        "_maybe_preempt", "_preempt", "_lora_adjusted", "_lora_release",
     },
     "gofr_tpu/serving/batch.py": "*",
     "gofr_tpu/serving/stepplan.py": "*",
+    # adapter registry: engine-thread-facing surface only — the
+    # lora-upload worker (_upload) materializes host arrays on its own
+    # thread by design, mirroring the kv-spill worker
+    "gofr_tpu/serving/lora.py": {
+        "acquire", "release", "tables", "slot_factors",
+    },
     # migration/upload paths that run on the engine thread: a host sync
     # sneaking in here would stall admission behind a device round-trip.
     # The spill worker's np.asarray (device→host, its own thread) and

@@ -97,6 +97,10 @@ RETRACE_ZONE_FILES = (
     "gofr_tpu/serving/batch.py",
     "gofr_tpu/serving/stepplan.py",
     "gofr_tpu/serving/kv_cache.py",
+    # the adapter-gather rides the donated DecodeState carry through the
+    # batch.py kernels; the registry's table swaps must stay functional
+    # (.at[].set) and shape-stable or every adapter upload would retrace
+    "gofr_tpu/serving/lora.py",
 )
 RETRACE_ZONE_DIRS = ("gofr_tpu/ops/",)
 

@@ -50,6 +50,8 @@ POINTS = (
     "router.heartbeat",  # replica heartbeat publish (partition: beat drops)
     "stream.remote",    # remote token-stream transport (tears mid-stream)
     "scale.decision",   # autoscaler control-loop decision (skipped round)
+    "tenant.preempt",   # preemption ladder (faulted = skipped, advisory)
+    "lora.upload",      # async adapter upload (faulted = requeue, transient)
 )
 
 

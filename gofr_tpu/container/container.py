@@ -337,6 +337,19 @@ class Container:
             "Fraction of wall time the engine loop spent doing work "
             "(heartbeat-derived, over the telemetry poll interval)",
         )
+        # multi-tenant serving plane (serving/tenancy.py + serving/
+        # lora.py, docs/serving.md "Multi-tenancy"): preemptions of
+        # low-priority decode rows under pressure, and how many LoRA
+        # adapters are resident in the device factor tables
+        m.new_counter(
+            "app_tenant_preemptions_total",
+            "Decode rows paused by the preemption ladder so a higher "
+            "class could run (label tenant = the PREEMPTED tenant)",
+        )
+        m.new_gauge(
+            "app_lora_adapter_residency",
+            "LoRA adapters resident in the device factor tables",
+        )
 
     # -- accessors mirroring the reference's API ------------------------------
     @property

@@ -137,13 +137,30 @@ class InferenceService:
         """configs[0]: framework-overhead calibration."""
         return request or b"{}"
 
-    def _gen_kwargs(self, body: dict) -> dict:
-        return dict(
+    def _gen_kwargs(self, body: dict, context: Any = None) -> dict:
+        kw = dict(
             max_new_tokens=int(body.get("max_tokens") or 0) or None,
             temperature=float(body.get("temperature", 0.0)),
             top_k=int(body.get("top_k", 0)),
             top_p=float(body.get("top_p", 1.0)),
         )
+        # multi-tenant plane (docs/serving.md "Multi-tenancy"): adapter
+        # and tenant ride only when set, mirroring the HTTP handlers; an
+        # x-tenant-id metadata entry (the gateway's stamp) outranks the
+        # body field
+        tenant = str(body.get("tenant") or "")
+        if context is not None:
+            try:
+                for key, value in context.invocation_metadata() or ():
+                    if key == "x-tenant-id" and value:
+                        tenant = value
+            except Exception:
+                pass
+        if body.get("adapter_id"):
+            kw["adapter_id"] = str(body["adapter_id"])
+        if tenant:
+            kw["tenant"] = tenant
+        return kw
 
     async def generate(self, request: bytes, context: Any) -> bytes:
         if self.engine is None:
@@ -158,7 +175,7 @@ class InferenceService:
             # lifecycle spans off it
             result = await self.engine.generate(
                 prompt, deadline=_deadline_of(context),
-                trace_ctx=current_span(), **self._gen_kwargs(body)
+                trace_ctx=current_span(), **self._gen_kwargs(body, context)
             )
         except LIFECYCLE_ERRORS as exc:
             await _abort_lifecycle(context, exc)
@@ -189,7 +206,7 @@ class InferenceService:
                 prompt, deadline=_deadline_of(context),
                 on_result=lambda r: final.setdefault("result", r),
                 trace_ctx=current_span(),
-                **self._gen_kwargs(body),
+                **self._gen_kwargs(body, context),
             ):
                 yield _json_bytes({"token": token_id, "text": piece})
         except LIFECYCLE_ERRORS as exc:

@@ -32,6 +32,11 @@ the unit of concurrency is the *slot*, not the thread. Components:
   lifecycle timelines behind /requestz, and the TPU HBM / duty-cycle
   poller feeding health, metrics and membership heartbeats
   (docs/observability.md).
+- lora.py / tenancy.py: the multi-tenant plane — the LoRA adapter
+  registry (paged adapter weights, heterogeneous-adapter batched
+  decode) and per-tenant SLO classes (priority/deadline classes,
+  token-rate budgets, the preemption ladder — docs/serving.md
+  "Multi-tenancy").
 """
 
 from gofr_tpu.serving.device_telemetry import DeviceTelemetry
@@ -61,6 +66,8 @@ from gofr_tpu.serving.autoscaler import (
 from gofr_tpu.serving.supervisor import EngineSupervisor
 from gofr_tpu.serving.timeline import RequestTimeline, TimelineRecorder
 from gofr_tpu.serving.tokenizer import ByteTokenizer, Tokenizer
+from gofr_tpu.serving.lora import AdapterRegistry, LoraAdapter
+from gofr_tpu.serving.tenancy import TenantPolicy, TenantRegistry
 
 __all__ = [
     "ServingEngine",
@@ -87,4 +94,8 @@ __all__ = [
     "Autoscaler",
     "AutoscalerConfig",
     "SimulatedPoolDriver",
+    "AdapterRegistry",
+    "LoraAdapter",
+    "TenantRegistry",
+    "TenantPolicy",
 ]

@@ -113,7 +113,12 @@ class DecodeState:
     cache bound on dense (``.at[].set`` drops out-of-bounds writes).
     Position 0 was the old dense target, which became a corruption bug
     the moment rows could be frozen while still holding LIVE prompt KV
-    (mid-chunked-prefill cursor rows)."""
+    (mid-chunked-prefill cursor rows).
+
+    ``adapter`` is the row's LoRA device-table slot (serving/lora.py; 0 =
+    base model). It rides the donated carry like ``stop_tok`` so the
+    heterogeneous-adapter gather inside the block needs no per-step host
+    traffic — the adapter index is admitted once and stays on device."""
 
     last_token: jnp.ndarray  # [B] int32
     seq_len: jnp.ndarray  # [B] int32 — tokens RESIDENT in KV (incl. prompt)
@@ -124,11 +129,13 @@ class DecodeState:
     top_k: jnp.ndarray  # [B] int32
     top_p: jnp.ndarray  # [B] f32
     rng: jax.Array
+    adapter: jnp.ndarray = None  # [B] int32 — LoRA table slot (0 = base)
 
     def tree_flatten(self):
         return (
             self.last_token, self.seq_len, self.done, self.budget,
             self.stop_tok, self.temperature, self.top_k, self.top_p, self.rng,
+            self.adapter,
         ), None
 
     @classmethod
@@ -139,11 +146,17 @@ class DecodeState:
 def make_decode_state(
     last_token: Any, seq_len: Any, done: Any, budget: Any, stop_tok: Any,
     temperature: Any, top_k: Any, top_p: Any, rng: jax.Array,
+    adapter: Any = None,
 ) -> DecodeState:
     """Upload a fresh device-resident DecodeState from host (numpy)
     mirrors — the cold path (engine start, post-failure rebuild). Steady
     state never re-uploads: admissions fold in via the donated scatter
-    below, and everything else advances on device."""
+    below, and everything else advances on device. ``adapter`` defaults
+    to all-base (slot 0) for callers predating the LoRA plane."""
+    import numpy as _np
+
+    if adapter is None:
+        adapter = _np.zeros(_np.asarray(last_token).shape[0], _np.int32)
     return DecodeState(
         jnp.asarray(last_token, jnp.int32),
         jnp.asarray(seq_len, jnp.int32),
@@ -154,6 +167,7 @@ def make_decode_state(
         jnp.asarray(top_k, jnp.int32),
         jnp.asarray(top_p, jnp.float32),
         rng,
+        jnp.asarray(adapter, jnp.int32),
     )
 
 
@@ -168,9 +182,11 @@ def admit_decode_state(
     temps: jnp.ndarray,  # [K] f32
     topks: jnp.ndarray,  # [K] int32
     topps: jnp.ndarray,  # [K] f32
+    adapters: jnp.ndarray,  # [K] int32 — LoRA table slots (0 = base)
 ) -> DecodeState:
     """Fold freshly-prefilled slots into the device-resident decode state
-    in one fused scatter (un-done + new budget + sampling params)."""
+    in one fused scatter (un-done + new budget + sampling params + the
+    per-row adapter index the block kernels gather with)."""
     return DecodeState(
         state.last_token.at[slots].set(tokens),
         state.seq_len.at[slots].set(lens),
@@ -181,6 +197,7 @@ def admit_decode_state(
         state.top_k.at[slots].set(topks),
         state.top_p.at[slots].set(topps),
         state.rng,
+        state.adapter.at[slots].set(adapters),
     )
 
 
@@ -201,11 +218,62 @@ def _pack_block(toks: jnp.ndarray, done: jnp.ndarray,
     )
 
 
-def _block_step(st: DecodeState, active, logits):
-    """Shared per-step tail of every decode_block* scan body: sample with
-    the row's own params, evaluate stop conditions, advance the carry.
-    Frozen (done/inactive) rows keep their token and length and emit -1."""
+def _lora_delta(
+    embedding: jnp.ndarray,  # [V, D] — the model's token embedding table
+    a_tab: jnp.ndarray,      # [n_adapters, D, r]
+    b_tab: jnp.ndarray,      # [n_adapters, r, V]
+    tokens: jnp.ndarray,     # [B] — the input tokens whose forward made logits
+    adapter: jnp.ndarray,    # [B] int32 — per-row adapter table slot
+) -> jnp.ndarray:
+    """Grouped low-rank logits delta for a heterogeneous-adapter batch:
+    a per-row ADAPTER-INDEX GATHER out of the stacked factor tables, then
+    two batched low-rank matmuls — ``emb[t] @ A_i @ B_i`` per row. Slot 0
+    is all-zero (base model), so mixed base/adapter batches need no mask.
+    Pure device math inside the fused block: no host traffic, no syncs."""
+    e = embedding[tokens].astype(jnp.float32)               # [B, D]
+    h = jnp.einsum("bd,bdr->br", e, a_tab[adapter])         # [B, r]
+    return jnp.einsum("br,brv->bv", h, b_tab[adapter])      # [B, V]
+
+
+def _lora_logits(params: dict, lora, tokens, adapter, logits):
+    """Apply the per-row adapter delta to a sampling site's logits.
+    ``lora`` is ``(a_table, b_table)`` or None (base-only engines trace
+    the exact pre-LoRA graph — the None path adds zero ops)."""
+    if lora is None or adapter is None:
+        return logits
+    a_tab, b_tab = lora
+    return logits + _lora_delta(
+        params["embedding"], a_tab, b_tab, tokens, adapter
+    )
+
+
+@jax.jit
+def lora_adjust_logits(
+    embedding: jnp.ndarray,  # [V, D]
+    a_row: jnp.ndarray,      # [D, r] — ONE adapter's factors
+    b_row: jnp.ndarray,      # [r, V]
+    token: jnp.ndarray,      # scalar int32 — the logits' input token
+    logits: jnp.ndarray,     # [1, V]
+) -> jnp.ndarray:
+    """Single-row adapter delta for the HOST-path first-token sampling
+    sites (monolithic prefill, full chunk-prefix-cache hits): the same
+    math as :func:`_lora_delta`, applied to one row's last-position
+    logits before ``sample_logits``. Pure device op — no sync."""
+    e = embedding[token].astype(jnp.float32)
+    h = e @ a_row.astype(jnp.float32)
+    return logits + (h @ b_row.astype(jnp.float32))[None]
+
+
+def _block_step(st: DecodeState, active, logits, params=None, lora=None):
+    """Shared per-step tail of every decode_block* scan body: apply the
+    per-row LoRA delta (heterogeneous-adapter batching, serving/lora.py),
+    sample with the row's own params, evaluate stop conditions, advance
+    the carry. Frozen (done/inactive) rows keep their token and length
+    and emit -1."""
     live = active & ~st.done
+    # the logits came from forwarding st.last_token — the delta is the
+    # same token's low-rank bypass, gathered by the row's adapter slot
+    logits = _lora_logits(params, lora, st.last_token, st.adapter, logits)
     rng, key = jax.random.split(st.rng)
     nxt = sample_logits(
         logits, key, temperature=st.temperature, top_k=st.top_k, top_p=st.top_p
@@ -218,6 +286,7 @@ def _block_step(st: DecodeState, active, logits):
         done,
         jnp.where(live, st.budget - 1, st.budget),
         st.stop_tok, st.temperature, st.top_k, st.top_p, rng,
+        st.adapter,
     )
     return new_st, jnp.where(live, nxt, -1)
 
@@ -230,6 +299,7 @@ def decode_block(
     state: DecodeState,  # donated
     active: jnp.ndarray,  # [B] bool — rows the host dispatched this block
     steps: int,
+    lora: tuple | None = None,  # (a_table, b_table) — heterogeneous LoRA
 ) -> tuple[jnp.ndarray, llama.KVCache, DecodeState]:
     """``steps`` fused decode+sample+stop-eval iterations in ONE dispatch
     over the dense slot cache. A row that stops mid-block freezes: no
@@ -237,6 +307,9 @@ def decode_block(
     Frozen rows aim their scatter PAST the cache bound (``.at[].set``
     drops out-of-bounds writes) — position 0 would corrupt live prompt
     KV for a row that is frozen because it is still mid-chunked-prefill.
+    ``lora`` (never donated) carries the stacked adapter factor tables;
+    each step gathers per-row slots out of the carry's ``adapter`` index
+    — one dispatch serves rows with DIFFERENT adapters (serving/lora.py).
     Returns (packed [B, steps+2] — see :func:`_pack_block` — cache,
     state); the packed array is the block's ONLY host-read value."""
     oob = cache.k.shape[2] + 1  # static: one past the slot's last position
@@ -248,7 +321,7 @@ def decode_block(
         logits, cache = llama.decode_step(
             cfg, params, st.last_token, cache, step_len
         )
-        st, out = _block_step(st, active, logits)
+        st, out = _block_step(st, active, logits, params, lora)
         return (cache, st), out
 
     (cache, state), toks = jax.lax.scan(
@@ -267,6 +340,7 @@ def decode_block_paged(
     block_tables: jnp.ndarray,  # [B, M] — covers the whole block's writes
     active: jnp.ndarray,  # [B] bool
     steps: int,
+    lora: tuple | None = None,  # (a_table, b_table) — heterogeneous LoRA
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, DecodeState]:
     """Paged twin of :func:`decode_block`: frozen rows' appends divert to
     the trash page (llama.decode_step_paged's ``active`` redirect), so a
@@ -279,7 +353,7 @@ def decode_block_paged(
         logits, kp, vp = llama.decode_step_paged(
             cfg, params, st.last_token, kp, vp, block_tables, step_len, live
         )
-        st, out = _block_step(st, active, logits)
+        st, out = _block_step(st, active, logits, params, lora)
         return (kp, vp, st), out
 
     (k_pool, v_pool, state), toks = jax.lax.scan(
@@ -301,6 +375,7 @@ def decode_block_paged_q(
     block_tables: jnp.ndarray,
     active: jnp.ndarray,
     steps: int,
+    lora: tuple | None = None,  # (a_table, b_table) — heterogeneous LoRA
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray,
            DecodeState]:
     """int8 twin of :func:`decode_block_paged`."""
@@ -313,7 +388,7 @@ def decode_block_paged_q(
             cfg, params, st.last_token, kp, vp, ksp, vsp, block_tables,
             step_len, live,
         )
-        st, out = _block_step(st, active, logits)
+        st, out = _block_step(st, active, logits, params, lora)
         return (kp, vp, ksp, vsp, st), out
 
     (k_pool, v_pool, ks_pool, vs_pool, state), toks = jax.lax.scan(
@@ -339,6 +414,7 @@ def decode_block_paged_q(
 def _fold_finished_prefill(
     st: DecodeState,
     logits_c: jnp.ndarray,   # [B, C, V] chunk-forward logits
+    chunk: jnp.ndarray,      # [B, C] the chunk's input tokens
     chunk_start: jnp.ndarray,  # [B] resident length before the chunk
     finish: jnp.ndarray,     # [B] bool — this chunk completes the prompt
     new_len: jnp.ndarray,    # [B] resident length after the chunk
@@ -349,16 +425,29 @@ def _fold_finished_prefill(
     topps: jnp.ndarray,
     rids: jnp.ndarray,       # [B] request ids (first-token RNG keys)
     rng_root: jax.Array,
+    adapters: jnp.ndarray | None = None,  # [B] LoRA table slots
+    params: dict | None = None,
+    lora: tuple | None = None,
 ) -> tuple[DecodeState, jnp.ndarray, jnp.ndarray]:
     """Sample first tokens for rows whose prompt just finished prefilling
-    and fold them into the decode carry. Returns (state, first [B] — -1
-    on non-finishing rows — last_logits [B, V] at each row's final chunk
-    position, for the chunk-prefix cache)."""
+    and fold them into the decode carry (including each row's LoRA
+    adapter slot, so the decode steps gather the right delta). Returns
+    (state, first [B] — -1 on non-finishing rows — last_logits [B, V] at
+    each row's final chunk position, for the chunk-prefix cache —
+    BASE-model logits: the adapter delta applies at sampling sites, so
+    cached entries stay adapter-independent while the adapter-id-scoped
+    keys keep cross-adapter hits impossible anyway)."""
     C = logits_c.shape[1]
     pos = jnp.clip(new_len - chunk_start - 1, 0, C - 1)
     last_logits = jnp.take_along_axis(
         logits_c, pos[:, None, None], axis=1
     )[:, 0]  # [B, V]
+    # the logits sampled from were produced by the chunk's last prompt
+    # token — the same token keys the low-rank bypass delta
+    last_tok = jnp.take_along_axis(chunk, pos[:, None], axis=1)[:, 0]
+    if adapters is None:
+        adapters = jnp.zeros_like(rids)
+    sample_from = _lora_logits(params, lora, last_tok, adapters, last_logits)
     keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(rng_root, rids)
 
     def sample_one(lg, key, t, tk, tp):
@@ -366,7 +455,7 @@ def _fold_finished_prefill(
             lg[None], key, temperature=t, top_k=tk, top_p=tp
         )[0]
 
-    sampled = jax.vmap(sample_one)(last_logits, keys, temps, topks, topps)
+    sampled = jax.vmap(sample_one)(sample_from, keys, temps, topks, topps)
     done_f = (sampled == stops) | (budgets <= 0)
     st = DecodeState(
         jnp.where(finish, sampled, st.last_token),
@@ -378,6 +467,7 @@ def _fold_finished_prefill(
         jnp.where(finish, topks, st.top_k),
         jnp.where(finish, topps, st.top_p),
         st.rng,
+        jnp.where(finish, adapters, st.adapter),
     )
     return st, jnp.where(finish, sampled, -1), last_logits
 
@@ -414,6 +504,8 @@ def ragged_step(
     rng_root: jax.Array,
     decode_active: jnp.ndarray,  # [B] bool — rows decoding THIS block
     steps: int,
+    adapters: jnp.ndarray | None = None,  # [B] LoRA slots for chunk rows
+    lora: tuple | None = None,  # (a_table, b_table) — never donated
 ) -> tuple[jnp.ndarray, jnp.ndarray, llama.KVCache, DecodeState]:
     """Unified ragged dispatch, dense cache: prefill-chunk forward for the
     chunk rows, first-token fold for finishing rows, then the N-step
@@ -425,8 +517,8 @@ def ragged_step(
         cfg, params, chunk, cache, chunk_start
     )
     state, first, last_logits = _fold_finished_prefill(
-        state, logits_c, chunk_start, finish, new_len, budgets, stops,
-        temps, topks, topps, rids, rng_root,
+        state, logits_c, chunk, chunk_start, finish, new_len, budgets,
+        stops, temps, topks, topps, rids, rng_root, adapters, params, lora,
     )
     # frozen rows include MID-PREFILL cursor rows whose low positions hold
     # live prompt KV: their scatter must drop out of bounds, never land on
@@ -440,7 +532,7 @@ def ragged_step(
         logits, cache = llama.decode_step(
             cfg, params, st.last_token, cache, step_len
         )
-        st, out = _block_step(st, decode_active, logits)
+        st, out = _block_step(st, decode_active, logits, params, lora)
         return (cache, st), out
 
     (cache, state), toks = jax.lax.scan(
@@ -475,6 +567,8 @@ def ragged_step_paged(
     rng_root: jax.Array,
     decode_active: jnp.ndarray,
     steps: int,
+    adapters: jnp.ndarray | None = None,  # [B] LoRA slots for chunk rows
+    lora: tuple | None = None,  # (a_table, b_table) — never donated
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, DecodeState]:
     """Paged twin of :func:`ragged_step`: chunk writes route through the
     block tables (inactive rows and beyond-capacity positions divert to
@@ -484,8 +578,8 @@ def ragged_step_paged(
         chunk_active, kv_capacity,
     )
     state, first, last_logits = _fold_finished_prefill(
-        state, logits_c, chunk_start, finish, new_len, budgets, stops,
-        temps, topks, topps, rids, rng_root,
+        state, logits_c, chunk, chunk_start, finish, new_len, budgets,
+        stops, temps, topks, topps, rids, rng_root, adapters, params, lora,
     )
 
     def step(carry, _):
@@ -495,7 +589,7 @@ def ragged_step_paged(
         logits, kp, vp = llama.decode_step_paged(
             cfg, params, st.last_token, kp, vp, block_tables, step_len, live
         )
-        st, out = _block_step(st, decode_active, logits)
+        st, out = _block_step(st, decode_active, logits, params, lora)
         return (kp, vp, st), out
 
     (k_pool, v_pool, state), toks = jax.lax.scan(
@@ -532,6 +626,8 @@ def ragged_step_paged_q(
     rng_root: jax.Array,
     decode_active: jnp.ndarray,
     steps: int,
+    adapters: jnp.ndarray | None = None,  # [B] LoRA slots for chunk rows
+    lora: tuple | None = None,  # (a_table, b_table) — never donated
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray,
            jnp.ndarray, DecodeState]:
     """int8 twin of :func:`ragged_step_paged`."""
@@ -542,8 +638,8 @@ def ragged_step_paged_q(
         )
     )
     state, first, last_logits = _fold_finished_prefill(
-        state, logits_c, chunk_start, finish, new_len, budgets, stops,
-        temps, topks, topps, rids, rng_root,
+        state, logits_c, chunk, chunk_start, finish, new_len, budgets,
+        stops, temps, topks, topps, rids, rng_root, adapters, params, lora,
     )
 
     def step(carry, _):
@@ -554,7 +650,7 @@ def ragged_step_paged_q(
             cfg, params, st.last_token, kp, vp, ksp, vsp, block_tables,
             step_len, live,
         )
-        st, out = _block_step(st, decode_active, logits)
+        st, out = _block_step(st, decode_active, logits, params, lora)
         return (kp, vp, ksp, vsp, st), out
 
     (k_pool, v_pool, ks_pool, vs_pool, state), toks = jax.lax.scan(

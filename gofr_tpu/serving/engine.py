@@ -31,6 +31,7 @@ import numpy as np
 from gofr_tpu import chaos
 from gofr_tpu.http.errors import (
     ErrorDeadlineExceeded,
+    ErrorInvalidParam,
     ErrorRequestEntityTooLarge,
     ErrorServiceUnavailable,
     ErrorTooManyRequests,
@@ -123,6 +124,13 @@ class EngineConfig:
     # capable of both phases (the crash-safety degrade path re-prefills
     # on a decode replica when a handoff source dies).
     role: str = "unified"
+    # multi-tenant preemption (serving/tenancy.py, docs/serving.md
+    # "Multi-tenancy"): when a strictly higher class waits and the batch
+    # is full (slots or KV pages), pause the lowest-priority decode row —
+    # its committed KV pages out through the prefix-cache/host-spill tier
+    # and the row resumes warm with its emitted tokens intact. Off = the
+    # A/B control: a tenant storm then starves higher classes.
+    tenant_preempt: bool = True
 
     @classmethod
     def from_config(cls, config: Any) -> "EngineConfig":
@@ -184,6 +192,9 @@ class EngineConfig:
                 config.get_or_default("TPU_DRAIN_DEADLINE_S", "30")
             ),
             role=config.get_or_default("TPU_REPLICA_ROLE", "unified"),
+            tenant_preempt=config.get_or_default(
+                "TPU_TENANT_PREEMPT", "1"
+            ) not in ("0", "false", "off"),
         )
 
 
@@ -219,7 +230,7 @@ class _Request:
         "stream_cb", "future", "created", "first_token_at", "tokens", "slot",
         "canceled", "stop_ids", "priority", "dispatched", "deadline",
         "kv_exhausted", "timeline", "trace_ctx", "prefill_only",
-        "handoff_from",
+        "handoff_from", "tenant", "adapter_id", "adapter_slot", "preemptions",
     )
 
     def __init__(self, rid: int, prompt_ids: list[int], max_new_tokens: int,
@@ -258,11 +269,36 @@ class _Request:
         # should pull its KV chain from, under the kv.handoff 2PC fetch.
         self.prefill_only = False
         self.handoff_from: str | None = None
+        # multi-tenant plane (serving/tenancy.py + serving/lora.py):
+        # tenant name (timeline/span/metric label + preemption class),
+        # the request's named LoRA adapter and its pinned device-table
+        # slot (0 = base), and how many times this row was preempted
+        self.tenant: str | None = None
+        self.adapter_id: str | None = None
+        self.adapter_slot = 0
+        self.preemptions = 0
         # absolute perf_counter time the caller stops caring; None = forever
         self.deadline = (self.created + deadline) if deadline else None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
+
+    @property
+    def serve_ids(self) -> list[int]:
+        """The token sequence a (re-)admission must make KV-resident:
+        the prompt plus every token already emitted. Fresh requests have
+        no tokens, so this IS the prompt; a preempted request resumes by
+        prefilling (warm, via the chunk-boundary cache) its whole
+        generated context and sampling the NEXT token — emitted tokens
+        are preserved, never re-run."""
+        return self.prompt_ids + self.tokens
+
+    @property
+    def new_budget(self) -> int:
+        """Tokens the request may still emit (max_new minus what is
+        already out) — the admission-time budget for fresh AND resumed
+        requests."""
+        return self.max_new_tokens - len(self.tokens)
 
 
 class _Inflight:
@@ -321,6 +357,8 @@ class ServingEngine:
         seed: int = 0,
         prefix_cache: Any = None,
         kv_migrator: Any = None,
+        lora: Any = None,
+        tenants: Any = None,
     ) -> None:
         self.model_cfg = cfg
         self.params = params
@@ -368,6 +406,20 @@ class ServingEngine:
         # migrates the advertised slabs from the owning replica instead
         # of re-prefilling — advisory, every failure degrades to compute
         self._kv_migrator = kv_migrator
+        # multi-tenant plane (docs/serving.md "Multi-tenancy"): the LoRA
+        # adapter registry (serving/lora.py — per-request adapter_id,
+        # heterogeneous-adapter batched decode) and the tenant policy
+        # registry (serving/tenancy.py — priority/deadline classes,
+        # token-rate budgets, the preemption ladder). Both optional; an
+        # engine without them is byte-identical to the pre-tenancy one.
+        self._lora = lora
+        self._tenants = tenants
+        if self._lora is not None and self.config.spec_tokens > 0:
+            raise ValueError(
+                "TPU_SPEC_TOKENS and a LoRA adapter registry are mutually "
+                "exclusive: the speculative verify path predates the "
+                "adapter gather (serve adapters from non-spec replicas)"
+            )
 
         if self.config.kv_dtype not in ("bf16", "int8"):
             raise ValueError(
@@ -637,6 +689,8 @@ class ServingEngine:
                     leftovers = list(self._by_id.values())
                     self._by_id.clear()
                 for req in leftovers:
+                    # the registry outlives this engine: pins must not
+                    self._lora_release(req)
                     self._settle_future(req, ErrorServiceUnavailable(
                         "engine wedged; retry on another replica",
                         retry_after=1.0,
@@ -660,6 +714,9 @@ class ServingEngine:
             leftovers = list(self._by_id.values())
             self._by_id.clear()
         for req in leftovers:
+            # the adapter registry outlives this engine: release pins so
+            # a successor engine sharing it can still recycle slots
+            self._lora_release(req)
             self._settle_future(req, ErrorServiceUnavailable(
                 "engine stopped before the request was served; retry",
                 retry_after=1.0,
@@ -806,6 +863,10 @@ class ServingEngine:
                     self._by_id.clear()
                 requeue: list[_Request] = []
                 for req in pending:
+                    # whatever the partition verdict, the row's adapter
+                    # pin dies with the old batch (a requeued request
+                    # re-acquires at its re-admission)
+                    self._lora_release(req)
                     if not req.tokens and not req.canceled:
                         # never emitted a token: still queued, OR
                         # partially-prefilled behind a chunk cursor — its
@@ -990,6 +1051,10 @@ class ServingEngine:
             details["kv_pages"] = self.paged_cache.stats()
         if self._prefix_cache is not None:
             details["prefix_cache"] = self._prefix_cache.stats()
+        if self._lora is not None:
+            details["lora"] = self._lora.residency()
+        if self._tenants is not None:
+            details["tenants"] = self._tenants.snapshot()
         # the flight recorder's compact latency view: median TTFT /
         # queue-wait / e2e over the completed ring (phase detail per
         # request lives at /requestz)
@@ -1037,6 +1102,8 @@ class ServingEngine:
         trace_ctx: Any = None,
         prefill_only: bool = False,
         handoff_from: str | None = None,
+        tenant: str | None = None,
+        adapter_id: str | None = None,
     ) -> Any:
         """Thread-safe submit. Returns a concurrent Future resolving to
         GenerationResult. ``stream_cb(token_id, text_piece, done)`` fires per
@@ -1063,13 +1130,50 @@ class ServingEngine:
                 "engine restarting; retry", retry_after=1.0
             )
 
+        # -- tenancy gates (serving/tenancy.py, docs/serving.md) -----------
+        # resolve the tenant's SLO class FIRST: its priority drives the
+        # scheduler + preemption ladder, its deadline class fills in a
+        # missing deadline (so expired-while-queued and mid-stream expiry
+        # work for every tenant), and its token-rate budget rejects an
+        # over-budget tenant in microseconds with 429 + Retry-After — the
+        # same shed contract clients and routers already key on.
+        # TENANTLESS requests are untouched: naming a tenant is the
+        # opt-in — merely wiring a registry must not inject deadlines or
+        # demote priority on existing anonymous traffic.
+        if self._tenants is not None and tenant:
+            policy = self._tenants.policy(tenant)
+            if priority == 0:
+                priority = int(policy.priority or 0)
+            if deadline is None and policy.deadline_s:
+                deadline = float(policy.deadline_s)
+        if adapter_id and (
+            self._lora is None or not self._lora.known(adapter_id)
+        ):
+            # a client error either way: no registry, or an id the
+            # registry has never seen — 400, never a retriable
+            raise ErrorInvalidParam("adapter_id")
+
         # load shedding BEFORE any per-request work: rejecting here costs
         # microseconds; admitting a request that will wait past its
         # deadline costs a 504 after seconds of queueing. ONE stats
         # snapshot serves both the estimate and the queue-depth gauge —
         # stats() takes the scheduler mutex the engine thread contends on.
         depth = self._sched.stats()["queue_depth"]
-        est_wait = self._shed.estimate_wait(depth, self.config.max_slots)
+        shed_depth = depth
+        if self._tenants is not None:
+            # CLASS-AWARE wait estimate: the priority queue admits this
+            # request ahead of every lower class, so only same-or-higher
+            # class waiters stand between it and a slot — a batch-tenant
+            # flood must raise the batch class's estimate (and shed IT),
+            # never shed the interactive tenant the flood cannot delay
+            # (the preemption ladder frees the slot itself)
+            with self._count_lock:
+                shed_depth = sum(
+                    1 for r in self._by_id.values()
+                    if r.slot is None and not r.canceled
+                    and r.priority <= priority
+                )
+        est_wait = self._shed.estimate_wait(shed_depth, self.config.max_slots)
         if self._metrics:
             self._metrics.set_gauge("app_estimated_queue_wait_seconds", est_wait)
         shed_cap = self.config.shed_max_wait_s
@@ -1105,6 +1209,39 @@ class ServingEngine:
         budget = self.config.max_seq_len - len(prompt_ids)
         max_new = min(max_new_tokens or self.config.max_new_tokens_default, budget)
 
+        if self._tenants is not None:
+            # token-rate budget: prompt + requested generation charged
+            # against the tenant's bucket — over budget is a 429 the
+            # retry ladder (and the router's candidate walk) understands
+            ok, retry_after = self._tenants.admit(
+                tenant, len(prompt_ids) + max_new
+            )
+            if not ok:
+                if self._metrics:
+                    self._metrics.increment_counter(
+                        "app_requests_shed_total",
+                        tenant=tenant or "default",
+                    )
+                raise ErrorTooManyRequests(
+                    f"tenant {tenant or 'default'} over its token-rate "
+                    "budget",
+                    retry_after=max(retry_after, 0.05),
+                )
+
+        if adapter_id:
+            from gofr_tpu.serving.lora import UnknownAdapter
+
+            try:
+                # submit-time prefetch AFTER every rejection gate: the
+                # async upload (lora-upload worker, lora.upload chaos
+                # point) runs while the request queues, so admission
+                # normally finds the adapter resident — and shed/over-
+                # budget traffic never touches (or thrashes) the device
+                # adapter table
+                self._lora.prefetch(adapter_id)
+            except UnknownAdapter:  # deregistered since the gate above
+                raise ErrorInvalidParam("adapter_id") from None
+
         future: Any = concurrent.futures.Future()
         future.request_id = rid
         req = _Request(
@@ -1114,11 +1251,14 @@ class ServingEngine:
         req.priority = priority
         req.prefill_only = bool(prefill_only)
         req.handoff_from = handoff_from
+        req.tenant = tenant
+        req.adapter_id = adapter_id or None
         # flight-recorder timeline + the queue span, BEFORE any admission
         # gate that can still reject: a shed/stopped request leaves a
         # terminal timeline too (the chaos tier audits exactly-one-
         # terminal over every accepted request id)
         tl = self.timeline.begin(rid, prompt_tokens=len(prompt_ids))
+        tl.tenant = tenant
         req.timeline = tl
         req.trace_ctx = trace_ctx
         if self._tracer is not None:
@@ -1128,6 +1268,10 @@ class ServingEngine:
             )
             qspan.set_attribute("request.id", rid)
             qspan.set_attribute("tokens.prompt", len(prompt_ids))
+            if tenant:
+                qspan.set_attribute("tenant", tenant)
+            if adapter_id:
+                qspan.set_attribute("lora.adapter", adapter_id)
             tl.open_span("queue", qspan)
         elif trace_ctx is not None:
             tl.trace_id = trace_ctx.trace_id
@@ -1296,8 +1440,12 @@ class ServingEngine:
                 # queue this iteration would admit from)
                 continue
             try:
+                # the preemption ladder runs BEFORE the plan: a freed
+                # slot is admitted in this same iteration, so a waiting
+                # higher class pays at most one loop latency
+                did_work = self._maybe_preempt()
                 plan = self._plan_step()
-                did_work = self._admit(plan)
+                did_work |= self._admit(plan)
                 if any(s is not None for s in self.slots):
                     did_work |= self._decode_step(plan)
                 elif self._inflight_q:
@@ -1449,11 +1597,26 @@ class ServingEngine:
                     qspan.set_attribute("queue.wait_s", round(queue_wait, 6))
                     qspan.end()
                 if self._metrics:
+                    labels = (
+                        {"tenant": req.tenant} if req.tenant else {}
+                    )
                     self._metrics.record_histogram(
                         "app_request_queue_wait_seconds", queue_wait,
+                        **labels,
                     )
             try:
-                if self._route_chunked(len(req.prompt_ids)):
+                if self._lora is not None and req.adapter_id:
+                    from gofr_tpu.serving.lora import AdapterBusy
+
+                    try:
+                        # pin the adapter's device-table slot for the
+                        # life of the row; every table slot pinned (or a
+                        # faulted async upload) is TRANSIENT — requeue
+                        # exactly like KV-pool pressure
+                        req.adapter_slot = self._lora.acquire(req.adapter_id)
+                    except AdapterBusy:
+                        raise _RequeueRequest() from None
+                if self._route_chunked(len(req.serve_ids)):
                     self._start_cursor(slot, req)
                 else:
                     self._prefill_into(slot, req)
@@ -1464,10 +1627,11 @@ class ServingEngine:
                 # batch still proceeds — their slots are already claimed and
                 # the scheduler never re-delivers an admitted pair
                 self._check_retired()  # warm_restart already requeued it
+                self._lora_release(req)
                 sched.release(slot)
                 try:
                     sched.submit(
-                        rid, len(req.prompt_ids), req.max_new_tokens,
+                        rid, len(req.serve_ids), req.max_new_tokens,
                         req.priority, front=True,
                     )
                 except Exception:
@@ -1480,6 +1644,7 @@ class ServingEngine:
                 # request was already requeued/settled by warm_restart, and
                 # slots/pools here belong to the replacement engine.
                 self._check_retired()
+                self._lora_release(req)
                 self.slots[slot] = None
                 self.cache_len[slot] = 0
                 if self.paged_cache is not None:
@@ -1509,6 +1674,31 @@ class ServingEngine:
                     self._fail_all(exc, kv_unhealthy=True)
         self._observe_queue()
         return bool(pairs or canceled_ids)
+
+    def _lora_adjusted(self, req: _Request, last_logits: Any,
+                       last_token: int) -> Any:
+        """Apply the row's adapter delta to host-path last-position
+        logits before first-token sampling (monolithic prefill, full
+        chunk-prefix hits). Pure device op, no sync; base rows return
+        the logits untouched."""
+        if self._lora is None or not req.adapter_slot:
+            return last_logits
+        factors = self._lora.slot_factors(req.adapter_slot)
+        if factors is None:
+            return last_logits
+        return batch_ops.lora_adjust_logits(
+            self.params["embedding"], factors[0], factors[1],
+            jnp.int32(last_token), last_logits,
+        )
+
+    def _lora_release(self, req: _Request) -> None:
+        """Unpin a row's adapter-table slot (no-op for base rows). Every
+        path that takes a row out of the batch — retire, requeue,
+        preempt, fail-all, the restart sweep — funnels through this so a
+        pin can never outlive its row."""
+        if req.adapter_slot and self._lora is not None:
+            self._lora.release(req.adapter_slot)
+            req.adapter_slot = 0
 
     # -- KV reuse tiers (prefix cache + host spill + cluster migration) --------
     def _cache_lookup(self, key: str) -> tuple[Any, str]:
@@ -1566,10 +1756,14 @@ class ServingEngine:
 
     def _prefill_into(self, slot: int, req: _Request) -> None:
         cfg = self.model_cfg
-        S = len(req.prompt_ids)
+        # serve_ids = prompt + already-emitted tokens: identical to the
+        # prompt for a fresh request; a preempted request re-prefills its
+        # whole generated context and resumes from the NEXT token
+        ids = req.serve_ids
+        S = len(ids)
         bucket = batch_ops.pad_bucket(S, self._buckets())
         tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
-        tokens[0, :S] = req.prompt_ids
+        tokens[0, :S] = ids
         seq_len = jnp.array([S], jnp.int32)
 
         if self.paged_cache is not None:
@@ -1606,9 +1800,14 @@ class ServingEngine:
             import hashlib as _hashlib
 
             digest = _hashlib.blake2b(
-                np.asarray(req.prompt_ids, np.int32).tobytes(), digest_size=16
+                np.asarray(ids, np.int32).tobytes(), digest_size=16
             ).hexdigest()
-            cache_key = f"prefill:{bucket}:{len(req.prompt_ids)}:{digest}"
+            # the adapter id is part of the key BY CONSTRUCTION: a
+            # cross-adapter KV hit is impossible however the cache is
+            # shared/migrated (docs/serving.md "Multi-tenancy")
+            cache_key = (
+                f"prefill:{bucket}:{S}:{digest}:{req.adapter_id or '-'}"
+            )
             cached, prefix_tier = self._cache_lookup(cache_key)
             if cached is None and self._kv_migrator is not None:
                 # disaggregated handoff first (the router named the
@@ -1684,12 +1883,15 @@ class ServingEngine:
                     dense.k, dense.v, k_slab, v_slab, jnp.int32(slot)
                 )
             # sample the first token with this request's params, keyed by
-            # request id (NOT the shared stream — see _rng_root above)
+            # request id (NOT the shared stream — see _rng_root above).
+            # The row's LoRA delta applies HERE, at the sampling site —
+            # cached entries stay base-model logits (adapter-scoped keys
+            # already make cross-adapter hits impossible).
             key = jax.random.fold_in(self._rng_root, req.id)
             from gofr_tpu.ops.sampling import sample_logits
 
             first = sample_logits(
-                last_logits, key,
+                self._lora_adjusted(req, last_logits, ids[-1]), key,
                 temperature=jnp.float32(req.temperature),
                 top_k=jnp.int32(req.top_k),
                 top_p=jnp.float32(req.top_p),
@@ -1721,31 +1923,42 @@ class ServingEngine:
         self.temperature[slot] = req.temperature
         self.top_k[slot] = req.top_k
         self.top_p[slot] = req.top_p
+        self.adapter_idx[slot] = req.adapter_slot
         # folded into the device-resident DecodeState by one donated
         # scatter at the next dispatch: (first token, resident len,
-        # remaining budget, stop id). The budget carries BOTH limits —
-        # max_new and the sequence cap (submit already clamped max_new to
-        # the sequence budget) — so the device's stop-eval covers them.
-        # A multi-token stop set disables device stop-eval (-1 sentinel);
-        # the host's _commit_token still enforces it at each sync.
+        # remaining budget, stop id, adapter slot). The budget carries
+        # BOTH limits — max_new and the sequence cap (submit already
+        # clamped max_new to the sequence budget) — and counts only the
+        # REMAINING tokens, so a preempted request resumes with what it
+        # has left, not a fresh allowance. A multi-token stop set
+        # disables device stop-eval (-1 sentinel); the host's
+        # _commit_token still enforces it at each sync.
         self._pending_admit[slot] = (
-            first_id, resident, req.max_new_tokens - 1,
+            first_id, resident, req.new_budget - 1,
             next(iter(req.stop_ids)) if len(req.stop_ids) == 1 else -1,
+            req.adapter_slot,
         )
         self._commit_first_token(slot, req, first_id)
 
     # -- chunked prefill (continuous batching) ---------------------------------
-    def _chunk_cache_keys(self, prompt_ids: list[int]) -> list[tuple[int, int, str]]:
+    def _chunk_cache_keys(
+        self, prompt_ids: list[int], adapter_id: str | None = None,
+    ) -> list[tuple[int, int, str]]:
         """Chunk-prefix cache keys for every chunk boundary of a prompt:
         chunk geometry + the content digest of the FULL prefix up to each
         boundary — two prompts sharing a prefix share its chunk entries,
-        and a chunk-size change can never alias. ONE incremental blake2b
-        pass with a copy() snapshot per boundary: digesting each prefix
-        from scratch would be quadratic in prompt length on the engine
-        thread."""
+        and a chunk-size change can never alias. The ADAPTER ID is part
+        of the key: same prompt under two adapters is two cache chains,
+        so a cross-adapter KV hit is impossible by construction — here,
+        in the distributed prefix index, and across disaggregated
+        handoffs (the keys are content-addressed everywhere). ONE
+        incremental blake2b pass with a copy() snapshot per boundary:
+        digesting each prefix from scratch would be quadratic in prompt
+        length on the engine thread."""
         import hashlib as _hashlib
 
         arr = np.asarray(prompt_ids, np.int32)
+        aid = adapter_id or "-"
         h = _hashlib.blake2b(digest_size=16)
         out: list[tuple[int, int, str]] = []
         pos, total = 0, len(prompt_ids)
@@ -1754,7 +1967,7 @@ class ServingEngine:
             h.update(arr[pos:end].tobytes())
             key = (
                 f"chunkpfx:{self._chunk_tokens}:{pos}:{end}:"
-                f"{h.copy().hexdigest()}"
+                f"{h.copy().hexdigest()}:{aid}"
             )
             out.append((pos, end, key))
             pos = end
@@ -1766,7 +1979,8 @@ class ServingEngine:
         the step planner's chunk grants. Raises before touching slot state
         on page pressure (_RequeueRequest) or a never-fits prompt (413) —
         the _admit cleanup contract."""
-        total = len(req.prompt_ids)
+        ids = req.serve_ids  # prompt + emitted tokens (preempt resume)
+        total = len(ids)
         pc = self.paged_cache
         if pc is not None and pc.pages_needed(total) > pc.num_pages:
             raise ErrorRequestEntityTooLarge(
@@ -1784,11 +1998,21 @@ class ServingEngine:
         cache_keys: dict[tuple[int, int], str] | None = None
         tiers: set[str] = set()
         if self._prefix_cache is not None and self._chunk_cache_enabled:
-            boundaries = self._chunk_cache_keys(req.prompt_ids)
+            boundaries = self._chunk_cache_keys(ids, req.adapter_id)
             cache_keys = {(s, e): k for s, e, k in boundaries}
             for start, end, key in boundaries:
                 val, tier = self._cache_lookup(key)
                 if val is None:
+                    break
+                if end >= total and val[0].shape[-1] != self.model_cfg.vocab_size:
+                    # a preemption page-out stored this span with a
+                    # PLACEHOLDER logits column (the paged-out row never
+                    # had last-position logits to give). Its KV is good
+                    # as a NON-final link, but it must never serve as the
+                    # chain's final entry — the zero-dispatch admit below
+                    # would sample this request's first token from
+                    # garbage. Stop the walk; the tail chunk recomputes
+                    # and samples fresh.
                     break
                 hits.append((start, end, val))
                 tiers.add(tier)
@@ -1825,6 +2049,10 @@ class ServingEngine:
 
                     for start, end, val in fetched:
                         val = _to_device(val)  # async upload, no sync
+                        if (end >= total and
+                                val[0].shape[-1] != self.model_cfg.vocab_size):
+                            break  # peer's preempt placeholder: same
+                            # final-entry guard as the local walk above
                         hits.append((start, end, val))
                         pos = end
                         # pay the transfer once per replica: later
@@ -1890,7 +2118,7 @@ class ServingEngine:
                 from gofr_tpu.ops.sampling import sample_logits
 
                 first = sample_logits(
-                    last_logits, key,
+                    self._lora_adjusted(req, last_logits, ids[-1]), key,
                     temperature=jnp.float32(req.temperature),
                     top_k=jnp.int32(req.top_k),
                     top_p=jnp.float32(req.top_p),
@@ -1900,7 +2128,8 @@ class ServingEngine:
             self._commit_prefilled(slot, req, first_id, total)
             return
 
-        cursor = ChunkCursor(req=req, slot=slot, total=total, seq=self._cursor_seq)
+        cursor = ChunkCursor(req=req, slot=slot, total=total,
+                             seq=self._cursor_seq, priority=req.priority)
         self._cursor_seq += 1
         cursor.cache_keys = cache_keys
         cursor.committed = cursor.dispatched = pos
@@ -1914,6 +2143,7 @@ class ServingEngine:
         self.temperature[slot] = req.temperature
         self.top_k[slot] = req.top_k
         self.top_p[slot] = req.top_p
+        self.adapter_idx[slot] = req.adapter_slot
         self._cursors[slot] = cursor
 
     def _cursor_requeue(self, slot: int, req: _Request,
@@ -1927,6 +2157,7 @@ class ServingEngine:
         self.slots[slot] = None
         self.cache_len[slot] = 0
         req.slot = None
+        self._lora_release(req)
         if self.paged_cache is not None:
             try:
                 self.paged_cache.free_slot(slot)
@@ -1939,7 +2170,7 @@ class ServingEngine:
             pass
         try:
             sched.submit(
-                req.id, len(req.prompt_ids), req.max_new_tokens,
+                req.id, len(req.serve_ids), req.max_new_tokens,
                 req.priority, front=True,
             )
         except Exception:
@@ -1963,6 +2194,177 @@ class ServingEngine:
             self._retire(slot, "deadline_exceeded")
         elif cursor.blocked:
             self._cursor_requeue(slot, req, cursor)
+
+    # -- tenant preemption (docs/serving.md "Multi-tenancy") -------------------
+    def _maybe_preempt(self) -> bool:
+        """The preemption ladder: when a STRICTLY higher class (lower
+        priority number) waits and the batch cannot take it — no free
+        slot, or (paged) the pool cannot cover its prompt — pause the
+        lowest-priority decode row. Its committed KV pages out through
+        the prefix-cache/host-spill tier (:meth:`_preempt`), the slot
+        frees, and the row resumes warm later with its emitted tokens
+        intact. Equal classes never preempt each other (no ping-pong: a
+        resumed row keeps its priority, so it can never evict what
+        evicted it). Engine-thread only; a few dict walks per iteration
+        and only when something is actually waiting."""
+        if not self.config.tenant_preempt or self._tenants is None:
+            return False
+        if self.config.spec_tokens > 0:
+            return False  # spec rows carry un-resumable draft state
+        with self._count_lock:
+            waiting = [
+                r for r in self._by_id.values()
+                if r.slot is None and not r.canceled
+            ]
+        if not waiting:
+            self._preempt_pending.clear()
+            return False
+        best = min(r.priority for r in waiting)
+        slot_pressure = all(s is not None for s in self.slots)
+        page_pressure = False
+        if not slot_pressure and self.paged_cache is not None:
+            need = min(
+                self.paged_cache.pages_needed(len(r.serve_ids))
+                for r in waiting if r.priority == best
+            )
+            page_pressure = (
+                need > self.paged_cache.stats()["free_blocks"]
+            )
+        if not slot_pressure and not page_pressure:
+            self._preempt_pending.clear()  # the pressure passed: resume
+            return False
+        # a pending victim preempts the moment its pipelined blocks drain
+        # (the dispatch loop stopped feeding it when it went pending —
+        # preempting under an in-flight block would free pages the
+        # dispatched device work still writes through)
+        for slot in sorted(self._preempt_pending):
+            req = self.slots[slot]
+            if req is None or req.priority <= best:
+                self._preempt_pending.discard(slot)
+                continue
+            cursor = self._cursors.get(slot)
+            if self._slot_in_flight(slot, req) or (
+                cursor is not None and cursor.in_flight > 0
+            ):
+                return False  # draining: the consume side lands first
+            self._preempt_pending.discard(slot)
+            self._preempt(slot)
+            return True
+        victim = None
+        for slot, req in enumerate(self.slots):
+            if req is None or req.priority <= best:
+                continue  # never preempt an equal-or-higher class
+            if victim is None or (
+                (req.priority, len(req.tokens))
+                > (self.slots[victim].priority, len(self.slots[victim].tokens))
+            ):
+                # lowest class first; ties pick the row with MORE tokens
+                # out (its resume is warmest — every committed chunk is
+                # already in the cache chain)
+                victim = slot
+        if victim is None:
+            return False
+        cursor = self._cursors.get(victim)
+        req = self.slots[victim]
+        if self._slot_in_flight(victim, req) or (
+            cursor is not None and cursor.in_flight > 0
+        ):
+            # stop feeding the row and preempt once the pipeline drains
+            self._preempt_pending.add(victim)
+            return False
+        self._preempt(victim)
+        return True
+
+    def _preempt(self, slot: int) -> None:
+        """Pause one decode row: page its committed whole-chunk KV spans
+        out into the prefix cache (whence device-LRU pressure demotes
+        them to the PR 11 host-RAM spill tier), free the slot + pages,
+        and requeue the request. Resume is the ordinary re-admission of
+        ``serve_ids`` (prompt + emitted tokens): the boundary walk finds
+        the paged-out chunks and warm-restores them, the tail chunk
+        recomputes, and the NEXT token samples — emitted tokens are
+        preserved and never re-emitted. The ``tenant.preempt`` chaos
+        point makes the policy advisory by construction: a fault there
+        skips this preemption, never corrupts the row."""
+        req = self.slots[slot]
+        if req is None:
+            return
+        try:
+            chaos.maybe_fail("tenant.preempt")
+        except Exception:
+            return  # advisory: a faulted preemption is a skipped one
+        ids = req.serve_ids
+        resident = int(self.cache_len[slot])
+        # page out whole chunk-boundary spans below the resident length —
+        # and strictly below the total, so the resume always computes at
+        # least the final tail chunk (whose logits seed the next token).
+        # int8 layouts skip the page-out (read_span would dequantize) and
+        # simply recompute on resume — the chunk cache is off there anyway.
+        if (self._prefix_cache is not None and self._chunk_cache_enabled
+                and not req.prefill_only):
+            boundaries = self._chunk_cache_keys(ids, req.adapter_id)
+            for start, end, key in boundaries:
+                if end > resident or end >= len(ids):
+                    break
+                if self.paged_cache is not None:
+                    k_slab, v_slab = self.paged_cache.read_span(
+                        slot, start, end
+                    )
+                else:
+                    k_slab = self.cache.k[:, slot, start:end]
+                    v_slab = self.cache.v[:, slot, start:end]
+                # the span entry's logits column is never read: the walk
+                # stops before the prompt's end by construction (see
+                # above), so a placeholder keeps the (logits, k, v)
+                # value shape without retaining a live buffer
+                self._prefix_cache.put(
+                    key, (jnp.zeros((1, 1), jnp.float32), k_slab, v_slab)
+                )
+        req.preemptions += 1
+        tl = req.timeline
+        if tl is not None:
+            tl.stamp(f"preempted:{req.preemptions}")
+        if self._metrics:
+            self._metrics.increment_counter(
+                "app_tenant_preemptions_total",
+                tenant=req.tenant or "default",
+            )
+        if self._logger:
+            self._logger.info(
+                f"preempted request {req.id} (tenant "
+                f"{req.tenant or 'default'}, priority {req.priority}) "
+                f"after {len(req.tokens)} tokens; {resident} resident "
+                "tokens paged out"
+            )
+        # nothing is in flight for the slot (the caller checked): free it
+        # and requeue. The consume-side identity checks make any stale
+        # record harmless, exactly like a cancel retire.
+        self._cursors.pop(slot, None)
+        self.slots[slot] = None
+        self.cache_len[slot] = 0
+        self.adapter_idx[slot] = 0
+        req.slot = None
+        req.dispatched = max(len(req.tokens) - 1, 0)
+        self._lora_release(req)
+        if self.paged_cache is not None:
+            try:
+                self.paged_cache.free_slot(slot)
+            except Exception:
+                pass
+        sched = self._sched
+        try:
+            sched.release(slot)
+        except KeyError:
+            pass
+        try:
+            sched.submit(
+                req.id, len(req.serve_ids), req.max_new_tokens,
+                req.priority,
+            )
+        except Exception:
+            with self._count_lock:
+                self._by_id.pop(req.id, None)
+            self._try_resolve(req, exc=ErrorTooManyRequests())
 
     # -- decode (pipelined N-step blocks) --------------------------------------
     def _decode_step(self, plan: StepPlan | None = None) -> bool:
@@ -2217,6 +2619,7 @@ class ServingEngine:
         return batch_ops.make_decode_state(
             self.last_token, np.maximum(self.cache_len, 1), done, budget,
             stop, self.temperature, self.top_k, self.top_p, sub,
+            self.adapter_idx,
         )
 
     def _dispatch_decode(self, plan: StepPlan | None = None) -> _Inflight | None:
@@ -2254,6 +2657,11 @@ class ServingEngine:
                 # pool-clamped: dispatch nothing further; the tokens still
                 # in flight are delivered at the next sync, then the row
                 # retires there with finish_reason kv_exhausted
+                continue
+            if slot in self._preempt_pending:
+                # marked for preemption: stop feeding the row so its
+                # pipelined blocks drain — the preemption ladder pages it
+                # out the moment nothing is in flight for the slot
                 continue
             rows.append((slot, req))
 
@@ -2346,6 +2754,8 @@ class ServingEngine:
                 jnp.asarray(self.temperature[idx]),
                 jnp.asarray(self.top_k[idx]),
                 jnp.asarray(self.top_p[idx]),
+                jnp.asarray(np.fromiter((v[4] for _, v in items), np.int32,
+                                        len(items))),
             )
         # NOTE: self._dec_state is NOT updated here — the scatter donated
         # the old buffers, and the commit happens in one place after the
@@ -2363,32 +2773,37 @@ class ServingEngine:
         # self.* commits happen only after the retirement check
         prefill_rows: list = []
         last_logits = None
+        lora = self._lora.tables() if self._lora is not None else None
         if chunk_rows:
             (packed, last_logits, new_cache, new_state, prefill_rows) = (
                 self._dispatch_ragged(cfg, pc, state, mask_d, chunk_rows, N)
             )
         elif pc is not None:
             tables_d = pc.tables_device()
-            with self._cold_dispatch("decode", "paged", pc.quantized, N):
+            with self._cold_dispatch("decode", "paged", pc.quantized, N,
+                                     lora is not None):
                 if pc.quantized:
                     (packed, pc.k_pool, pc.v_pool, pc.ks_pool, pc.vs_pool,
                      new_state) = batch_ops.decode_block_paged_q(
                         cfg, self.params, pc.k_pool, pc.v_pool,
                         pc.ks_pool, pc.vs_pool, state, tables_d, mask_d, N,
+                        lora=lora,
                     )
                 else:
                     (packed, pc.k_pool, pc.v_pool, new_state) = (
                         batch_ops.decode_block_paged(
                             cfg, self.params, pc.k_pool, pc.v_pool, state,
-                            tables_d, mask_d, N,
+                            tables_d, mask_d, N, lora=lora,
                         )
                     )
             new_cache = self.cache  # dense path untouched
         else:
             with self._cold_dispatch("decode", "dense",
-                                     self.cache.quantized, N):
+                                     self.cache.quantized, N,
+                                     lora is not None):
                 packed, new_cache, new_state = batch_ops.decode_block(
                     cfg, self.params, self.cache, state, mask_d, N,
+                    lora=lora,
                 )
         self._check_retired()  # commit to self only as the loop's owner
         self.cache = new_cache
@@ -2432,17 +2847,20 @@ class ServingEngine:
         stops = np.full(B, -1, np.int32)
         rids = np.zeros(B, np.int32)
         kvcap = np.zeros(B, np.int32)
+        adapters = np.zeros(B, np.int32)
         for slot, cursor, req, start_pos, n in chunk_rows:
-            chunk[slot, :n] = req.prompt_ids[start_pos : start_pos + n]
+            serve = req.serve_ids  # prompt + emitted (preempt resume)
+            chunk[slot, :n] = serve[start_pos : start_pos + n]
             start[slot] = start_pos
             cactive[slot] = True
             finish[slot] = start_pos + n >= cursor.total
             new_len[slot] = start_pos + n
-            budgets[slot] = req.max_new_tokens - 1
+            budgets[slot] = req.new_budget - 1
             stops[slot] = (
                 next(iter(req.stop_ids)) if len(req.stop_ids) == 1 else -1
             )
             rids[slot] = req.id
+            adapters[slot] = req.adapter_slot
             if pc is not None:
                 kvcap[slot] = pc.owned_capacity(slot)
         chunk_d = jnp.asarray(chunk)
@@ -2458,11 +2876,14 @@ class ServingEngine:
         temps_d = jnp.asarray(self.temperature.copy())
         topks_d = jnp.asarray(self.top_k.copy())
         topps_d = jnp.asarray(self.top_p.copy())
+        adapters_d = jnp.asarray(adapters)
+        lora = self._lora.tables() if self._lora is not None else None
         if pc is not None:
             tables_d = pc.tables_device()
             cactive_d = jnp.asarray(cactive)
             kvcap_d = jnp.asarray(kvcap)
-            with self._cold_dispatch("ragged", "paged", pc.quantized, N):
+            with self._cold_dispatch("ragged", "paged", pc.quantized, N,
+                                     lora is not None):
                 if pc.quantized:
                     (packed, last_logits, pc.k_pool, pc.v_pool, pc.ks_pool,
                      pc.vs_pool, new_state) = batch_ops.ragged_step_paged_q(
@@ -2471,6 +2892,7 @@ class ServingEngine:
                         start_d, cactive_d, kvcap_d, finish_d, newlen_d,
                         budgets_d, stops_d, temps_d, topks_d, topps_d,
                         rids_d, self._rng_root, mask_d, N,
+                        adapters=adapters_d, lora=lora,
                     )
                 else:
                     (packed, last_logits, pc.k_pool, pc.v_pool,
@@ -2479,17 +2901,19 @@ class ServingEngine:
                         tables_d, chunk_d, start_d, cactive_d, kvcap_d,
                         finish_d, newlen_d, budgets_d, stops_d, temps_d,
                         topks_d, topps_d, rids_d, self._rng_root,
-                        mask_d, N,
+                        mask_d, N, adapters=adapters_d, lora=lora,
                     )
             new_cache = self.cache  # dense path untouched
         else:
             with self._cold_dispatch("ragged", "dense",
-                                     self.cache.quantized, N):
+                                     self.cache.quantized, N,
+                                     lora is not None):
                 (packed, last_logits, new_cache,
                  new_state) = batch_ops.ragged_step(
                     cfg, self.params, self.cache, state, chunk_d, start_d,
                     finish_d, newlen_d, budgets_d, stops_d, temps_d,
                     topks_d, topps_d, rids_d, self._rng_root, mask_d, N,
+                    adapters=adapters_d, lora=lora,
                 )
         prefill_rows = []
         for slot, cursor, req, start_pos, n in chunk_rows:
@@ -2662,22 +3086,31 @@ class ServingEngine:
         TTFT stamps/metrics, emission, and the ONE stop/length retire
         chain — a divergence between the two admission routes is exactly
         the bug class sharing this prevents."""
-        req.first_token_at = time.perf_counter()
         self.last_token[slot] = first_id
-        ttft = req.first_token_at - req.created
-        self._shed.observe_ttft(ttft)
+        resumed = req.first_token_at is not None  # preempt/resume round trip
+        if not resumed:
+            req.first_token_at = time.perf_counter()
+            ttft = req.first_token_at - req.created
+            self._shed.observe_ttft(ttft)
         tl = req.timeline
         if tl is not None:
             # prefill end + first token share the commit instant: the
-            # sampled first token IS the prefill's last output
+            # sampled first token IS the prefill's last output. First
+            # stamp wins, so a resumed request keeps its original TTFT.
             tl.stamp("prefill_end")
             tl.stamp("first_token")
             tl.end_span("prefill")  # no-op on the chunked path (per-chunk
             # spans end at their own consumes)
-        if self._metrics:
+        if self._metrics and not resumed:
             self._metrics.record_histogram("app_ttft_seconds", ttft)
+            # tenant rides as an EXTRA labeled series (tenant-less
+            # traffic keeps the bare source=engine series, so existing
+            # scrapes and the hedge-floor percentile read unchanged)
+            labels = {"source": "engine"}
+            if req.tenant:
+                labels["tenant"] = req.tenant
             self._metrics.record_histogram(
-                "app_request_ttft_seconds", ttft, source="engine",
+                "app_request_ttft_seconds", ttft, **labels
             )
         if req.prefill_only:
             # disaggregated prefill phase: the prompt KV (and the cached
@@ -2793,6 +3226,8 @@ class ServingEngine:
                     )
         self.slots[slot] = None
         self.cache_len[slot] = 0
+        self.adapter_idx[slot] = 0
+        self._preempt_pending.discard(slot)
         self._cursors.pop(slot, None)  # a mid-chunked-prefill retire
         if self.paged_cache is not None:
             self.paged_cache.free_slot(slot)
@@ -2801,6 +3236,7 @@ class ServingEngine:
         except KeyError:
             pass
         if req is not None:
+            self._lora_release(req)
             with self._count_lock:
                 self._by_id.pop(req.id, None)
             self._finish(req, reason)
@@ -2900,7 +3336,10 @@ class ServingEngine:
         ttft = (req.first_token_at - req.created) if req.first_token_at else 0.0
         duration = now - req.created
         if self._metrics:
-            self._metrics.record_histogram("app_request_e2e_seconds", duration)
+            labels = {"tenant": req.tenant} if req.tenant else {}
+            self._metrics.record_histogram(
+                "app_request_e2e_seconds", duration, **labels
+            )
         # the detok/settlement span covers the off-engine-thread tail:
         # full-text detokenization, the terminal stream frame, future
         # resolution — it ends at the terminal mark inside _try_resolve
@@ -3079,6 +3518,9 @@ class ServingEngine:
         self.temperature = np.ones(B, np.float32)
         self.top_k = np.zeros(B, np.int32)
         self.top_p = np.ones(B, np.float32)
+        # per-slot LoRA adapter-table slot (0 = base): the host mirror of
+        # DecodeState.adapter, authoritative for recovery rebuilds
+        self.adapter_idx = np.zeros(B, np.int32)
         self.slots: list[_Request | None] = [None] * B
         # the pipelined-block queue: dispatched-but-unmaterialized blocks,
         # oldest first; depth bounded by decode_sync_every
@@ -3089,8 +3531,8 @@ class ServingEngine:
         self._dec_state: Any = None
         # slots prefilled since the last dispatch, folded into the device
         # state by ONE donated scatter: slot → (first token, resident len,
-        # remaining budget, stop id)
-        self._pending_admit: dict[int, tuple[int, int, int, int]] = {}
+        # remaining budget, stop id, adapter slot)
+        self._pending_admit: dict[int, tuple[int, int, int, int, int]] = {}
         self._mask_dev: Any = None  # cached device active mask
         self._mask_host: Any = None  # host copy the cache was built from
         self._last_consume_t: float | None = None
@@ -3102,6 +3544,9 @@ class ServingEngine:
         # requests requeue from chunk 0 (their KV died with the pools).
         self._cursors: dict[int, ChunkCursor] = {}
         self._cursor_seq = 0
+        # decode rows marked for preemption: no further blocks dispatch
+        # for them; the ladder pages them out once their pipeline drains
+        self._preempt_pending: set[int] = set()
         self._plan_gauges: tuple | None = None  # last-exported step-plan gauges
         self._sched = Scheduler(
             self.config.max_slots, self.config.max_queue,
@@ -3130,6 +3575,7 @@ class ServingEngine:
         # below like any other active request.
         self._inflight_q.clear()
         self._cursors.clear()
+        self._preempt_pending.clear()
         self._pending_admit.clear()
         self._dec_state = None  # rebuilt from host mirrors at next dispatch
         self._mask_dev = None
@@ -3154,6 +3600,7 @@ class ServingEngine:
             if req is not None:
                 self.slots[slot] = None
                 self.cache_len[slot] = 0
+                self._lora_release(req)
                 if self.paged_cache is not None:
                     try:
                         self.paged_cache.free_slot(slot)
@@ -3196,6 +3643,12 @@ class ServingEngine:
             name, parent=parent, kind="internal", activate=False
         )
         span.set_attribute("request.id", req.id)
+        if req.tenant:
+            # per-tenant SLO attainment is scraped straight off the
+            # serve.* spans (docs/serving.md "Multi-tenancy")
+            span.set_attribute("tenant", req.tenant)
+        if req.adapter_id:
+            span.set_attribute("lora.adapter", req.adapter_id)
         if tl is not None:
             tl.open_span(key, span)
         return span

@@ -43,6 +43,13 @@ class GenerateRequest:
     # replica this request's admission pulls its KV chain from
     prefill_only: bool = False
     handoff_from: str = ""
+    # multi-tenant plane (docs/serving.md "Multi-tenancy"): the request's
+    # LoRA adapter (unknown ids 400 at submit) and tenant (SLO class,
+    # rate budget, the timeline/span/metric label). The X-Tenant-Id
+    # header overrides the body field so gateways can stamp tenancy
+    # without rewriting payloads.
+    adapter_id: str = ""
+    tenant: str = ""
 
 
 def _shutdown_hook(engine: Any) -> Any:
@@ -86,11 +93,7 @@ def register_generation_routes(app: Any, engine: Any, prefix: str = "",
 
     async def generate(ctx: Any):
         body = ctx.bind(GenerateRequest)
-        kw = _validated_generate_kwargs(body)
-        kw["deadline"] = deadline_from_ctx(ctx)
-        # hang the engine's lifecycle spans off the request's server span
-        # (which carries the inbound W3C traceparent when one was sent)
-        kw["trace_ctx"] = current_span()
+        kw = _request_kwargs(ctx, body)
         if body.stream:
             return _sse_response(engine, body.prompt, kw)
         result = await engine.generate(body.prompt, **kw)
@@ -129,9 +132,7 @@ def register_generation_routes(app: Any, engine: Any, prefix: str = "",
         frame and whose tokens arrive as they decode, so remote TTFT is
         decoupled from completion time."""
         body = ctx.bind(GenerateRequest)
-        kw = _validated_generate_kwargs(body)
-        kw["deadline"] = deadline_from_ctx(ctx)
-        kw["trace_ctx"] = current_span()
+        kw = _request_kwargs(ctx, body)
         return _sse_response(engine, body.prompt, kw)
 
     async def generate_cancel(ctx: Any):
@@ -250,6 +251,29 @@ def _validated_generate_kwargs(body: GenerateRequest) -> dict:
         kw["prefill_only"] = True
     if body.handoff_from:
         kw["handoff_from"] = body.handoff_from
+    # tenancy flags likewise ride only when set
+    if body.adapter_id:
+        kw["adapter_id"] = body.adapter_id
+    if body.tenant:
+        kw["tenant"] = body.tenant
+    return kw
+
+
+def _request_kwargs(ctx: Any, body: GenerateRequest) -> dict:
+    """The ONE per-request kwargs assembly every HTTP/WS generation
+    route uses: validated body kwargs, then the context-derived fields —
+    the deadline header, the caller's trace context, and the tenancy
+    contract: ``X-Tenant-Id`` outranks the body's ``tenant`` field (a
+    gateway stamping tenancy must win over whatever the client
+    claimed)."""
+    kw = _validated_generate_kwargs(body)
+    header_tenant = ctx.header("x-tenant-id")
+    if header_tenant:
+        kw["tenant"] = header_tenant
+    kw["deadline"] = deadline_from_ctx(ctx)
+    # hang the engine's lifecycle spans off the request's server span
+    # (which carries the inbound W3C traceparent when one was sent)
+    kw["trace_ctx"] = current_span()
     return kw
 
 
@@ -266,9 +290,7 @@ def register_generation_ws(app: Any, engine: Any, path: str = "/ws/generate",
 
     async def ws_generate(ctx: Any):
         body = ctx.bind(GenerateRequest)
-        kw = _validated_generate_kwargs(body)
-        kw["deadline"] = deadline_from_ctx(ctx)
-        kw["trace_ctx"] = current_span()
+        kw = _request_kwargs(ctx, body)
         n = 0
         final: dict = {}
         try:

@@ -270,6 +270,11 @@ class HTTPReplica:
             payload["prefill_only"] = True
         if kw.get("handoff_from"):
             payload["handoff_from"] = kw["handoff_from"]
+        # multi-tenant plane: adapter + tenant ride the wire the same way
+        if kw.get("adapter_id"):
+            payload["adapter_id"] = kw["adapter_id"]
+        if kw.get("tenant"):
+            payload["tenant"] = kw["tenant"]
         return payload
 
     def submit(self, prompt: str | list[int], *, deadline: float | None = None,
@@ -733,18 +738,25 @@ class Router:
 
     # -- routing ---------------------------------------------------------------
     def _candidates_for(self, prompt: Any,
-                        role: str | None = None) -> tuple[list[str], bool]:
+                        role: str | None = None,
+                        adapter_id: str | None = None) -> tuple[list[str], bool]:
         """Ordered candidate replicas for a new request: the prefix-
         affine replica first (when healthy and under the spill bound),
         then every other routable replica by least estimated wait.
         ``role`` restricts the pool to one disaggregation phase (the
         affinity ring is built over that pool, so shared prefixes keep
         landing on the same prefill replica's chunk cache).
-        Returns (candidates, spilled)."""
+        ``adapter_id`` joins the affinity key: same prompt under two
+        adapters is two cache chains (the keys carry the adapter id), and
+        same-adapter traffic pinning to one replica keeps that adapter
+        device-resident there instead of thrashing every table in the
+        tier (serving/lora.py). Returns (candidates, spilled)."""
         routable = self.membership.candidates(role=role)
         if not routable:
             return [], False
         key = prefix_affinity_key(prompt, self.config.affinity_prefix_tokens)
+        if adapter_id:
+            key = key + adapter_id.encode("utf-8")
         affine = self._ring_for(routable).lookup(key)
         spilled = False
         if affine in routable:
@@ -802,7 +814,9 @@ class Router:
         present = self.membership.roles_present()
         if ms.ROLE_PREFILL in present and ms.ROLE_DECODE in present:
             return self._submit_disagg(req)
-        candidates, spilled = self._candidates_for(prompt)
+        candidates, spilled = self._candidates_for(
+            prompt, adapter_id=kw.get("adapter_id")
+        )
         if not candidates:
             with self._stats_mu:
                 self.no_replica_total += 1
@@ -868,7 +882,8 @@ class Router:
         registered = True
         try:
             candidates, _ = self._candidates_for(
-                req.prompt, role=ms.ROLE_PREFILL
+                req.prompt, role=ms.ROLE_PREFILL,
+                adapter_id=req.kw.get("adapter_id"),
             )
             prefill_fut = None
             for replica_id in candidates:
@@ -940,7 +955,8 @@ class Router:
         try:
             kw = {
                 k: v for k, v in req.kw.items()
-                if k in ("temperature", "top_k", "top_p", "priority")
+                if k in ("temperature", "top_k", "top_p", "priority",
+                         "adapter_id", "tenant")
             }
             prefill_fut = handle.submit(
                 req.prompt, deadline=remaining, prefill_only=True,

@@ -59,6 +59,10 @@ class ChunkCursor:
     slot: int
     total: int               # prompt tokens to prefill
     seq: int                 # admission order (FIFO grant order)
+    priority: int = 0        # tenant priority (lower first): grants walk
+    #                          (priority, seq), so a batch-class long
+    #                          prompt cannot absorb the chunk budget
+    #                          ahead of an interactive one
     committed: int = 0       # tokens confirmed resident at a consume
     dispatched: int = 0      # tokens handed to a ragged dispatch
     chunk_index: int = 0     # next chunk ordinal (timeline/span labels)
@@ -136,7 +140,12 @@ class StepPlanner:
             prefill_budget = self.chunk_tokens
         budget = prefill_budget
         grants: list[tuple[int, int]] = []
-        for cur in sorted(cursors, key=lambda c: c.seq):
+        # priority-aware grant order (multi-tenant plane, docs/serving.md
+        # "Multi-tenancy"): higher classes (lower priority number) drain
+        # first; FIFO within a class — the PR 10 starvation guarantee
+        # (decode reserved first) is unchanged, only the PREFILL budget
+        # walk became class-aware
+        for cur in sorted(cursors, key=lambda c: (c.priority, c.seq)):
             if budget <= 0:
                 break
             if cur.blocked or cur.remaining <= 0:

@@ -53,7 +53,7 @@ class RequestTimeline:
         "request_id", "trace_id", "created_unix", "prompt_tokens",
         "phases", "decode_blocks", "decode_tokens", "last_block_at",
         "prefill_chunks", "prefix_tier", "finish_reason", "terminal_at",
-        "terminal_marks", "spans", "_t0",
+        "terminal_marks", "spans", "tenant", "_t0",
     )
 
     def __init__(self, request_id: int, prompt_tokens: int = 0,
@@ -77,6 +77,11 @@ class RequestTimeline:
         # cache; docs/performance.md "KV reuse tiers"). First stamp wins
         # — a requeued admission keeps its original attribution.
         self.prefix_tier: str | None = None
+        # multi-tenant plane (docs/serving.md "Multi-tenancy"): the
+        # request's tenant label — per-tenant SLO attainment is directly
+        # scrapeable off /requestz (preempted:<n> phase stamps mark each
+        # preemption of the row)
+        self.tenant: str | None = None
         self.finish_reason: str | None = None
         self.terminal_at: float | None = None
         # how many times a terminal state was recorded for this request —
@@ -219,6 +224,8 @@ class RequestTimeline:
             out["prefill_chunks"] = list(self.prefill_chunks)
         if self.prefix_tier is not None:
             out["prefix_tier"] = self.prefix_tier
+        if self.tenant is not None:
+            out["tenant"] = self.tenant
         for key, value in (
             ("queue_wait_ms", self.queue_wait_s()),
             ("ttft_ms", self.ttft_s()),

@@ -382,13 +382,22 @@ def _assert_chunk_spans_never_double_prefill(eng: ServingEngine) -> None:
                 )
                 pos = c["start"] + c["tokens"]
         # a request that produced tokens finished its prefill: the final
-        # run covers the whole prompt exactly once
+        # run covers the whole prompt exactly once. A PREEMPTED request's
+        # resume run covers prompt + already-emitted tokens (serve_ids) —
+        # at least the prompt, still contiguous, never less.
         if tl.prefill_chunks and (
             tl.decode_tokens or "first_token" in tl.phases
         ):
-            assert sum(c["tokens"] for c in runs[-1]) == tl.prompt_tokens, (
-                tl.request_id, tl.prefill_chunks, tl.prompt_tokens,
-            )
+            preempted = any(p.startswith("preempted") for p in tl.phases)
+            covered = sum(c["tokens"] for c in runs[-1])
+            if preempted:
+                assert covered >= tl.prompt_tokens, (
+                    tl.request_id, tl.prefill_chunks, tl.prompt_tokens,
+                )
+            else:
+                assert covered == tl.prompt_tokens, (
+                    tl.request_id, tl.prefill_chunks, tl.prompt_tokens,
+                )
 
 
 @pytest.mark.chaos
@@ -477,3 +486,82 @@ def test_drain_under_decode_faults(seed):
     finally:
         if eng._running:
             eng.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_tenant_storm_preemption_preserves_lifecycle(seed):
+    """The tenant-storm seed (docs/serving.md "Multi-tenancy"): a
+    low-priority flood saturates the batch while high-priority requests
+    arrive, with faults firing at the NEW seams — ``tenant.preempt``
+    (a faulted preemption is a SKIPPED one, advisory by construction)
+    and ``lora.upload`` (a faulted adapter upload requeues the request
+    like KV-pool pressure). Asserts the lifecycle invariant over every
+    request, zero high-priority deadline misses while the flood runs
+    (preemption keeps the higher class inside its SLO even as faults
+    thin it out), and clean reclamation after drain."""
+    from gofr_tpu.serving.lora import AdapterRegistry, make_adapter
+    from gofr_tpu.serving.tenancy import TenantPolicy, TenantRegistry
+
+    cfg = tiny_cfg(64)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    lora = AdapterRegistry(max_active=3)
+    lora.register(make_adapter(cfg, "bulk-lora", rank=2, seed=3, scale=4.0))
+    tenants = TenantRegistry()
+    tenants.set_policy(TenantPolicy(name="gold", deadline_class="interactive",
+                                    deadline_s=60.0))
+    tenants.set_policy(TenantPolicy(name="bulk", deadline_class="batch",
+                                    deadline_s=120.0))
+    eng = ServingEngine(
+        cfg, params,
+        EngineConfig(max_slots=2, max_seq_len=64, prefill_buckets=(16,),
+                     admission_per_step=2, max_queue=64,
+                     prefix_cache_entries=16, prefill_chunk_tokens=8),
+        ByteTokenizer(cfg.vocab_size), lora=lora, tenants=tenants,
+    )
+    inj = chaos.ChaosInjector(
+        seed, {"tenant.preempt": 0.3, "lora.upload": 0.3}, max_faults=3
+    )
+    eng.start()
+    try:
+        # warm the executables OUTSIDE the storm: a first-compile stall
+        # must not masquerade as a deadline miss
+        eng.submit("warm", max_new_tokens=2).result(timeout=120)
+        eng.submit("warm-lora", max_new_tokens=2,
+                   adapter_id="bulk-lora").result(timeout=120)
+        with chaos.active(inj):
+            low: list = []
+            hi: list = []
+            # the flood: ≥4x decode capacity of batch-class traffic,
+            # half of it through the LoRA adapter (exercises the upload
+            # seam under fault)
+            for i in range(8):
+                low.append(eng.submit(
+                    f"low {i} xxxxxxxx"[:12], max_new_tokens=24,
+                    tenant="bulk",
+                    adapter_id="bulk-lora" if i % 2 else None,
+                ))
+            time.sleep(0.05)
+            for i in range(4):
+                hi.append(eng.submit(
+                    f"hi {i}", max_new_tokens=3, tenant="gold",
+                ))
+            for fut in hi:
+                result = fut.result(timeout=120)
+                # ZERO high-priority deadline misses while the flood runs
+                assert result.finish_reason in ("stop", "length"), (
+                    f"high-priority request missed: {result.finish_reason}"
+                )
+            outcomes = [("plain", f) for f in low + hi]
+            _assert_terminal(outcomes)
+        _assert_reclaimed(eng)
+        assert eng.drain(deadline_s=60) is True
+        assert eng._thread is None or not eng._thread.is_alive()
+        assert eng.health_check()["status"] == "DOWN"
+        _assert_timelines_terminal(eng)
+        _assert_chunk_spans_never_double_prefill(eng)
+    finally:
+        if eng._running:
+            eng.stop()
+        lora.close()

@@ -1,0 +1,653 @@
+"""The multi-tenant serving plane (docs/serving.md "Multi-tenancy").
+
+Covers the tentpole's three legs end-to-end on the real engine:
+
+- LoRA adapter multiplexing: heterogeneous-adapter batched decode is
+  TOKEN-IDENTICAL to sequential per-adapter runs (dense AND paged — the
+  per-row adapter-index gather inside the fused block changes nothing
+  about which tokens a row produces), the prefix cache is adapter-scoped
+  (same prompt under two adapters = two entries, no cross-hit), and the
+  one-sync-per-block contract survives the adapter gathers.
+- Per-tenant SLO classes: policy resolution, deadline-class defaults,
+  token-rate budgets rejected with 429 + Retry-After.
+- Preemption: a preempt/resume round trip preserves emitted tokens, and
+  the acceptance A/B — under a low-priority flood, high-priority requests
+  meet their deadline class WITH preemption and measurably miss WITHOUT
+  it (asserted, not assumed).
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from gofr_tpu.http.errors import (
+    ErrorDeadlineExceeded,
+    ErrorInvalidParam,
+    ErrorTooManyRequests,
+)
+from gofr_tpu.models import llama
+from gofr_tpu.serving import ByteTokenizer, EngineConfig, ServingEngine
+from gofr_tpu.serving.lora import (
+    AdapterBusy,
+    AdapterRegistry,
+    UnknownAdapter,
+    make_adapter,
+)
+from gofr_tpu.serving.stepplan import ChunkCursor, StepPlanner
+from gofr_tpu.serving.tenancy import (
+    TenantPolicy,
+    TenantRegistry,
+    TokenBucket,
+)
+
+
+def tiny_cfg(max_seq: int = 128) -> llama.LlamaConfig:
+    return llama.LlamaConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq_len=max_seq,
+    )
+
+
+def make_engine(cfg=None, *, lora=None, tenants=None, metrics=None,
+                **cfg_kw) -> ServingEngine:
+    cfg = cfg or tiny_cfg(cfg_kw.get("max_seq_len", 128))
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    defaults = dict(
+        max_slots=4, max_seq_len=128, prefill_buckets=(16,),
+        admission_per_step=4, max_queue=64,
+    )
+    defaults.update(cfg_kw)
+    return ServingEngine(
+        cfg, params, EngineConfig(**defaults), ByteTokenizer(cfg.vocab_size),
+        lora=lora, tenants=tenants, metrics=metrics,
+    )
+
+
+def two_adapter_registry(cfg) -> AdapterRegistry:
+    reg = AdapterRegistry(max_active=4)
+    reg.register(make_adapter(cfg, "tenant-a", rank=4, seed=1, scale=8.0))
+    reg.register(make_adapter(cfg, "tenant-b", rank=4, seed=2, scale=8.0))
+    return reg
+
+
+# -- policy layer (pure host) --------------------------------------------------
+
+def test_tenant_policy_class_defaults():
+    p = TenantPolicy(name="x", deadline_class="interactive")
+    assert p.priority == 0 and p.deadline_s == 2.0
+    p = TenantPolicy(name="y", deadline_class="batch")
+    assert p.priority == 2 and p.deadline_s == 60.0
+    with pytest.raises(ValueError):
+        TenantPolicy(name="z", deadline_class="nope")
+
+
+def test_token_bucket_refills_and_reports_retry():
+    b = TokenBucket(rate=100.0, burst=100.0)
+    ok, _ = b.take(100.0, now=0.0)
+    assert ok
+    ok, retry = b.take(50.0, now=0.0)
+    assert not ok and retry == pytest.approx(0.5)
+    ok, _ = b.take(50.0, now=1.0)  # 1s refilled 100, plenty
+    assert ok
+
+
+def test_registry_from_config_parses_policies():
+    class FakeConfig:
+        def __init__(self, env):
+            self.env = env
+
+        def get(self, key):
+            return self.env.get(key)
+
+        def get_or_default(self, key, default):
+            return self.env.get(key, default)
+
+    reg = TenantRegistry.from_config(FakeConfig({
+        "TPU_TENANT_POLICIES": "gold:interactive;bulk:batch:500",
+        "TPU_TENANT_INTERACTIVE_DEADLINE_S": "1.5",
+    }))
+    assert reg.policy("gold").deadline_s == 1.5
+    assert reg.policy("gold").priority == 0
+    assert reg.policy("bulk").token_rate == 500.0
+    # unknown tenants fall back to the default standard policy
+    assert reg.policy("stranger").deadline_class == "standard"
+    with pytest.raises(ValueError):
+        TenantRegistry.from_config(FakeConfig({
+            "TPU_TENANT_POLICIES": "broken",
+        }))
+
+
+def test_planner_grants_walk_priority_then_fifo():
+    planner = StepPlanner(chunk_tokens=8, block_steps=4, max_admissions=2)
+    batch_cur = ChunkCursor(req=None, slot=0, total=32, seq=0, priority=2)
+    gold_cur = ChunkCursor(req=None, slot=1, total=32, seq=1, priority=0)
+    plan = planner.plan(decode_rows=0, cursors=[batch_cur, gold_cur],
+                        free_slots=2, queue_depth=0)
+    # the later-admitted high class drains FIRST; budget (one chunk in
+    # auto mode) covers exactly one grant
+    assert plan.grants == [(1, 8)]
+
+
+# -- adapter registry ----------------------------------------------------------
+
+def test_adapter_registry_upload_pin_evict():
+    cfg = tiny_cfg()
+    reg = AdapterRegistry(max_active=3)  # 2 usable slots (0 = base)
+    for name, seed in (("a", 1), ("b", 2), ("c", 3)):
+        reg.register(make_adapter(cfg, name, rank=2, seed=seed))
+    assert reg.acquire(None) == 0  # base
+    sa = reg.acquire("a")
+    sb = reg.acquire("b")
+    assert sa != sb and sa > 0 and sb > 0
+    # both slots pinned: a third adapter cannot land — transient
+    with pytest.raises(AdapterBusy):
+        reg.acquire("c", timeout=5.0)
+    reg.release(sa)  # a's slot unpins → LRU-recyclable
+    sc = reg.acquire("c", timeout=10.0)
+    assert sc == sa  # recycled the unpinned slot
+    assert reg.residency()["resident"] == 2
+    with pytest.raises(UnknownAdapter):
+        reg.acquire("never-registered")
+    reg.close()
+
+
+def test_adapter_rank_mismatch_rejected():
+    reg = AdapterRegistry(max_active=3)
+    with pytest.raises(ValueError):
+        reg.register(type("A", (), {
+            "adapter_id": "bad",
+            "a": np.zeros((8, 4), np.float32),
+            "b": np.zeros((2, 16), np.float32),
+        })())
+    reg.close()
+
+
+# -- heterogeneous-adapter decode ---------------------------------------------
+
+@pytest.mark.parametrize("kv_layout", ["dense", "paged"])
+def test_heterogeneous_batch_token_identical_to_sequential(kv_layout):
+    """THE adapter-correctness acceptance: one batched dispatch serving
+    rows with different adapters produces exactly the tokens each row
+    would get from a sequential run of its own adapter."""
+    cfg = tiny_cfg()
+    reg = two_adapter_registry(cfg)
+    kw = dict(kv_layout=kv_layout)
+    if kv_layout == "paged":
+        kw.update(kv_page_size=8)
+    prompt = [5, 6, 7, 8]
+
+    eng = make_engine(cfg, lora=reg, **kw)
+    eng.start()
+    try:
+        seq = {}
+        for aid in (None, "tenant-a", "tenant-b"):
+            seq[aid] = eng.submit(
+                prompt, max_new_tokens=8, temperature=0.0, adapter_id=aid,
+            ).result(timeout=120).token_ids
+    finally:
+        eng.stop()
+
+    eng2 = make_engine(cfg, lora=reg, **kw)
+    eng2.start()
+    try:
+        futs = {
+            aid: eng2.submit(
+                prompt, max_new_tokens=8, temperature=0.0, adapter_id=aid,
+            )
+            for aid in (None, "tenant-a", "tenant-b")
+        }
+        batched = {
+            aid: fut.result(timeout=120).token_ids
+            for aid, fut in futs.items()
+        }
+    finally:
+        eng2.stop()
+
+    assert batched == seq
+    # and the adapters actually CHANGE the output (a zero delta would
+    # make this test vacuous)
+    assert seq["tenant-a"] != seq[None]
+    assert seq["tenant-a"] != seq["tenant-b"]
+    reg.close()
+
+
+def test_adapter_gathers_add_no_host_syncs(monkeypatch):
+    """The PR 6 contract under adapters: one host sync per N-step block,
+    no new device syncs from the adapter gathers (the delta runs inside
+    the fused dispatch)."""
+    from gofr_tpu.serving import engine as engine_mod
+
+    cfg = tiny_cfg()
+    reg = two_adapter_registry(cfg)
+    eng = make_engine(cfg, lora=reg, multi_step=4)
+    syncs = {"n": 0}
+    real = engine_mod._block_sync
+
+    def counting(value):
+        syncs["n"] += 1
+        return real(value)
+
+    monkeypatch.setattr(engine_mod, "_block_sync", counting)
+    eng.start()
+    try:
+        res = eng.submit(
+            [3, 4, 5], max_new_tokens=16, temperature=0.0,
+            adapter_id="tenant-a",
+        ).result(timeout=120)
+        assert len(res.token_ids) == 16
+    finally:
+        eng.stop()
+        reg.close()
+    # 16 tokens: 1 prefill-sampled + 15 through 4-step blocks → 4 block
+    # syncs (the 4th block retires the row at its budget), plus drain
+    # slack for a trailing dispatched-ahead block
+    assert syncs["n"] <= 6, syncs["n"]
+
+
+def test_prefix_cache_is_adapter_scoped():
+    """Same prompt under two adapters → two cache entries; a hit under
+    one adapter never serves the other (impossible by key construction)."""
+    cfg = tiny_cfg()
+    reg = two_adapter_registry(cfg)
+    eng = make_engine(cfg, lora=reg, prefix_cache_entries=8)
+    eng.start()
+    try:
+        prompt = [9, 10, 11]
+        eng.submit(prompt, max_new_tokens=2, temperature=0.0,
+                   adapter_id="tenant-a").result(timeout=120)
+        stats1 = eng._prefix_cache.stats()
+        eng.submit(prompt, max_new_tokens=2, temperature=0.0,
+                   adapter_id="tenant-b").result(timeout=120)
+        stats2 = eng._prefix_cache.stats()
+        # the second adapter's run was a MISS (no cross-adapter hit) and
+        # filed its own entry
+        assert stats2["entries"] == stats1["entries"] + 1
+        assert stats2["hits"] == stats1["hits"]
+        keys = eng._prefix_cache.keys()
+        assert any(k.endswith(":tenant-a") for k in keys)
+        assert any(k.endswith(":tenant-b") for k in keys)
+        # same-adapter re-run IS a hit
+        eng.submit(prompt, max_new_tokens=2, temperature=0.0,
+                   adapter_id="tenant-a").result(timeout=120)
+        assert eng._prefix_cache.stats()["hits"] == stats2["hits"] + 1
+    finally:
+        eng.stop()
+        reg.close()
+
+
+def test_unknown_adapter_is_a_client_error():
+    cfg = tiny_cfg()
+    reg = two_adapter_registry(cfg)
+    eng = make_engine(cfg, lora=reg)
+    eng.start()
+    try:
+        with pytest.raises(ErrorInvalidParam):
+            eng.submit([1, 2], adapter_id="no-such-adapter")
+        # and naming an adapter on an engine WITHOUT a registry is the
+        # same client error, not a crash
+        eng2 = make_engine(cfg)
+        eng2.start()
+        try:
+            with pytest.raises(ErrorInvalidParam):
+                eng2.submit([1, 2], adapter_id="tenant-a")
+        finally:
+            eng2.stop()
+    finally:
+        eng.stop()
+        reg.close()
+
+
+# -- tenant budgets + deadline classes ----------------------------------------
+
+def test_tenant_token_rate_budget_429():
+    """Per-tenant budget enforcement: an over-budget tenant is rejected
+    with 429 + Retry-After; other tenants are untouched."""
+    tenants = TenantRegistry()
+    tenants.set_policy(TenantPolicy(
+        name="metered", deadline_class="standard", token_rate=30.0,
+        burst_tokens=30.0, deadline_s=None,
+    ))
+    eng = make_engine(tenants=tenants)
+    eng.start()
+    try:
+        # first request drains the burst bucket (prompt 3 + max_new 27)
+        eng.submit([1, 2, 3], max_new_tokens=27, temperature=0.0,
+                   tenant="metered").result(timeout=120)
+        with pytest.raises(ErrorTooManyRequests) as exc_info:
+            eng.submit([1, 2, 3], max_new_tokens=27, tenant="metered")
+        assert exc_info.value.retry_after > 0
+        # an unmetered tenant still serves
+        res = eng.submit([1, 2, 3], max_new_tokens=2, temperature=0.0,
+                         tenant="other").result(timeout=120)
+        assert res.finish_reason in ("stop", "length")
+        assert tenants.rejections.get("metered") == 1
+    finally:
+        eng.stop()
+
+
+def test_tenant_deadline_class_fills_missing_deadline():
+    """A deadline-less request inherits its class default — the engine's
+    expired-while-queued and mid-stream expiry work for every tenant."""
+    tenants = TenantRegistry()
+    tenants.set_policy(TenantPolicy(
+        name="twitchy", deadline_class="interactive", deadline_s=1e-9,
+    ))
+    eng = make_engine(tenants=tenants)
+    eng.start()
+    try:
+        with pytest.raises(ErrorDeadlineExceeded):
+            eng.submit([1, 2, 3], max_new_tokens=4,
+                       tenant="twitchy").result(timeout=60)
+    finally:
+        eng.stop()
+
+
+def test_tenant_label_lands_on_timeline_and_metrics():
+    from gofr_tpu.metrics.register import Manager
+
+    m = Manager()
+    m.new_histogram("app_request_ttft_seconds", "t")
+    m.new_histogram("app_request_queue_wait_seconds", "q")
+    m.new_histogram("app_request_e2e_seconds", "e")
+    m.new_histogram("app_ttft_seconds", "t0")
+    m.new_histogram("app_tpot_seconds", "t1")
+    m.new_histogram("app_decode_block_seconds", "d")
+    m.new_counter("app_requests_shed_total", "s")
+    tenants = TenantRegistry()
+    eng = make_engine(tenants=tenants, metrics=m)
+    eng.start()
+    try:
+        fut = eng.submit([5, 6], max_new_tokens=2, temperature=0.0,
+                         tenant="acme")
+        fut.result(timeout=120)
+        tl = eng.timeline.get(fut.request_id)
+        assert tl.tenant == "acme"
+        assert tl.to_dict()["tenant"] == "acme"
+        _total, count = m.get("app_request_ttft_seconds").snapshot(
+            {"source": "engine", "tenant": "acme"}
+        )
+        assert count == 1
+        _total, count = m.get("app_request_e2e_seconds").snapshot(
+            {"tenant": "acme"}
+        )
+        assert count == 1
+    finally:
+        eng.stop()
+
+
+def test_http_and_grpc_kwargs_thread_tenancy():
+    """Transport plumbing: the HTTP body/header and gRPC body/metadata
+    forms all reach engine.submit as adapter_id/tenant kwargs."""
+    from gofr_tpu.grpcx.inference import InferenceService
+    from gofr_tpu.serving.handlers import (
+        GenerateRequest,
+        _request_kwargs,
+        _validated_generate_kwargs,
+    )
+
+    body = GenerateRequest(prompt="hi", adapter_id="a1", tenant="acme")
+    kw = _validated_generate_kwargs(body)
+    assert kw["adapter_id"] == "a1" and kw["tenant"] == "acme"
+    body2 = GenerateRequest(prompt="hi")
+    assert "adapter_id" not in _validated_generate_kwargs(body2)
+
+    class Ctx:
+        def __init__(self, headers):
+            self._h = headers
+
+        def header(self, name):
+            return self._h.get(name)
+
+    # the gateway's header stamp outranks the body claim
+    assert _request_kwargs(Ctx({"x-tenant-id": "gw"}), body)["tenant"] == "gw"
+    assert _request_kwargs(Ctx({}), body)["tenant"] == "acme"
+
+    svc = InferenceService()
+    kw = svc._gen_kwargs({"prompt": "x", "adapter_id": "a2",
+                          "tenant": "body-t"})
+    assert kw["adapter_id"] == "a2" and kw["tenant"] == "body-t"
+
+    class GrpcCtx:
+        def invocation_metadata(self):
+            return (("x-tenant-id", "meta-t"),)
+
+    kw = svc._gen_kwargs({"prompt": "x", "tenant": "body-t"}, GrpcCtx())
+    assert kw["tenant"] == "meta-t"
+
+
+# -- preemption ---------------------------------------------------------------
+
+def _storm_registries():
+    tenants = TenantRegistry()
+    # generous explicit deadlines: the class PRIORITIES drive these
+    # tests; CI wall-clock noise must not
+    tenants.set_policy(TenantPolicy(name="gold", deadline_class="interactive",
+                                    deadline_s=60.0))
+    tenants.set_policy(TenantPolicy(name="bulk", deadline_class="batch",
+                                    deadline_s=600.0))
+    return tenants
+
+
+def storm_cfg() -> llama.LlamaConfig:
+    """A bigger tiny config for the preemption tests: with vocab 64 the
+    greedy chain hits EOS within a few tokens and 'long' generations
+    retire instantly — vocab 256 / d 64 sustains full-length greedy
+    streams (asserted in the tests, so a vacuous run fails loudly)."""
+    return llama.LlamaConfig.tiny(max_seq_len=256)
+
+
+def test_preempt_resume_round_trip_preserves_tokens():
+    """A preempted row resumes warm (chunk-boundary page-out → prefix
+    cache) and its final token stream is IDENTICAL to an uninterrupted
+    run — emitted tokens preserved, nothing re-emitted, nothing lost."""
+    tenants = _storm_registries()
+    cfg = storm_cfg()
+    kw = dict(max_slots=1, max_seq_len=256, prefix_cache_entries=16,
+              prefill_chunk_tokens=8)
+    eng = make_engine(cfg, tenants=tenants, **kw)
+    eng.start()
+    try:
+        ctrl = eng.submit(list(range(2, 20)), max_new_tokens=80,
+                          temperature=0.0, tenant="bulk").result(timeout=120)
+        assert len(ctrl.token_ids) == 80, "greedy chain retired early"
+    finally:
+        eng.stop()
+
+    eng2 = make_engine(cfg, tenants=tenants, **kw)
+    eng2.start()
+    try:
+        eng2.submit([9, 9], max_new_tokens=2,
+                    temperature=0.0).result(timeout=120)  # warm the jit
+        got: list = []
+        f_low = eng2.submit(
+            list(range(2, 20)), max_new_tokens=80, temperature=0.0,
+            tenant="bulk", stream_cb=lambda t, s, d: got.append(t),
+        )
+        deadline = time.monotonic() + 60
+        while len(got) < 6 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert len(got) >= 6, "low-priority row never started decoding"
+        f_hi = eng2.submit([8, 9, 10], max_new_tokens=4, temperature=0.0,
+                           tenant="gold")
+        hi = f_hi.result(timeout=120)
+        low = f_low.result(timeout=120)
+        tl = eng2.timeline.get(f_low.request_id)
+        assert hi.finish_reason in ("stop", "length")
+        assert low.token_ids == ctrl.token_ids
+        stamps = [p for p in tl.phases if p.startswith("preempted")]
+        assert stamps, "expected the low-priority row to be preempted"
+    finally:
+        eng2.stop()
+
+
+def test_equal_classes_never_preempt_each_other():
+    tenants = _storm_registries()
+    eng = make_engine(tenants=tenants, max_slots=1, max_seq_len=128,
+                      prefix_cache_entries=16, prefill_chunk_tokens=8)
+    eng.start()
+    try:
+        eng.submit([9, 9], max_new_tokens=2, temperature=0.0).result(timeout=120)
+        got: list = []
+        f1 = eng.submit(list(range(2, 12)), max_new_tokens=60,
+                        temperature=0.0, tenant="bulk",
+                        stream_cb=lambda t, s, d: got.append(t))
+        deadline = time.monotonic() + 60
+        while len(got) < 4 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        f2 = eng.submit([8, 9], max_new_tokens=4, temperature=0.0,
+                        tenant="bulk")
+        f1.result(timeout=120)
+        f2.result(timeout=120)
+        tl = eng.timeline.get(f1.request_id)
+        assert not any(p.startswith("preempted") for p in tl.phases)
+    finally:
+        eng.stop()
+
+
+@pytest.mark.slow
+def test_preemption_ab_high_priority_meets_deadline_only_with_it():
+    """THE acceptance A/B (ISSUE 15): under a low-priority flood at ≥4x
+    decode capacity, high-priority requests meet their deadline class
+    with preemption enabled and MEASURABLY MISS with it disabled — the
+    preemption win is asserted against its own control, not assumed."""
+    import jax.numpy as jnp
+
+    # heavier tiny config: one 320-token batch-class generation takes a
+    # measurable ~0.4s of wall clock, so "deadline shorter than one flood
+    # generation, longer than the preemption path" has real room between
+    # the two — the CPU floor of the same contention geometry a TPU
+    # tenant storm has
+    ab_cfg = llama.LlamaConfig(
+        vocab_size=256, d_model=128, n_layers=4, n_heads=4, n_kv_heads=2,
+        d_ff=256, max_seq_len=512, dtype=jnp.float32,
+    )
+    flood_prompt = list(range(5, 17))  # sustains 320 greedy tokens
+
+    def run(preempt: bool):
+        tenants = _storm_registries()
+        eng = make_engine(
+            ab_cfg, tenants=tenants, max_slots=1, max_seq_len=512,
+            prefix_cache_entries=32, prefill_chunk_tokens=8,
+            tenant_preempt=preempt,
+        )
+        eng.start()
+        try:
+            eng.submit([9, 9], max_new_tokens=2,
+                       temperature=0.0).result(timeout=120)
+            # calibrate: one full low-priority generation's wall time
+            t0 = time.monotonic()
+            calib = eng.submit(flood_prompt, max_new_tokens=320,
+                               temperature=0.0, tenant="bulk").result(timeout=300)
+            t_low = time.monotonic() - t0
+            assert len(calib.token_ids) == 320, "greedy chain retired early"
+            # the flood: 4 long batch-class generations on ONE slot
+            got: list = []
+            floods = [
+                eng.submit(
+                    flood_prompt, max_new_tokens=320,
+                    temperature=0.0, tenant="bulk",
+                    stream_cb=(
+                        (lambda t, s, d: got.append(t)) if i == 0 else None
+                    ),
+                )
+                for i in range(4)
+            ]
+            deadline = time.monotonic() + 60
+            while len(got) < 4 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            # the high-priority deadline: far shorter than one flood
+            # generation (the miss case) but generous against preemption
+            # latency (a handful of engine iterations)
+            hi_deadline = max(0.15, 0.4 * t_low)
+            try:
+                hi = eng.submit(
+                    [8, 9, 10], max_new_tokens=4, temperature=0.0,
+                    tenant="gold", deadline=hi_deadline,
+                ).result(timeout=300)
+                met = hi.finish_reason in ("stop", "length")
+            except (ErrorDeadlineExceeded, ErrorTooManyRequests):
+                met = False
+            for f in floods:
+                try:
+                    f.result(timeout=300)
+                except (ErrorDeadlineExceeded, ErrorTooManyRequests):
+                    pass
+            return met, t_low
+        finally:
+            eng.stop()
+
+    met_with, t_low = run(preempt=True)
+    assert met_with, (
+        f"high-priority request missed its deadline WITH preemption "
+        f"(one low generation takes {t_low:.2f}s)"
+    )
+    met_without, t_low2 = run(preempt=False)
+    assert not met_without, (
+        f"high-priority request met its deadline WITHOUT preemption — "
+        f"the A/B shows no preemption effect (low gen {t_low2:.2f}s)"
+    )
+
+
+def test_preemption_counter_and_residency_gauge_register():
+    """metric-register-site: the new series are in the container catalog
+    and emit through the normal paths."""
+    from gofr_tpu.container.container import Container
+
+    c = Container(None)
+    assert c.metrics_manager.get("app_tenant_preemptions_total") is not None
+    assert c.metrics_manager.get("app_lora_adapter_residency") is not None
+    c.close()
+
+
+def test_preempt_pageout_never_serves_placeholder_logits():
+    """Review regression: a preemption page-out stores chunk spans with a
+    PLACEHOLDER logits column. A shorter request whose whole prompt
+    equals one of those boundary prefixes (same adapter) must not admit
+    straight to decode off the placeholder — the final-entry guard stops
+    the chain walk and the tail chunk recomputes, so its first token is
+    identical to an uninterrupted run's."""
+    tenants = _storm_registries()
+    cfg = storm_cfg()
+    kw = dict(max_slots=1, max_seq_len=256, prefix_cache_entries=32,
+              prefill_chunk_tokens=8, prefill_buckets=(16,))
+    long_prompt = list(range(2, 20))   # 18 tokens → chunks (0,8), (8,16)
+    short_prompt = long_prompt[:16]    # == a paged-out boundary prefix
+
+    # control: the short prompt served cold
+    eng = make_engine(cfg, tenants=tenants, **kw)
+    eng.start()
+    try:
+        ctrl = eng.submit(short_prompt, max_new_tokens=4,
+                          temperature=0.0).result(timeout=120)
+    finally:
+        eng.stop()
+
+    eng2 = make_engine(cfg, tenants=tenants, **kw)
+    eng2.start()
+    try:
+        eng2.submit([9, 9], max_new_tokens=2,
+                    temperature=0.0).result(timeout=120)
+        got: list = []
+        f_low = eng2.submit(
+            long_prompt, max_new_tokens=80, temperature=0.0,
+            tenant="bulk", stream_cb=lambda t, s, d: got.append(t),
+        )
+        deadline = time.monotonic() + 60
+        while len(got) < 6 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        f_hi = eng2.submit([8, 9, 10], max_new_tokens=4, temperature=0.0,
+                           tenant="gold")
+        f_hi.result(timeout=120)
+        tl = eng2.timeline.get(f_low.request_id)
+        f_low.result(timeout=120)
+        assert any(p.startswith("preempted") for p in tl.phases), \
+            "setup failed: the long request was never preempted"
+        # the paged-out spans are in the cache now; the short prompt must
+        # still produce the CONTROL tokens, not a placeholder-sampled one
+        res = eng2.submit(short_prompt, max_new_tokens=4,
+                          temperature=0.0).result(timeout=120)
+        assert res.token_ids == ctrl.token_ids
+    finally:
+        eng2.stop()
