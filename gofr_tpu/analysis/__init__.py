@@ -46,6 +46,24 @@ C++ TUs already run under ASan/UBSan/TSan (``make native-asan`` /
   during the chaos tier, fails on anything left live after drain, and
   exports observed acquire/release pairs (``GOFR_LEAK_EXPORT``) for
   the static coverage cross-check.
+- :mod:`gofr_tpu.analysis.deadlinecheck` — whole-program deadline-
+  propagation and bounded-wait analysis over a call graph rooted at the
+  request-serving entry points: a request-scoped deadline must bound
+  every blocking call on its path (``deadline-dropped``), transport
+  sites reachable from a serving entry must carry a finite bound
+  (``unbounded-wire-call``), retry/requeue loops must be governed by a
+  max-elapsed budget (``retry-unbudgeted``), waits on the cancel/drain
+  surface must be stop-Event-gated or bounded (``cancel-unreachable``),
+  and analyzer zone tables must not drift from the tree
+  (``zone-drift``); exports the static boundary table
+  (``--deadline-table``) the runtime tracer's observed crossings are
+  asserted a subset of (``--check-deadline-table``).
+- :mod:`gofr_tpu.analysis.deadlinetrace` — the runtime deadline tracer:
+  instruments budget crossings (router→replica, engine admission,
+  migrator fetch, LoRA acquire, SSE stream open) during the chaos tier,
+  fails on a widened budget or an expired request crossing a new
+  boundary, and exports observed sites for the static coverage
+  cross-check.
 - :mod:`gofr_tpu.analysis.sarif` — SARIF 2.1.0 output for the unified
   ``--all`` front door (``--format sarif``), for CI annotation.
 - :mod:`gofr_tpu.analysis.audit` — the stale-suppression audit

@@ -32,6 +32,7 @@ def _default_repo_root() -> str:
 
 
 def _list_rules() -> None:
+    from gofr_tpu.analysis import deadlinecheck as dc
     from gofr_tpu.analysis import leakcheck as lk
     from gofr_tpu.analysis import rules as rules_mod
     from gofr_tpu.analysis import shardcheck as sc
@@ -47,6 +48,21 @@ def _list_rules() -> None:
         ", ".join(sorted(sc.RETRACE_ZONE_FILES + sc.RETRACE_ZONE_DIRS)),
     )
     print("retire-gate zones:", ", ".join(sorted(lk.RETIRE_GATE_ZONES)))
+    print(
+        "deadline entry roots:",
+        ", ".join(sorted(
+            dc.ENTRY_FUNC_NAMES
+            | {f"{c}.*" for c in dc.ENTRY_CLASSES}
+            | set(dc.ENTRY_FILES)
+        )),
+    )
+    print(
+        "deadline boundaries:",
+        ", ".join(sorted(
+            {f"{c}.{m}" for c, ms in dc.BOUNDARY_CLASSES.items() for m in ms}
+            | dc.BOUNDARY_FUNCS
+        )),
+    )
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -130,6 +146,18 @@ def main(argv: list[str] | None = None) -> int:
         "table: every observed acquire/release site must be statically "
         "known",
     )
+    parser.add_argument(
+        "--deadline-table", action="store_true",
+        help="emit deadlinecheck's static boundary table as JSON (the "
+        "runtime deadline tracer's observed crossings must be a subset)",
+    )
+    parser.add_argument(
+        "--check-deadline-table", metavar="PATH", default=None,
+        help="verify a runtime deadline export "
+        "(gofr_tpu.analysis.deadlinetrace) is covered by the static "
+        "boundary table: every observed budget crossing must be "
+        "statically known, and the export must record zero violations",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -157,7 +185,8 @@ def main(argv: list[str] | None = None) -> int:
 
     if (
         args.lock_graph or args.check_lock_graph
-        or args.leak_table or args.check_leak_table or args.all
+        or args.leak_table or args.check_leak_table
+        or args.deadline_table or args.check_deadline_table or args.all
     ):
         # same path validation as the lint modes: a typo'd directory must
         # be a usage error, not an empty graph/table that vacuously
@@ -257,6 +286,53 @@ def main(argv: list[str] | None = None) -> int:
         print(
             f"leakcheck: runtime pairs covered by the static table "
             f"({len(runtime.get('events', []))} observed event(s) checked)"
+        )
+        return 0
+
+    if args.deadline_table:
+        from gofr_tpu.analysis.deadlinecheck import (
+            build_boundary_table,
+            render_table_json,
+        )
+
+        print(render_table_json(build_boundary_table(paths)))
+        return 0
+
+    if args.check_deadline_table:
+        import json as _json
+
+        from gofr_tpu.analysis.deadlinecheck import (
+            build_boundary_table,
+            check_deadline_coverage,
+        )
+
+        try:
+            with open(args.check_deadline_table, encoding="utf-8") as fp:
+                runtime = _json.load(fp)
+        except (OSError, ValueError) as exc:
+            print(
+                f"error: cannot read runtime deadline export "
+                f"{args.check_deadline_table}: {exc}",
+                file=sys.stderr,
+            )
+            return 2
+        divergences = check_deadline_coverage(
+            runtime, build_boundary_table(paths)
+        )
+        for d in divergences:
+            print(d)
+        if divergences:
+            print(
+                f"deadlinecheck: {len(divergences)} divergence(s) — "
+                "analyzer blind spot or a runtime budget violation "
+                "(docs/static-analysis.md#deadlinecheck)",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"deadlinecheck: runtime crossings covered by the static "
+            f"boundary table "
+            f"({len(runtime.get('events', []))} observed crossing(s) checked)"
         )
         return 0
 

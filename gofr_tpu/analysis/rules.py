@@ -999,6 +999,7 @@ class RouterRetryTypedRule(Rule):
 
 
 def default_rules() -> list[Rule]:
+    from gofr_tpu.analysis.deadlinecheck import deadlinecheck_rules
     from gofr_tpu.analysis.leakcheck import leakcheck_rules
     from gofr_tpu.analysis.lockcheck import lockcheck_rules
     from gofr_tpu.analysis.shardcheck import shardcheck_rules
@@ -1010,4 +1011,5 @@ def default_rules() -> list[Rule]:
         *shardcheck_rules(),
         *lockcheck_rules(),
         *leakcheck_rules(),
+        *deadlinecheck_rules(),
     ]

@@ -45,6 +45,11 @@ RULE_DESCRIPTIONS = {
     "leak-exception-path": "raise/return strands a resource mid-pair",
     "settle-on-raise": "raise after registration without settlement",
     "retire-gate-missing": "commit after blocking call without retire gate",
+    "deadline-dropped": "request deadline in scope but not derived into bound",
+    "unbounded-wire-call": "serving-reachable wait/wire call with no bound",
+    "retry-unbudgeted": "retry/requeue loop with no max-elapsed budget",
+    "cancel-unreachable": "cancel-path wait no stop Event can interrupt",
+    "zone-drift": "analyzer zone names a file/function that moved",
     "bad-transfer-annotation": "malformed leakcheck ownership annotation",
     "stale-suppression": "suppression matching no current finding",
     "bad-suppression": "gofrlint suppression without a reason",

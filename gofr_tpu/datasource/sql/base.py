@@ -153,6 +153,7 @@ class PooledSQLBase:
 
     def connect(self) -> None:
         pool = self._live_pool()
+        # gofrlint: disable=cancel-unreachable -- pool.acquire() is internally bounded by checkout_timeout and raises once close() flips _closed
         conn = pool.acquire()
         pool.release(conn)
         pool.start_ping_loop()
@@ -164,6 +165,7 @@ class PooledSQLBase:
     # -- pooled execution --------------------------------------------------
     def _execute(self, sql: str, args: tuple = ()) -> tuple[list, Any]:
         pool = self._live_pool()
+        # gofrlint: disable=cancel-unreachable -- pool.acquire() is internally bounded by checkout_timeout and raises once close() flips _closed
         conn = pool.acquire()
         try:
             out = self._conn_execute(conn, sql, args)
@@ -206,6 +208,7 @@ class PooledSQLBase:
 
     def begin(self) -> PooledTx:
         pool = self._live_pool()
+        # gofrlint: disable=cancel-unreachable -- pool.acquire() is internally bounded by checkout_timeout and raises once close() flips _closed
         conn = pool.acquire()
         try:
             self._conn_execute(conn, "BEGIN", ())

@@ -171,6 +171,7 @@ class SQLite:
         return bind_rows(self.query(sql, *args), target)
 
     def begin(self) -> Tx:
+        # gofrlint: disable=cancel-unreachable -- in-process mutex guarding a local sqlite handle; every hold is a short statement, never a wire wait
         self._lock.acquire()
         try:
             return Tx(self)

@@ -128,6 +128,7 @@ class _DeviceProber:
 
     def _loop(self) -> None:
         while not self._stop:
+            # gofrlint: disable=cancel-unreachable,unbounded-wire-call -- _req doubles as the stop wake: stop() sets _stop then _req.set(), so this wait IS the stop gate
             self._req.wait()
             self._req.clear()
             if self._stop:

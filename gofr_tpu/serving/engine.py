@@ -283,6 +283,14 @@ class _Request:
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
 
+    def remaining(self, now: float) -> float | None:
+        """Seconds left of the deadline (None when deadline-less),
+        clamped at 0.0 — the budget handed to a downstream wait is
+        never negative."""
+        if self.deadline is None:
+            return None
+        return max(self.deadline - now, 0.0)
+
     @property
     def serve_ids(self) -> list[int]:
         """The token sequence a (re-)admission must make KV-resident:
@@ -802,6 +810,7 @@ class ServingEngine:
             self._wake.set()
             # give the loop a short window to reclaim the canceled slots
             # before the thread is asked to exit
+            # gofrlint: disable=deadline-dropped -- post-deadline cleanup grace: the drain budget already elapsed, this constant bounds slot reclaim, not a request
             self._idle.wait(timeout=5.0)
         self.stop(join_timeout=join_timeout)
         return drained
@@ -1289,6 +1298,7 @@ class ServingEngine:
         # scheduler call while holding the mutex, fail fast and retriable
         # instead of piling every client thread up behind it forever
         try:
+            # gofrlint: disable=deadline-dropped -- deliberate constant: bounds a wedged-scheduler pile-up with a fast retriable 503; the request's own deadline is enforced by expired-while-queued
             if not self._submit_mu.acquire(timeout=5.0):
                 raise ErrorServiceUnavailable(
                     "engine busy; retry on another replica", retry_after=1.0
@@ -1612,8 +1622,19 @@ class ServingEngine:
                         # pin the adapter's device-table slot for the
                         # life of the row; every table slot pinned (or a
                         # faulted async upload) is TRANSIENT — requeue
-                        # exactly like KV-pool pressure
-                        req.adapter_slot = self._lora.acquire(req.adapter_id)
+                        # exactly like KV-pool pressure. The wait is
+                        # clamped to the request's remaining deadline: a
+                        # slow upload degrades to AdapterBusy → requeue,
+                        # and the expired-while-queued check 504s the
+                        # request next round instead of letting the
+                        # acquire outlive it
+                        budget = 5.0
+                        rem = req.remaining(time.perf_counter())
+                        if rem is not None:
+                            budget = min(budget, rem)
+                        req.adapter_slot = self._lora.acquire(
+                            req.adapter_id, timeout=budget
+                        )
                     except AdapterBusy:
                         raise _RequeueRequest() from None
                 if self._route_chunked(len(req.serve_ids)):
@@ -1816,12 +1837,17 @@ class ServingEngine:
                 # this exact prefill — migrate its slabs instead of
                 # recomputing (either failure stays a compute miss)
                 fetched = None
+                # the fetch is bounded by what the request has left: an
+                # expired one degrades to a compute miss without a fetch
+                budget = req.remaining(time.perf_counter())
                 if req.handoff_from is not None:
                     fetched = self._kv_migrator.fetch_one_handoff(
-                        cache_key, req.handoff_from
+                        cache_key, req.handoff_from, deadline=budget
                     )
                 if fetched is None:
-                    fetched = self._kv_migrator.fetch_one(cache_key)
+                    fetched = self._kv_migrator.fetch_one(
+                        cache_key, deadline=budget
+                    )
                 # the fetch can block (remote transport timeout): a warm
                 # restart may have retired this thread meanwhile — the
                 # put below would poison the cache the restart just
@@ -2027,9 +2053,12 @@ class ServingEngine:
                 # advisory tiers below degrade to re-prefill.
                 remaining = [b for b in boundaries if b[0] >= pos]
                 fetched = []
+                # bounded by the request's remaining deadline, exactly
+                # like the monolithic path's handoff/advisory fetches
+                budget = req.remaining(time.perf_counter())
                 if req.handoff_from is not None:
                     fetched = self._kv_migrator.fetch_handoff(
-                        remaining, req.handoff_from
+                        remaining, req.handoff_from, deadline=budget
                     )
                 if not fetched:
                     # cluster tier: migrate the longest advertised
@@ -2039,7 +2068,9 @@ class ServingEngine:
                     # and the planner's chunk grants compute the rest
                     # (never a double-prefill: committed spans stay
                     # contiguous).
-                    fetched = self._kv_migrator.fetch_chain(remaining)
+                    fetched = self._kv_migrator.fetch_chain(
+                        remaining, deadline=budget
+                    )
                 # the fetch can block (remote transport timeout): a
                 # retired thread must not put dead slabs into the
                 # replacement engine's freshly-reset cache
@@ -2169,6 +2200,7 @@ class ServingEngine:
         except KeyError:
             pass
         try:
+            # gofrlint: disable=retry-unbudgeted -- expiry is gated upstream: _cursor_health checks req.expired before every pressure requeue, and admission re-checks it next round (504)
             sched.submit(
                 req.id, len(req.serve_ids), req.max_new_tokens,
                 req.priority, front=True,

@@ -229,13 +229,16 @@ class KVMigrator:
 
     def __init__(self, replica_id: str, index: PrefixIndex, *,
                  logger: Any = None, metrics: Any = None,
-                 failure_backoff_s: float = 5.0) -> None:
+                 failure_backoff_s: float = 5.0,
+                 fetch_timeout_s: float = 2.0) -> None:
         self.replica_id = replica_id
         self.index = index
         self._logger = logger
         self._metrics = metrics
         self.failure_backoff_s = failure_backoff_s
+        self.fetch_timeout_s = fetch_timeout_s
         self._peers: dict[str, Callable[[list[str]], dict[str, tuple]]] = {}
+        self._peer_bounded: dict[str, bool] = {}
         self._suppressed_until: dict[str, float] = {}
         self.migrations_total = 0
         self.handoffs_total = 0
@@ -244,18 +247,46 @@ class KVMigrator:
     def add_peer(self, replica_id: str,
                  fetch: Callable[[list[str]], dict[str, tuple]]) -> None:
         self._peers[replica_id] = fetch
+        # a remote transport fetcher (HTTPReplica.fetch_kv) takes a
+        # timeout kwarg; a local peek-based one doesn't block at all.
+        # Detected once here so fetch_chain can clamp the wire wait to
+        # the request's remaining deadline without changing the plain
+        # fetch(keys) peer contract.
+        try:
+            import inspect
+
+            self._peer_bounded[replica_id] = (
+                "timeout" in inspect.signature(fetch).parameters
+            )
+        except (TypeError, ValueError):
+            self._peer_bounded[replica_id] = False
 
     def remove_peer(self, replica_id: str) -> None:
         self._peers.pop(replica_id, None)
+        self._peer_bounded.pop(replica_id, None)
+
+    def _peer_kwargs(self, replica_id: str,
+                     deadline: float | None) -> dict[str, float]:
+        if not self._peer_bounded.get(replica_id):
+            return {}
+        if deadline is None:
+            return {"timeout": self.fetch_timeout_s}
+        return {"timeout": min(self.fetch_timeout_s, deadline)}
 
     def fetch_chain(
-        self, boundaries: list[tuple[int, int, str]]
+        self, boundaries: list[tuple[int, int, str]],
+        deadline: float | None = None,
     ) -> list[tuple[int, int, tuple]]:
         """Fetch the longest advertised contiguous run of chunk-boundary
         entries for ``boundaries`` ([(start, end, key), ...], the
         engine's remaining un-cached chain). Returns [(start, end,
         value), ...], contiguous from the first boundary — possibly
-        empty, never raising."""
+        empty, never raising. ``deadline`` is the request's remaining
+        budget in seconds: an already-expired request degrades to a
+        compute miss without touching the wire, and a bounded peer's
+        transport timeout is clamped to it."""
+        if deadline is not None and deadline <= 0:
+            return []  # expired: never block admission on a dead request
         if not boundaries or not self._peers:
             return []
         keys = [key for _s, _e, key in boundaries]
@@ -272,7 +303,10 @@ class KVMigrator:
         want = boundaries[:n]
         try:
             chaos.maybe_fail("kv.migrate")
-            fetched = fetch([key for _s, _e, key in want])
+            fetched = fetch(
+                [key for _s, _e, key in want],
+                **self._peer_kwargs(rid, deadline),
+            )
         except Exception as exc:
             # the source died mid-transfer (or the chaos point fired):
             # nothing was committed — a clean degrade to re-prefill,
@@ -300,15 +334,17 @@ class KVMigrator:
                 self._metrics.increment_counter("app_kv_migrations_total")
         return out
 
-    def fetch_one(self, key: str) -> tuple | None:
+    def fetch_one(self, key: str,
+                  deadline: float | None = None) -> tuple | None:
         """Single-entry fetch (the whole-prompt/monolithic prefill
         path). Same advisory contract as :meth:`fetch_chain`."""
-        got = self.fetch_chain([(0, 0, key)])
+        got = self.fetch_chain([(0, 0, key)], deadline=deadline)
         return got[0][2] if got else None
 
     # -- disaggregated prefill→decode handoff ----------------------------------
     def fetch_handoff(
-        self, boundaries: list[tuple[int, int, str]], source: str
+        self, boundaries: list[tuple[int, int, str]], source: str,
+        deadline: float | None = None,
     ) -> list[tuple[int, int, tuple]]:
         """The prefill→decode KV handoff fetch (docs/robustness.md "The
         disaggregation plane"): pull ``boundaries`` from the NAMED
@@ -322,7 +358,12 @@ class KVMigrator:
 
         The ``kv.handoff`` chaos point models the source dying (or the
         transport tearing) mid-handoff; a failed source is suppressed
-        for ``failure_backoff_s`` exactly like the advisory tier."""
+        for ``failure_backoff_s`` exactly like the advisory tier.
+        ``deadline`` follows the :meth:`fetch_chain` contract: expired →
+        degrade without touching the wire, bounded peer → clamped
+        transport timeout."""
+        if deadline is not None and deadline <= 0:
+            return []
         if not boundaries:
             return []
         fetch = self._peers.get(source)
@@ -333,7 +374,10 @@ class KVMigrator:
             return []
         try:
             chaos.maybe_fail("kv.handoff")
-            fetched = fetch([key for _s, _e, key in boundaries])
+            fetched = fetch(
+                [key for _s, _e, key in boundaries],
+                **self._peer_kwargs(source, deadline),
+            )
         except Exception as exc:
             self.failed_fetches_total += 1
             self._suppressed_until[source] = (
@@ -365,9 +409,10 @@ class KVMigrator:
             self._metrics.increment_counter("app_kv_handoffs_total")
         return out
 
-    def fetch_one_handoff(self, key: str, source: str) -> tuple | None:
+    def fetch_one_handoff(self, key: str, source: str,
+                          deadline: float | None = None) -> tuple | None:
         """Monolithic-prompt handoff: the single whole-prompt prefill
         entry from the named source — present and well-formed, or None
         (re-prefill). Same 2PC/backoff contract as :meth:`fetch_handoff`."""
-        got = self.fetch_handoff([(0, 1, key)], source)
+        got = self.fetch_handoff([(0, 1, key)], source, deadline=deadline)
         return got[0][2] if got else None

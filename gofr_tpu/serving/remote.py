@@ -36,6 +36,7 @@ stream_cb contract expects.
 from __future__ import annotations
 
 import json
+import time
 from typing import Any, Callable
 
 from gofr_tpu import chaos
@@ -71,12 +72,23 @@ def error_from_status(status: int, detail: str,
     return RuntimeError(detail)
 
 
-def iter_events(resp: Any) -> Any:
+def iter_events(resp: Any, deadline_abs: float | None = None) -> Any:
     """Parse SSE ``data:`` frames off a streaming response, yielding
     each decoded JSON event as it arrives; returns at ``[DONE]`` or
     stream end. Unparseable frames are skipped (forward compatibility:
-    a newer server may interleave event types this client predates)."""
+    a newer server may interleave event types this client predates).
+
+    ``deadline_abs`` (``time.monotonic()`` terms) bounds the WHOLE
+    stream, not just each socket read: the open-time ``timeout`` only
+    caps per-read stalls, so without this gate an expired request keeps
+    the remote decode — and this worker thread — running to the final
+    frame. Checked between frames; the in-flight read still ends within
+    one socket timeout."""
     for line in resp.lines():
+        if deadline_abs is not None and time.monotonic() > deadline_abs:
+            raise ErrorDeadlineExceeded(
+                "remote stream exceeded the request deadline between frames"
+            )
         if not line.startswith("data:"):
             continue  # SSE comments / keepalives
         payload = line[5:].strip()
@@ -114,6 +126,13 @@ def run_stream(
     (late deadline/drain, delivered as events because the 200 head was
     already on the wire), and ``ConnectionError`` for a stream that
     tore before its terminal frame."""
+    # the request's whole-stream budget: `timeout` is the caller's
+    # remaining deadline (HTTPReplica passes its deadline through), so
+    # it bounds the open AND the frame loop — per-read socket stalls
+    # are capped by the transport, the total by this clock
+    deadline_abs = (
+        time.monotonic() + timeout if timeout is not None else None
+    )
     resp = svc.stream(
         "POST", path, json=payload, headers=headers, timeout=timeout,
     )
@@ -131,7 +150,7 @@ def run_stream(
         )
     terminal: dict[str, Any] | None = None
     try:
-        for event in iter_events(resp):
+        for event in iter_events(resp, deadline_abs=deadline_abs):
             if "error" in event:
                 raise error_from_status(
                     int(event.get("status") or 0), str(event["error"])

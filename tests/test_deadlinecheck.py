@@ -1,0 +1,571 @@
+"""deadlinecheck (gofr_tpu/analysis/deadlinecheck.py): the whole-program
+deadline-propagation and bounded-wait analyzer — deadline-dropped,
+unbounded-wire-call, retry-unbudgeted, cancel-unreachable over a call
+graph rooted at the serving entry points, plus the zone-drift audit of
+the sibling analyzers' zone tables, the static boundary table the
+runtime deadline tracer is cross-checked against, suppressions, and the
+unified ``--all`` wiring. docs/static-analysis.md#deadlinecheck
+documents the catalog these pin down.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from gofr_tpu.analysis import baseline_io
+from gofr_tpu.analysis.core import run_rules, run_unified
+from gofr_tpu.analysis.deadlinecheck import (
+    ZoneDriftRule,
+    build_boundary_table,
+    check_deadline_coverage,
+    deadlinecheck_rules,
+    render_table_json,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_tree(tmp_path, files: dict[str, str]):
+    """Materialize {relpath: source} under tmp_path and lint the top dir
+    with the deadlinecheck families only (fixture isolation from the
+    other rule sets)."""
+    for rel, source in files.items():
+        full = tmp_path / rel
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_text(source)
+    top = tmp_path / sorted(files)[0].split("/")[0]
+    return run_rules([str(top)], deadlinecheck_rules())
+
+
+def rules_of(findings):
+    return sorted(f.rule for f in findings)
+
+
+# ------------------------------------------------------- deadline-dropped
+def test_constant_timeout_while_deadline_in_scope(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "class W:\n"
+            "    def run(self, payload, deadline):\n"
+            "        fut = self.pool_start(payload)\n"
+            "        return fut.result(timeout=5.0)\n"
+        ),
+    })
+    assert "deadline-dropped" in rules_of(findings)
+    assert any(f.line == 4 and "constant timeout=" in f.message
+               for f in findings)
+
+
+def test_no_bound_while_request_deadline_in_scope(tmp_path):
+    # no deadline PARAM — the function consults the request object's
+    # deadline surface (req.expired), which is the same evidence: the
+    # engine-admission LoRA-acquire class
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "class Engine:\n"
+            "    def admit(self, req, now):\n"
+            "        if req.expired(now):\n"
+            "            return\n"
+            "        req.slot = self._lora.acquire(req.adapter_id)\n"
+        ),
+    })
+    assert "deadline-dropped" in rules_of(findings)
+    assert any(f.line == 5 and "no bound at all" in f.message
+               for f in findings)
+
+
+def test_derived_bound_is_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "import time\n"
+            "class W:\n"
+            "    def run(self, payload, deadline):\n"
+            "        start = time.monotonic()\n"
+            "        left = deadline - (time.monotonic() - start)\n"
+            "        budget = min(5.0, left)\n"
+            "        fut = self.pool_start(payload)\n"
+            "        return fut.result(timeout=budget)\n"
+        ),
+    })
+    assert [f for f in findings if f.rule == "deadline-dropped"] == []
+
+
+def test_deadline_forwarded_into_callee_is_clean(tmp_path):
+    # the deadline rides into the callee as a kwarg — not dropped even
+    # though no timeout= appears at this frame
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "class R:\n"
+            "    def route(self, prompt, deadline):\n"
+            "        left = self.clamp(deadline)\n"
+            "        return handle.submit(prompt, deadline=left)\n"
+        ),
+    })
+    assert [f for f in findings if f.rule == "deadline-dropped"] == []
+
+
+def test_no_deadline_in_scope_not_applicable(tmp_path):
+    # rule 1 only fires when a deadline IS in scope; a constant bound in
+    # a deadline-less helper is rule 2's (reachability-gated) business
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "class C:\n"
+            "    def ping(self):\n"
+            "        return self._svc.post('/ping', json={}, timeout=2.0)\n"
+        ),
+    })
+    assert [f for f in findings if f.rule == "deadline-dropped"] == []
+
+
+# ---------------------------------------------------- unbounded-wire-call
+def test_result_without_timeout_reachable_from_submit(tmp_path):
+    # cross-file reachability: submit (a serving root) -> helper
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "from gofr_tpu.svc.b import helper\n"
+            "def submit(payload):\n"
+            "    return helper(payload)\n"
+        ),
+        "gofr_tpu/svc/b.py": (
+            "def helper(payload):\n"
+            "    fut = start(payload)\n"
+            "    return fut.result()\n"
+        ),
+    })
+    assert "unbounded-wire-call" in rules_of(findings)
+    assert any(f.path.endswith("b.py") and f.line == 3 for f in findings)
+
+
+def test_frame_loop_without_deadline_gate(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "def stream(resp, on_token):\n"
+            "    for line in resp.lines():\n"
+            "        on_token(line)\n"
+        ),
+    })
+    assert "unbounded-wire-call" in rules_of(findings)
+    assert any("stream frames" in f.message for f in findings)
+
+
+def test_bounded_result_is_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "def submit(payload):\n"
+            "    fut = start(payload)\n"
+            "    return fut.result(timeout=2.0)\n"
+        ),
+    })
+    assert [f for f in findings if f.rule == "unbounded-wire-call"] == []
+
+
+def test_unreachable_wait_is_clean(tmp_path):
+    # the same unbounded .result(), but nothing on the serving surface
+    # calls it — reachability gates the rule
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "def offline_job(payload):\n"
+            "    fut = start(payload)\n"
+            "    return fut.result()\n"
+        ),
+    })
+    assert [f for f in findings if f.rule == "unbounded-wire-call"] == []
+
+
+def test_frame_loop_with_deadline_gate_is_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "import time\n"
+            "def stream(resp, on_token, deadline_abs):\n"
+            "    for line in resp.lines():\n"
+            "        if deadline_abs is not None and "
+            "time.monotonic() > deadline_abs:\n"
+            "            raise TimeoutError\n"
+            "        on_token(line)\n"
+        ),
+    })
+    assert [f for f in findings if f.rule == "unbounded-wire-call"] == []
+
+
+def test_done_callback_result_is_clean(tmp_path):
+    # .exception() consulted on the same future first: the done-callback
+    # idiom — result() cannot block
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "def submit(fut):\n"
+            "    exc = fut.exception()\n"
+            "    if exc is None:\n"
+            "        return fut.result()\n"
+            "    raise exc\n"
+        ),
+    })
+    assert [f for f in findings if f.rule == "unbounded-wire-call"] == []
+
+
+# ------------------------------------------------------- retry-unbudgeted
+def test_bare_retry_loop_flagged(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "def pump(conn):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            conn.send_frame()\n"
+            "        except ConnectionError:\n"
+            "            conn = redial()\n"
+            "            continue\n"
+        ),
+    })
+    assert "retry-unbudgeted" in rules_of(findings)
+    assert any("no budget" in f.message for f in findings)
+
+
+def test_requeue_without_expiry_check_flagged(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "def back_to_queue(sched, item):\n"
+            "    sched.submit(item.id, item.size, front=True)\n"
+        ),
+    })
+    assert "retry-unbudgeted" in rules_of(findings)
+    assert any("never checks request expiry" in f.message for f in findings)
+
+
+def test_attempt_bounded_retry_is_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "def pump(conn, max_retries):\n"
+            "    tries = 0\n"
+            "    while tries < max_retries:\n"
+            "        try:\n"
+            "            return conn.send_frame()\n"
+            "        except ConnectionError:\n"
+            "            tries += 1\n"
+            "            continue\n"
+        ),
+    })
+    assert [f for f in findings if f.rule == "retry-unbudgeted"] == []
+
+
+def test_stop_gated_maintenance_loop_is_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "def pump(self):\n"
+            "    while not self._stop.is_set():\n"
+            "        try:\n"
+            "            self.poll()\n"
+            "        except ConnectionError:\n"
+            "            continue\n"
+        ),
+    })
+    assert [f for f in findings if f.rule == "retry-unbudgeted"] == []
+
+
+def test_requeue_with_expiry_gate_is_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "def back_to_queue(sched, item, now):\n"
+            "    if item.expired(now):\n"
+            "        return\n"
+            "    sched.submit(item.id, item.size, front=True)\n"
+        ),
+    })
+    assert [f for f in findings if f.rule == "retry-unbudgeted"] == []
+
+
+# ----------------------------------------------------- cancel-unreachable
+def test_unbounded_join_on_stop_path(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "class W:\n"
+            "    def stop(self):\n"
+            "        self._thread.join()\n"
+        ),
+    })
+    assert "cancel-unreachable" in rules_of(findings)
+    assert any(f.line == 3 for f in findings)
+
+
+def test_unbounded_wait_reachable_from_drain(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "class W:\n"
+            "    def drain(self):\n"
+            "        self._flush()\n"
+            "    def _flush(self):\n"
+            "        self._flushed_ev.wait()\n"
+        ),
+    })
+    assert "cancel-unreachable" in rules_of(findings)
+    assert any(f.line == 5 for f in findings)
+
+
+def test_bounded_join_on_stop_path_is_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "class W:\n"
+            "    def stop(self, join_timeout=2.0):\n"
+            "        self._thread.join(timeout=join_timeout)\n"
+        ),
+    })
+    assert [f for f in findings if f.rule == "cancel-unreachable"] == []
+
+
+def test_stop_event_wait_is_clean(tmp_path):
+    # waiting ON the stop signal is interruptible by definition
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "class W:\n"
+            "    def shutdown(self):\n"
+            "        self._done.wait()\n"
+        ),
+    })
+    assert [f for f in findings if f.rule == "cancel-unreachable"] == []
+
+
+def test_wait_off_the_cancel_surface_is_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "class W:\n"
+            "    def crunch(self):\n"
+            "        self._batch_ev.wait()\n"
+        ),
+    })
+    assert [f for f in findings if f.rule == "cancel-unreachable"] == []
+
+
+# ------------------------------------------------------------- zone-drift
+def _zone_lint(tmp_path, files, zones, anchor="gofr_tpu/svc/anchor.py"):
+    for rel, source in files.items():
+        full = tmp_path / rel
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_text(source)
+    top = tmp_path / sorted(files)[0].split("/")[0]
+    return run_rules(
+        [str(top)], [ZoneDriftRule(zones=zones, anchor=anchor)]
+    )
+
+
+def test_zone_names_missing_file(tmp_path):
+    findings = _zone_lint(
+        tmp_path,
+        {"gofr_tpu/svc/anchor.py": "def live():\n    pass\n"},
+        zones=[("FAKE_ZONES", "gofr_tpu/analysis/rules.py",
+                {"gofr_tpu/svc/moved_away.py": "*"})],
+    )
+    assert rules_of(findings) == ["zone-drift"]
+    assert "no longer exists in the scanned tree" in findings[0].message
+
+
+def test_zone_names_missing_function(tmp_path):
+    findings = _zone_lint(
+        tmp_path,
+        {"gofr_tpu/svc/anchor.py": "def live():\n    pass\n"},
+        zones=[("FAKE_ZONES", "gofr_tpu/analysis/rules.py",
+                {"gofr_tpu/svc/anchor.py": {"live", "renamed_away"}})],
+    )
+    assert rules_of(findings) == ["zone-drift"]
+    assert "'renamed_away'" in findings[0].message
+
+
+def test_zone_matching_tree_is_clean(tmp_path):
+    findings = _zone_lint(
+        tmp_path,
+        {"gofr_tpu/svc/anchor.py": (
+            "def live():\n    pass\n\ndef also_live():\n    pass\n"
+        )},
+        zones=[("FAKE_ZONES", "gofr_tpu/analysis/rules.py",
+                {"gofr_tpu/svc/anchor.py": {"live", "also_live"}})],
+    )
+    assert findings == []
+
+
+def test_zone_drift_gated_on_anchor(tmp_path):
+    # fixture trees without the anchor file must not trip the real
+    # tables: the rule stays inert
+    findings = _zone_lint(
+        tmp_path,
+        {"gofr_tpu/svc/other.py": "def live():\n    pass\n"},
+        zones=[("FAKE_ZONES", "gofr_tpu/analysis/rules.py",
+                {"gofr_tpu/svc/moved_away.py": "*"})],
+        anchor="gofr_tpu/svc/anchor.py",
+    )
+    assert findings == []
+
+
+def test_default_zones_inert_on_fixture_engine(tmp_path):
+    # a fixture tree materializing a file NAMED like the anchor (the
+    # shardcheck fixtures do) must not arm the real zone tables: the
+    # anchor must also DEFINE ServingEngine
+    for rel, source in {
+        "gofr_tpu/serving/engine.py": "def drive(cache):\n    return cache\n",
+    }.items():
+        full = tmp_path / rel
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_text(source)
+    findings = run_rules([str(tmp_path / "gofr_tpu")], [ZoneDriftRule()])
+    assert findings == []
+
+
+def test_real_zone_tables_match_real_tree():
+    """The satellite's point: every DISPATCH/BACKOFF/ROUTER_RETRY/
+    HOT_SYNC/RETRACE/RETIRE_GATE zone entry still names a live file and
+    live functions."""
+    findings = run_rules(
+        [os.path.join(REPO_ROOT, "gofr_tpu")], [ZoneDriftRule()]
+    )
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ------------------------------------------------------------ suppression
+def test_suppression_with_reason_is_honored(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "class W:\n"
+            "    def run(self, payload, deadline):\n"
+            "        fut = self.pool_start(payload)\n"
+            "        # gofrlint: disable=deadline-dropped -- grace wait\n"
+            "        return fut.result(timeout=5.0)\n"
+        ),
+    })
+    assert [f for f in findings if f.rule == "deadline-dropped"] == []
+
+
+def test_cross_file_finding_suppressible(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "def submit(payload):\n"
+            "    fut = start(payload)\n"
+            "    # gofrlint: disable=unbounded-wire-call -- settled upstream\n"
+            "    return fut.result()\n"
+        ),
+    })
+    assert [f for f in findings if f.rule == "unbounded-wire-call"] == []
+
+
+# ------------------------------------------------- real tree & the gate
+def test_real_tree_clean():
+    """The acceptance bar: the repo itself is deadlinecheck-clean (the
+    SSE frame loop, the migrator fetches, and the LoRA acquire are
+    deadline-bounded; deliberate waits are suppressed with reasons)."""
+    findings = run_rules(
+        [os.path.join(REPO_ROOT, "gofr_tpu")], deadlinecheck_rules()
+    )
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_unified_pass_includes_deadline_rules():
+    from gofr_tpu.analysis.rules import default_rules
+
+    names = {r.name for r in default_rules()}
+    assert {
+        "deadline-dropped", "unbounded-wire-call", "retry-unbudgeted",
+        "cancel-unreachable", "zone-drift",
+    } <= names
+
+
+def test_unified_run_keeps_deadline_suppressions_live(tmp_path):
+    # run_unified shows rules the RAW view and post-filters: the
+    # suppression must both hide the finding and register as live
+    for rel, source in {
+        "gofr_tpu/svc/a.py": (
+            "class W:\n"
+            "    def run(self, payload, deadline):\n"
+            "        fut = self.pool_start(payload)\n"
+            "        # gofrlint: disable=deadline-dropped -- grace\n"
+            "        return fut.result(timeout=5.0)\n"
+        ),
+    }.items():
+        full = tmp_path / rel
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_text(source)
+    live, stale = run_unified(
+        [str(tmp_path / "gofr_tpu")], deadlinecheck_rules()
+    )
+    assert [f for f in live if f.rule == "deadline-dropped"] == []
+    assert stale == [], "\n".join(f.render() for f in stale)
+
+
+def test_findings_roundtrip_json_and_sarif(tmp_path):
+    from gofr_tpu.analysis.sarif import render_sarif
+
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "class W:\n"
+            "    def run(self, payload, deadline):\n"
+            "        fut = self.pool_start(payload)\n"
+            "        return fut.result(timeout=5.0)\n"
+        ),
+    })
+    assert findings
+    blob = json.loads(baseline_io.render_json(findings))
+    assert any(e["rule"] == "deadline-dropped" for e in blob["findings"])
+    sarif = json.loads(render_sarif(findings))
+    results = sarif["runs"][0]["results"]
+    assert any(r["ruleId"] == "deadline-dropped" for r in results)
+
+
+def test_baseline_covers_deadline_findings(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "gofr_tpu/svc/a.py": (
+            "class W:\n"
+            "    def run(self, payload, deadline):\n"
+            "        fut = self.pool_start(payload)\n"
+            "        return fut.result(timeout=5.0)\n"
+        ),
+    })
+    assert findings
+    path = str(tmp_path / "baseline.json")
+    baseline_io.write_baseline(path, findings)
+    left, covered = baseline_io.apply_baseline(
+        findings, baseline_io.load_baseline(path)
+    )
+    assert left == [] and covered == len(findings)
+
+
+# ------------------------------------------- boundary table & cross-check
+def test_boundary_table_contains_known_sites():
+    table = build_boundary_table([os.path.join(REPO_ROOT, "gofr_tpu")])
+    sites = table["sites"]
+    for site, path_prefix in {
+        "Router.submit": "gofr_tpu/serving/router.py:",
+        "LocalReplica.submit": "gofr_tpu/serving/router.py:",
+        "HTTPReplica.submit": "gofr_tpu/serving/router.py:",
+        "HTTPReplica.fetch_kv": "gofr_tpu/serving/router.py:",
+        "ServingEngine.submit": "gofr_tpu/serving/engine.py:",
+        "KVMigrator.fetch_chain": "gofr_tpu/serving/prefix_index.py:",
+        "KVMigrator.fetch_handoff": "gofr_tpu/serving/prefix_index.py:",
+        "AdapterRegistry.acquire": "gofr_tpu/serving/lora.py:",
+        "remote.run_stream": "gofr_tpu/serving/remote.py:",
+    }.items():
+        assert site in sites, site
+        assert sites[site].startswith(path_prefix), (site, sites[site])
+    json.loads(render_table_json(table))  # stable JSON
+
+
+def test_coverage_flags_unknown_site_and_violations():
+    table = {"version": 1, "sites": {"Router.submit": "x.py:1"}}
+    runtime = {
+        "events": [
+            {"site": "Router.submit", "op": "crossing"},
+            {"site": "Mystery.hop", "op": "crossing"},
+        ],
+        "violations": ["budget widened at Mystery.hop: ..."],
+    }
+    divergences = check_deadline_coverage(runtime, table)
+    assert any("Mystery.hop" in d and "unknown boundary" in d
+               for d in divergences)
+    assert any(d.startswith("runtime budget violation:")
+               for d in divergences)
+
+
+def test_coverage_clean_when_subset():
+    table = build_boundary_table([os.path.join(REPO_ROOT, "gofr_tpu")])
+    runtime = {
+        "events": [
+            {"site": "Router.submit", "op": "crossing"},
+            {"site": "ServingEngine.submit", "op": "crossing"},
+        ],
+        "violations": [],
+    }
+    assert check_deadline_coverage(runtime, table) == []

@@ -1,0 +1,309 @@
+"""deadlinetrace (gofr_tpu/analysis/deadlinetrace.py): the runtime twin
+of deadlinecheck — monitor invariants (monotone narrowing, no dead
+crossings), install/uninstall patching of the real boundary classes,
+export merge-writes, the static↔runtime coverage cross-check against
+``build_boundary_table``, and the regression tests for the three
+deadline-propagation fixes the static sweep surfaced (the SSE
+whole-stream bound in serving/remote.py, KVMigrator's deadline-clamped
+peer fetches, and the engine's LoRA-acquire budget clamp).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import pytest
+
+from gofr_tpu.analysis import deadlinetrace
+from gofr_tpu.analysis.deadlinecheck import (
+    build_boundary_table,
+    check_deadline_coverage,
+)
+from gofr_tpu.analysis.deadlinetrace import (
+    DeadlineTraceError,
+    DeadlineTraceMonitor,
+)
+from gofr_tpu.http.errors import ErrorDeadlineExceeded
+from gofr_tpu.models import llama
+from gofr_tpu.serving import ByteTokenizer, EngineConfig, ServingEngine
+from gofr_tpu.serving.lora import AdapterRegistry, make_adapter
+from gofr_tpu.serving.prefix_index import KVMigrator, PrefixIndex
+from gofr_tpu.serving.remote import iter_events
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------ monitor invariants
+
+def test_clean_nesting_no_violations():
+    mon = DeadlineTraceMonitor()
+    mon.enter("Router.submit", 1.0)
+    mon.enter("ServingEngine.submit", 0.5)   # narrowed: fine
+    mon.exit("ServingEngine.submit")
+    mon.exit("Router.submit")
+    assert mon.violations() == []
+    mon.check()  # must not raise
+
+
+def test_widened_budget_is_a_violation():
+    mon = DeadlineTraceMonitor()
+    mon.enter("Router.submit", 0.5)
+    mon.enter("AdapterRegistry.acquire", 5.0)  # constant > remaining
+    assert any("budget widened at AdapterRegistry.acquire" in v
+               for v in mon.violations())
+    with pytest.raises(DeadlineTraceError):
+        mon.check()
+
+
+def test_negative_budget_is_a_dead_crossing():
+    mon = DeadlineTraceMonitor()
+    mon.enter("KVMigrator.fetch_chain", -0.25)
+    assert any("expired request crossed boundary" in v
+               for v in mon.violations())
+
+
+def test_zero_budget_is_legal():
+    # the clamped "ask, don't wait" form: the callee fails fast
+    mon = DeadlineTraceMonitor()
+    mon.enter("Router.submit", 1.0)
+    mon.enter("KVMigrator.fetch_chain", 0.0)
+    assert mon.violations() == []
+
+
+def test_none_budget_under_enclosing_deadline_is_not_a_violation():
+    # deadline-less submits are legal; the STATIC deadline-dropped rule
+    # owns "a deadline was in scope but not derived"
+    mon = DeadlineTraceMonitor()
+    mon.enter("Router.submit", 1.0)
+    mon.enter("LocalReplica.submit", None)
+    mon.enter("ServingEngine.submit", 0.5)   # checked against Router's
+    assert mon.violations() == []
+    mon.enter("AdapterRegistry.acquire", 50.0)  # still must narrow
+    assert len(mon.violations()) == 1
+
+
+def test_sibling_crossings_each_checked():
+    mon = DeadlineTraceMonitor()
+    mon.enter("Router.submit", 1.0)
+    mon.enter("KVMigrator.fetch_chain", 0.2)
+    mon.exit("KVMigrator.fetch_chain")
+    mon.enter("KVMigrator.fetch_handoff", 30.0)  # sibling, widened
+    assert len(mon.violations()) == 1
+    assert mon.crossings() == [
+        "Router.submit", "KVMigrator.fetch_chain", "KVMigrator.fetch_handoff",
+    ]
+    assert mon.observed_sites() == {
+        "Router.submit", "KVMigrator.fetch_chain", "KVMigrator.fetch_handoff",
+    }
+
+
+def test_export_shape_and_merge(tmp_path):
+    mon = DeadlineTraceMonitor()
+    mon.enter("Router.submit", 1.0)
+    mon.exit("Router.submit")
+    path = str(tmp_path / "deadline.json")
+    deadlinetrace.export_to(mon, path)
+
+    mon2 = DeadlineTraceMonitor()
+    mon2.enter("ServingEngine.submit", 0.5)
+    mon2.exit("ServingEngine.submit")
+    deadlinetrace.export_to(mon2, path)  # merge, not clobber
+
+    with open(path, encoding="utf-8") as fp:
+        data = json.load(fp)
+    assert data["version"] == 1
+    assert [e["site"] for e in data["events"]] == [
+        "Router.submit", "ServingEngine.submit",
+    ]
+    assert data["violations"] == []
+
+
+# --------------------------------------------------- install / uninstall
+
+def test_install_uninstall_restores_originals():
+    from gofr_tpu.serving.router import Router
+
+    before = Router.submit
+    mon = deadlinetrace.install()
+    try:
+        assert Router.submit is not before
+        assert getattr(Router.submit, "__wrapped__", None) is before
+        with pytest.raises(DeadlineTraceError):
+            deadlinetrace.install()  # nested install would strip wrappers
+    finally:
+        assert deadlinetrace.uninstall() is mon
+    assert Router.submit is before
+    assert deadlinetrace.uninstall() is None  # idempotent
+
+
+# ------------------------------------- fix 1: remote whole-stream bound
+
+class _FakeResp:
+    def __init__(self, frames):
+        self._frames = frames
+
+    def lines(self):
+        yield from self._frames
+
+
+def test_iter_events_raises_once_deadline_passes():
+    resp = _FakeResp(['data: {"token": 1, "text": "a"}', "data: [DONE]"])
+    events = iter_events(resp, deadline_abs=time.monotonic() - 0.01)
+    with pytest.raises(ErrorDeadlineExceeded):
+        next(events)
+
+
+def test_iter_events_yields_within_deadline():
+    resp = _FakeResp([
+        'data: {"id": 7}',
+        'data: {"token": 1, "text": "a"}',
+        "data: [DONE]",
+    ])
+    events = list(iter_events(resp, deadline_abs=time.monotonic() + 30.0))
+    assert events == [{"id": 7}, {"token": 1, "text": "a"}]
+
+
+def test_iter_events_unbounded_when_no_deadline():
+    resp = _FakeResp(['data: {"token": 1, "text": "a"}', "data: [DONE]"])
+    assert list(iter_events(resp)) == [{"token": 1, "text": "a"}]
+
+
+# --------------------------- fix 2: KVMigrator deadline-clamped fetches
+
+class _RecordingPeer:
+    """A bounded peer transport: takes the timeout kwarg like
+    HTTPReplica.fetch_kv and records what it was handed."""
+
+    def __init__(self):
+        self.calls: list[tuple[list[str], float | None]] = []
+
+    def __call__(self, keys: list[str], timeout: float = 2.0):
+        self.calls.append((list(keys), timeout))
+        return {k: (1, 2, 3) for k in keys}
+
+
+def test_expired_request_never_touches_the_wire():
+    peer = _RecordingPeer()
+    mig = KVMigrator("B", PrefixIndex())
+    mig.add_peer("A", peer)
+    spans = [(0, 16, "k0"), (16, 32, "k1")]
+    assert mig.fetch_handoff(spans, "A", deadline=0.0) == []
+    assert mig.fetch_handoff(spans, "A", deadline=-1.0) == []
+    assert mig.fetch_one_handoff("k0", "A", deadline=0.0) is None
+    assert mig.fetch_chain(spans, deadline=0.0) == []
+    assert peer.calls == []
+
+
+def test_bounded_peer_timeout_clamped_to_deadline():
+    peer = _RecordingPeer()
+    mig = KVMigrator("B", PrefixIndex(), fetch_timeout_s=2.0)
+    mig.add_peer("A", peer)
+    spans = [(0, 16, "k0"), (16, 32, "k1")]
+    got = mig.fetch_handoff(spans, "A", deadline=0.75)
+    assert [s[:2] for s in got] == [(0, 16), (16, 32)]
+    assert peer.calls[-1][1] == pytest.approx(0.75)  # min(2.0, 0.75)
+    # a roomy deadline leaves the transport default in charge
+    mig.fetch_handoff(spans, "A", deadline=30.0)
+    assert peer.calls[-1][1] == pytest.approx(2.0)
+    # deadline-less requests keep the configured transport bound
+    mig.fetch_handoff(spans, "A")
+    assert peer.calls[-1][1] == pytest.approx(2.0)
+
+
+def test_unbounded_local_peer_called_plain():
+    # local peek-based fetchers take no timeout: the clamp must not
+    # change the plain fetch(keys) peer contract
+    calls: list[list[str]] = []
+
+    def local_fetch(keys):
+        calls.append(list(keys))
+        return {k: (1, 2, 3) for k in keys}
+
+    mig = KVMigrator("B", PrefixIndex())
+    mig.add_peer("A", local_fetch)
+    got = mig.fetch_handoff([(0, 16, "k0")], "A", deadline=0.5)
+    assert [s[:2] for s in got] == [(0, 16)]
+    assert calls == [["k0"]]
+
+
+# ------------------------------- fix 3: LoRA-acquire budget clamp
+
+def _tiny_cfg() -> llama.LlamaConfig:
+    return llama.LlamaConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq_len=128,
+    )
+
+
+def test_lora_acquire_timeout_clamped_to_request_deadline():
+    cfg = _tiny_cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    reg = AdapterRegistry(max_active=4)
+    reg.register(make_adapter(cfg, "tenant-a", rank=2, seed=1))
+    seen: list[float] = []
+    inner = reg.acquire
+
+    def recording_acquire(adapter_id, timeout=5.0):
+        seen.append(timeout)
+        return inner(adapter_id, timeout=timeout)
+
+    reg.acquire = recording_acquire  # instance attr shadows the method
+    eng = ServingEngine(
+        cfg, params,
+        EngineConfig(max_slots=2, max_seq_len=128, prefill_buckets=(16,),
+                     max_queue=8),
+        ByteTokenizer(cfg.vocab_size), lora=reg,
+    )
+    eng.start()
+    try:
+        # warm the compile cache first so the deadline-bound request's
+        # budget isn't consumed by XLA compilation
+        eng.submit(
+            "hi", max_new_tokens=2, temperature=0.0, adapter_id="tenant-a",
+        ).result(timeout=300)
+        seen.clear()
+        r = eng.submit(
+            "hi", max_new_tokens=2, temperature=0.0,
+            adapter_id="tenant-a", deadline=0.8,
+        ).result(timeout=300)
+        assert r.finish_reason in ("stop", "length")
+    finally:
+        eng.stop()
+    # pre-fix the admission passed the constant 5.0 regardless of the
+    # request's 0.8s budget
+    assert seen and all(t <= 0.8 for t in seen), seen
+
+
+# ------------------------------ static↔runtime coverage cross-check
+
+def test_runtime_crossings_covered_by_static_table():
+    """Drive a real engine submit under the tracer: every observed
+    boundary crossing must be a site the static table knows, and the
+    workload must produce zero budget violations. (Deselected in the
+    Makefile fixture-suite lane like its lockcheck/leakcheck twins —
+    it imports the serving stack.)"""
+    cfg = _tiny_cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = ServingEngine(
+        cfg, params,
+        EngineConfig(max_slots=2, max_seq_len=128, prefill_buckets=(16,),
+                     max_queue=8),
+        ByteTokenizer(cfg.vocab_size),
+    )
+    mon = deadlinetrace.install()
+    try:
+        eng.start()
+        r = eng.submit(
+            "hello", max_new_tokens=2, temperature=0.0, deadline=60.0,
+        ).result(timeout=300)
+        assert r.finish_reason in ("stop", "length")
+        eng.stop()
+    finally:
+        deadlinetrace.uninstall()
+    mon.check()
+    assert "ServingEngine.submit" in mon.observed_sites()
+    table = build_boundary_table([os.path.join(REPO_ROOT, "gofr_tpu")])
+    assert check_deadline_coverage(mon.export(), table) == []

@@ -29,7 +29,10 @@ import pytest
 
 from gofr_tpu import chaos
 from gofr_tpu.chaos.injector import ChaosInjector
-from gofr_tpu.http.errors import ErrorServiceUnavailable
+from gofr_tpu.http.errors import (
+    ErrorDeadlineExceeded,
+    ErrorServiceUnavailable,
+)
 from gofr_tpu.models import llama
 from gofr_tpu.serving import (
     ByteTokenizer,
@@ -403,6 +406,66 @@ def test_router_degrades_when_prefill_pool_refuses(engine_setup):
         assert router.handoffs_total == 0
     finally:
         router.stop(); b.stop(); a.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_expired_request_never_crosses_disagg_boundary(engine_setup, seed):
+    """The deadline-propagation acceptance for the role-split tier
+    (docs/static-analysis.md#deadlinecheck): an already-expired request
+    submitted through the disagg router 504s at the router's deadline
+    gate WITHOUT crossing the prefill→decode boundary or opening a
+    remote stream — under the runtime deadline tracer with zero budget
+    violations, and every crossing it DOES observe is a site the static
+    boundary table knows."""
+    from gofr_tpu.analysis import deadlinetrace
+    from gofr_tpu.analysis.deadlinecheck import (
+        build_boundary_table,
+        check_deadline_coverage,
+    )
+
+    cfg, params = engine_setup
+    index, a, b, migrator = wire_pair(cfg, params)
+    router = Router(RouterConfig(
+        heartbeat_s=0.05, suspect_after_s=60.0, down_after_s=120.0,
+    ))
+    router.add_replica(LocalReplica("A", a, role="prefill"))
+    router.add_replica(LocalReplica("B", b, role="decode"))
+    router.membership.observe(Heartbeat("A", 1, role="prefill"))
+    router.membership.observe(Heartbeat("B", 1, role="decode"))
+    a.start(); b.start()
+    mon = deadlinetrace.install()
+    try:
+        with chaos.active(ChaosInjector(
+            seed, {"router.route": 0.5}, max_faults=2,
+        )):
+            # the deadline gate sits BEFORE the router.route chaos seam:
+            # an expired request must 504, never fault-and-retry onward
+            with pytest.raises(ErrorDeadlineExceeded):
+                res = router.submit(
+                    CHUNKED_PROMPT, max_new_tokens=4, temperature=0.0,
+                    deadline=1e-9,
+                )
+                if hasattr(res, "result"):
+                    res.result(timeout=60)
+    finally:
+        deadlinetrace.uninstall()
+        router.stop(); a.stop(); b.stop()
+    mon.check()  # zero budget violations
+    crossed = mon.observed_sites()
+    assert "Router.submit" in crossed
+    # the 504 settles at the router: the request never reaches a
+    # replica, the engine admission, or the remote stream transport
+    assert crossed.isdisjoint({
+        "LocalReplica.submit", "ServingEngine.submit", "HTTPReplica.submit",
+        "remote.run_stream", "KVMigrator.fetch_handoff",
+    }), crossed
+    import os as _os
+    table = build_boundary_table(
+        [_os.path.join(_os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__))), "gofr_tpu")]
+    )
+    assert check_deadline_coverage(mon.export(), table) == []
 
 
 # ------------------------------------------------- remote token streaming
