@@ -64,6 +64,23 @@ C++ TUs already run under ASan/UBSan/TSan (``make native-asan`` /
   fails on a widened budget or an expired request crossing a new
   boundary, and exports observed sites for the static coverage
   cross-check.
+- :mod:`gofr_tpu.analysis.kernelcheck` — device-contract analysis over
+  the committed kernel contract table
+  (:mod:`gofr_tpu.analysis.kernel_contracts`): host unpack sites must
+  slice packed kernel outputs by the declared column order
+  (``pack-layout-drift``), hot-zone dtype hygiene
+  (``dtype-discipline``), every DecodeState construction site must
+  agree with the declared carry spec (``carry-field-drift``),
+  shard_map/PartitionSpec plumbing must match the wrapped function and
+  its array ranks (``spec-rank-mismatch``), and every jitted kernel
+  entry must carry a declared contract
+  (``kernel-contract-coverage``); ``--kernel-table`` emits the table,
+  ``--check-kernel-table`` verifies a runtime export against it.
+- :mod:`gofr_tpu.analysis.kerneltrace` — the runtime twin:
+  ``jax.eval_shape``\\ s every contract entry across the config matrix
+  (dense/paged/quantized x base/LoRA x plain/ragged/spec) with zero
+  device execution, and a live-engine observer that records real
+  dispatch signatures — both exports feed ``--check-kernel-table``.
 - :mod:`gofr_tpu.analysis.sarif` — SARIF 2.1.0 output for the unified
   ``--all`` front door (``--format sarif``), for CI annotation.
 - :mod:`gofr_tpu.analysis.audit` — the stale-suppression audit

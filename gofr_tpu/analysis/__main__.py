@@ -63,6 +63,23 @@ def _list_rules() -> None:
             | dc.BOUNDARY_FUNCS
         )),
     )
+    from gofr_tpu.analysis import kernel_contracts as kctab
+    from gofr_tpu.analysis import kernelcheck as kch
+
+    print("kernel contract files:", ", ".join(kctab.KERNEL_FILES))
+    print(
+        "kernel contracts:",
+        ", ".join(k.name for k in kctab.KERNELS),
+    )
+    print(
+        "kernel unpack sites:",
+        ", ".join(f"{u.function} (layout {u.layout})"
+                  for u in kctab.UNPACK_SITES),
+    )
+    print(
+        "dtype hot zones:   engine."
+        + ", engine.".join(sorted(kch.ENGINE_HOT_FUNCS))
+    )
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -157,6 +174,20 @@ def main(argv: list[str] | None = None) -> int:
         "(gofr_tpu.analysis.deadlinetrace) is covered by the static "
         "boundary table: every observed budget crossing must be "
         "statically known, and the export must record zero violations",
+    )
+    parser.add_argument(
+        "--kernel-table", action="store_true",
+        help="emit the committed kernel contract table as JSON "
+        "(kernel_contracts.py: packed layouts, donation sets, carry "
+        "spec, symbolic return signatures)",
+    )
+    parser.add_argument(
+        "--check-kernel-table", metavar="PATH", default=None,
+        help="verify a runtime kernel export (gofr_tpu.analysis"
+        ".kerneltrace: the eval_shape matrix or a live-engine observer) "
+        "against the static contract table: packed widths, return "
+        "shapes/dtypes, and donated-carry passthrough signatures must "
+        "all match, with zero recorded violations",
     )
     args = parser.parse_args(argv)
 
@@ -333,6 +364,46 @@ def main(argv: list[str] | None = None) -> int:
             f"deadlinecheck: runtime crossings covered by the static "
             f"boundary table "
             f"({len(runtime.get('events', []))} observed crossing(s) checked)"
+        )
+        return 0
+
+    if args.kernel_table:
+        from gofr_tpu.analysis.kernel_contracts import render_table_json
+
+        print(render_table_json())
+        return 0
+
+    if args.check_kernel_table:
+        import json as _json
+
+        from gofr_tpu.analysis.kernelcheck import check_kernel_table
+
+        try:
+            with open(args.check_kernel_table, encoding="utf-8") as fp:
+                runtime = _json.load(fp)
+        except (OSError, ValueError) as exc:
+            print(
+                f"error: cannot read runtime kernel export "
+                f"{args.check_kernel_table}: {exc}",
+                file=sys.stderr,
+            )
+            return 2
+        divergences = check_kernel_table(runtime)
+        for d in divergences:
+            print(d)
+        if divergences:
+            print(
+                f"kernelcheck: {len(divergences)} static<->runtime "
+                "divergence(s) — the device contract table and the "
+                "traced kernels disagree "
+                "(docs/static-analysis.md#kernelcheck)",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"kernelcheck: runtime signatures match the contract table "
+            f"({len(runtime.get('cases', []))} case(s) checked, mode "
+            f"{runtime.get('mode', '?')})"
         )
         return 0
 

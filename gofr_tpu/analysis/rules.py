@@ -1000,6 +1000,7 @@ class RouterRetryTypedRule(Rule):
 
 def default_rules() -> list[Rule]:
     from gofr_tpu.analysis.deadlinecheck import deadlinecheck_rules
+    from gofr_tpu.analysis.kernelcheck import kernelcheck_rules
     from gofr_tpu.analysis.leakcheck import leakcheck_rules
     from gofr_tpu.analysis.lockcheck import lockcheck_rules
     from gofr_tpu.analysis.shardcheck import shardcheck_rules
@@ -1012,4 +1013,5 @@ def default_rules() -> list[Rule]:
         *lockcheck_rules(),
         *leakcheck_rules(),
         *deadlinecheck_rules(),
+        *kernelcheck_rules(),
     ]

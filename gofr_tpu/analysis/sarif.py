@@ -49,6 +49,11 @@ RULE_DESCRIPTIONS = {
     "unbounded-wire-call": "serving-reachable wait/wire call with no bound",
     "retry-unbudgeted": "retry/requeue loop with no max-elapsed budget",
     "cancel-unreachable": "cancel-path wait no stop Event can interrupt",
+    "pack-layout-drift": "packed kernel output vs host unpack-column drift",
+    "dtype-discipline": "hot-zone dtype hygiene (promotion, 64-bit, index)",
+    "carry-field-drift": "DecodeState construction site disagrees with carry spec",
+    "spec-rank-mismatch": "shard_map/PartitionSpec vs array rank or pytree drift",
+    "kernel-contract-coverage": "jitted kernel entry without a declared contract",
     "zone-drift": "analyzer zone names a file/function that moved",
     "bad-transfer-annotation": "malformed leakcheck ownership annotation",
     "stale-suppression": "suppression matching no current finding",

@@ -15,6 +15,11 @@ Reference parity note (SURVEY §5.7): the reference framework (gofr, pure Go)
 has no attention; this kernel is the TPU-native hot-op the north-star serving
 path requires. Falls back to interpret mode off-TPU so CI (8 virtual CPU
 devices, tests/conftest.py) exercises the same code path.
+
+``flash_attention`` is declared in the kernel contract table
+(``gofr_tpu/analysis/kernel_contracts.KERNELS``) and replayed by the
+kerneltrace eval_shape matrix — signature/static-arg changes must
+update the table in the same commit.
 """
 
 from __future__ import annotations

@@ -18,6 +18,12 @@ Two implementations with one contract:
   pages a sequence actually owns are streamed from HBM; pages past the
   sequence length are skipped with ``@pl.when``. int8 pools stream at
   half width and dequantize in VMEM (per-vector absmax scales).
+
+The jitted entries are declared in the kernel contract table
+(``gofr_tpu/analysis/kernel_contracts.KERNELS``; note the PER-LAYER
+pool ranks there — [N_pages, Hkv, page, Dh], no leading L) and
+replayed by the kerneltrace eval_shape matrix; a signature or rank
+change must update the table in the same commit.
 """
 
 from __future__ import annotations

@@ -25,6 +25,15 @@ must be rebound at every call site (``use-after-donation``) and nothing
 here may branch on traced values or take unhashable statics
 (``retrace-hazard``) — one per-request recompile eats the whole TTFT
 budget.
+
+Every jitted entry here is ALSO under device contract: its parameter
+tuple, donated/static sets, packed output layout, and carry signatures
+are declared in ``gofr_tpu/analysis/kernel_contracts.KERNELS`` and
+enforced by kernelcheck + the eval_shape runtime twin
+(docs/static-analysis.md "kernelcheck — device-contract analysis").
+Changing a signature, a pack column, or a ``DecodeState`` field means
+updating the contract table in the same commit — the lint gate and the
+tier-1 matrix both fail otherwise, by design.
 """
 
 from __future__ import annotations

@@ -2432,7 +2432,11 @@ class ServingEngine:
         and the chunk already amortizes dispatch latency the way
         multi_step does, multiplied by accepted drafts. Works on all four
         cache layouts (dense/paged x bf16/int8); ref
-        models/llama.py:speculative_generate for the library-level twin."""
+        models/llama.py:speculative_generate for the library-level twin.
+
+        Declared unpack site (kernel_contracts.UNPACK_SITES): the
+        [:, :-1] / [:, -1] slices below are checked against the 'spec'
+        pack layout — out | n_accept — by kernelcheck."""
         cfg = self.model_cfg
         chaos.maybe_fail("decode.dispatch")
         self._maybe_device_loss()
@@ -2970,6 +2974,9 @@ class ServingEngine:
         return packed, last_logits, new_cache, new_state, prefill_rows
 
     def _consume_block(self, rec: _Inflight) -> None:
+        # declared unpack site (kernel_contracts.UNPACK_SITES): the
+        # column offsets below are checked against the 'ragged' pack
+        # layout — tokens | done | n_valid | first — by kernelcheck
         packed = _block_sync(rec.packed)  # THE one sync for N device steps
         # the sync returned: a warm restart may have replaced this thread
         # while it waited — its tokens belong to requests already settled

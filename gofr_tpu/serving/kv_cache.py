@@ -16,6 +16,10 @@ shardcheck retrace/donation zone: the pool buffers are donated through
 every _write_pages*/decode dispatch and MUST be rebound in the same
 statement (``use-after-donation``, docs/static-analysis.md) — a stale
 ``self.k_pool`` read after a donating call is the round-4 on-TPU crash.
+The ``_write_pages*`` entries are declared in the kernel contract table
+(``gofr_tpu/analysis/kernel_contracts.KERNELS``) — pool/slab signatures
+and the donation sets are enforced by kernelcheck and replayed by the
+kerneltrace eval_shape matrix.
 """
 
 from __future__ import annotations
