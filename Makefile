@@ -5,7 +5,7 @@
 PY ?= python
 ASAN_RT := $(shell g++ -print-file-name=libasan.so 2>/dev/null)
 
-.PHONY: check ci import-check lint lock-order test bench-smoke bench-check native native-asan chaos
+.PHONY: check ci import-check lint lock-order test bench-smoke bench-check native native-asan chaos loadcheck
 
 check: import-check lint test native-asan bench-smoke
 	@echo "CHECK OK"
@@ -25,6 +25,7 @@ ci: lint bench-check
 	  --deselect tests/test_deadlinetrace.py::test_lora_acquire_timeout_clamped_to_request_deadline \
 	  --deselect tests/test_kerneltrace.py::test_observer_live_engine_matches_contract_table
 	$(MAKE) chaos
+	$(MAKE) loadcheck
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
 	@echo "CI OK"
 
@@ -49,9 +50,24 @@ ci: lint bench-check
 # asserting exactly-one-terminal-state-on-exactly-one-replica) and the
 # disaggregation plane (handoff-interrupted seeds: source death,
 # destination death, kv.handoff transport faults; autoscaler scale-down
-# drains under scale.decision faults).
+# drains under scale.decision faults), and the goodput-under-load tier
+# (docs/robustness.md "Goodput under production load"): the full stack
+# replays a seeded production trace while a wall-clock FaultSchedule
+# fires a mid-run replica kill + tenant storm + heartbeat partition,
+# asserting zero lost requests, exactly-one terminal per request, and
+# interactive-class goodput strictly above batch inside the fault
+# window (seeds in tests/test_loadlab.py::CHAOS_SEEDS).
 chaos:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py tests/test_supervisor.py tests/test_pubsub_chaos.py tests/test_router_chaos.py tests/test_disagg.py -q -m chaos
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py tests/test_supervisor.py tests/test_pubsub_chaos.py tests/test_router_chaos.py tests/test_disagg.py tests/test_loadlab.py -q -m chaos
+
+# goodput ratchet gate (docs/robustness.md, docs/performance.md#bench-ratchet):
+# one deterministic chaos-under-load trace (seed 101) through the full
+# stack via bench.py --loadlab, then the floor check — goodput under
+# chaos (direction max) plus TTFT/e2e p99 ceilings must stay inside
+# analysis/bench_floors.json.
+loadcheck:
+	JAX_PLATFORMS=cpu $(PY) bench.py --loadlab
+	$(PY) bench.py --check
 
 # gofrlint (docs/static-analysis.md): the unified front door — the
 # framework-invariant AST lints, the shardcheck SPMD family, the

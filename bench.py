@@ -604,6 +604,59 @@ def _tenant_storm(cfg: Any, params: Any, on_tpu: bool) -> dict:
         engine.stop()
 
 
+def _loadlab_goodput(cfg: Any, params: Any, on_tpu: bool) -> dict:
+    """Goodput under chaos at production-load shape (PR 18 GoodputLab,
+    docs/robustness.md#goodput-under-production-load): the canned
+    acceptance scenario — seeded heavy-tailed trace with a batch-tenant
+    storm, a mid-run replica kill, and a heartbeat partition — replayed
+    open-loop against the FULL stack (router + role-split replicas +
+    autoscaler). Three CPU-verifiable ratchet metrics come out of one
+    run: interactive-class goodput under chaos (direction:"max" — the
+    robustness headline), and interactive TTFT/e2e p99 (direction:"min").
+    The trace fingerprint in the details pins reproducibility."""
+    from gofr_tpu.loadlab import (
+        ServingStack,
+        acceptance_scenario,
+        acceptance_stack_config,
+        check_invariants,
+        generate_trace,
+        run_trace,
+        score,
+    )
+
+    spec, plan, fault_window = acceptance_scenario(101)
+    trace = generate_trace(spec)
+    stack_cfg = acceptance_stack_config(trace)
+    with ServingStack(cfg, params, stack_cfg) as stack:
+        result = run_trace(stack, trace, plan=plan)
+        timelines = stack.timelines()
+    report = score(result.outcomes, windows={"fault": fault_window})
+    violations = check_invariants(
+        result.outcomes, timelines, report=report, fault_window="fault"
+    )
+    if violations:
+        raise RuntimeError(f"loadlab invariant violated: {violations}")
+    inter = report.per_class["interactive"]
+    return {
+        "goodput_under_chaos": inter["goodput"],
+        "ttft_p99_ms": inter["ttft_p99_ms"],
+        "e2e_p99_ms": inter["e2e_p99_ms"],
+        "goodput_total": report.total["goodput"],
+        "goodput_batch": report.per_class["batch"]["goodput"],
+        "goodput_fault_window_interactive": report.goodput(
+            "interactive", window="fault"
+        ),
+        "n_requests": report.total["n"],
+        "killed": result.stack["killed"],
+        "scale_ups": result.stack["scale_ups"],
+        "heartbeats_dropped": result.chaos.get(
+            "router.heartbeat", {}
+        ).get("scheduled", 0),
+        "trace_fingerprint": result.trace_fingerprint,
+        "report_fingerprint": report.fingerprint(),
+    }
+
+
 def _router_warm_prefix(cfg: Any, params: Any, on_tpu: bool) -> dict:
     """Warm-prefix TTFT at multi-replica scale (ROADMAP item 3, AIBrix
     multi-tier KV pooling arXiv:2504.03648): two in-process replicas
@@ -1499,6 +1552,31 @@ def _run_benchmarks(platform: str, init_error: str | None, wall_start: float) ->
     if "error" not in storm_line:
         _append_local_record(storm_line)
 
+    # --- goodput under chaos at production-load shape (CPU-verifiable) -----
+    # one seeded run, three ratchet metrics (PR 18 GoodputLab)
+    loadlab_memo: list[dict] = []
+
+    def run_loadlab() -> dict:
+        if params is None:
+            raise RuntimeError("skipped: headline phase failed to build params")
+        if not loadlab_memo:
+            loadlab_memo.append(_loadlab_goodput(cfg, params, on_tpu))
+        return loadlab_memo[0]
+
+    for metric, unit, key in (
+        (f"loadlab_goodput_under_chaos_{model_kind}_{platform}", "fraction",
+         "goodput_under_chaos"),
+        (f"loadlab_ttft_p99_ms_{model_kind}_{platform}", "ms", "ttft_p99_ms"),
+        (f"loadlab_e2e_p99_ms_{model_kind}_{platform}", "ms", "e2e_p99_ms"),
+    ):
+        ll_line = _phase_line(
+            metric, unit, run_loadlab, value_key=key,
+            on_tpu=on_tpu and not init_error, init_error=init_error,
+        )
+        print(json.dumps(ll_line), flush=True)
+        if "error" not in ll_line:
+            _append_local_record(ll_line)
+
     # --- framework-only phases (no TPU dependence at all) ------------------
     echo_line = _phase_line(
         "grpc_unary_echo_req_per_s", "req/s", _grpc_unary_echo,
@@ -1688,13 +1766,71 @@ def _cli(argv: list[str]) -> int | None:
     against the ratcheted floors (analysis/bench_floors.json) WITHOUT
     touching jax or the TPU — the CI perf gate (`make bench-check`).
     ``--update-floors`` ratchets the floors up to the best committed
-    values. No flag → run the benchmarks. docs/performance.md."""
-    if not argv or argv[0] not in ("--check", "--update-floors"):
+    values. ``--loadlab`` runs ONLY the goodput-under-chaos phase and
+    appends its evidence (`make loadcheck`). No flag → run the
+    benchmarks. docs/performance.md."""
+    if not argv or argv[0] not in ("--check", "--update-floors", "--loadlab"):
         return None
+    if argv[0] == "--loadlab":
+        return _run_loadlab_only()
     from gofr_tpu.analysis.bench_ratchet import run_check
 
     paths = argv[1:] or [os.path.join(_REPO, "BENCH_LOCAL.jsonl")]
     return run_check(paths, update=argv[0] == "--update-floors")
+
+
+def _run_loadlab_only() -> int:
+    """The `make loadcheck` entry: one seeded chaos-under-load run on the
+    current backend, three contract lines, evidence appended to
+    BENCH_LOCAL.jsonl for ``--check`` to gate. Exit 1 when the phase
+    errors (including an invariant violation) so CI fails loudly."""
+    try:
+        platform, init_error = _acquire_backend()
+    except Exception as exc:
+        print(json.dumps({
+            "metric": "loadlab_goodput_under_chaos", "value": None,
+            "unit": "fraction", "vs_baseline": None,
+            "error": f"{type(exc).__name__}: {exc}",
+        }))
+        return 1
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, _REPO)
+    from gofr_tpu.models import llama
+
+    on_tpu = platform in ("tpu", "axon")
+    model_kind = os.environ.get("BENCH_MODEL", "8b-int8" if on_tpu else "tiny")
+    if model_kind != "tiny":
+        cfg = llama.LlamaConfig(max_seq_len=2048, dtype=jnp.bfloat16)
+    else:
+        cfg = llama.LlamaConfig.tiny(dtype=jnp.bfloat16)
+    params = jax.device_put(
+        llama.init_params(cfg, jax.random.PRNGKey(0), quantize=True)
+    )
+    memo: list[dict] = []
+
+    def run() -> dict:
+        if not memo:
+            memo.append(_loadlab_goodput(cfg, params, on_tpu))
+        return memo[0]
+
+    failed = False
+    for metric, unit, key in (
+        (f"loadlab_goodput_under_chaos_{model_kind}_{platform}", "fraction",
+         "goodput_under_chaos"),
+        (f"loadlab_ttft_p99_ms_{model_kind}_{platform}", "ms", "ttft_p99_ms"),
+        (f"loadlab_e2e_p99_ms_{model_kind}_{platform}", "ms", "e2e_p99_ms"),
+    ):
+        line = _phase_line(metric, unit, run, value_key=key,
+                           on_tpu=on_tpu and not init_error,
+                           init_error=init_error)
+        print(json.dumps(line), flush=True)
+        if "error" in line:
+            failed = True
+        else:
+            _append_local_record(line)
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

@@ -7,6 +7,8 @@ from gofr_tpu.chaos.injector import (
     ChaosFault,
     ChaosInjector,
     DeviceLost,
+    FaultSchedule,
+    ScheduledFault,
     active,
     hang_factory,
     install,
@@ -19,6 +21,8 @@ __all__ = [
     "ChaosFault",
     "ChaosInjector",
     "DeviceLost",
+    "FaultSchedule",
+    "ScheduledFault",
     "active",
     "hang_factory",
     "install",

@@ -1,0 +1,82 @@
+"""GoodputLab: the trace-driven production-load harness (ROADMAP item 1).
+
+Every robustness claim from the serving era (continuous batching, cluster
+KV reuse, disagg handoff, SLO-class preemption) was proven by unit-scale
+chaos seeds and single-scenario open-loop benches. This package is the
+missing layer: a seeded workload generator that drives the FULL
+router→replicas stack — role-split disagg tier, autoscaler, in-process
+pubsub heartbeats — with production-shaped traffic (heavy-tailed lengths,
+diurnal/Poisson-burst arrivals, tenant + adapter mixes, shared-prefix
+populations), composes a deterministic wall-clock chaos schedule over the
+run (mid-run replica kill, tenant storm, heartbeat partition at known
+offsets), and scores per-tenant per-SLO-class **goodput** straight from
+the PR 9 timeline data (vLLM-vs-TGI methodology, arXiv:2511.17593; AIBrix
+SLO gates, arXiv:2504.03648).
+
+Module map (docs/robustness.md "Goodput under production load"):
+
+- :mod:`gofr_tpu.loadlab.trace` — the trace schema + seeded generator;
+- :mod:`gofr_tpu.loadlab.arrival` — the arrival clock (non-homogeneous
+  Poisson via thinning, diurnal ramps, burst windows);
+- :mod:`gofr_tpu.loadlab.scenario` — chaos plans (the schedule grammar
+  over stack actions + :class:`gofr_tpu.chaos.FaultSchedule`) and the
+  canned acceptance scenario;
+- :mod:`gofr_tpu.loadlab.stack` — the system under test: Router + real
+  ServingEngine replicas built through ``SimulatedPoolDriver`` so the
+  autoscaler owns the pool, heartbeats over ``InMemoryBroker``;
+- :mod:`gofr_tpu.loadlab.driver` — open-loop trace replay + chaos-action
+  execution against the stack;
+- :mod:`gofr_tpu.loadlab.scorer` — goodput scoring + the robustness
+  invariant audit (zero lost, exactly-one terminal, class ordering);
+- ``python -m gofr_tpu.loadlab`` — the CLI front door.
+"""
+
+from gofr_tpu.loadlab.arrival import burst_windows, constant, diurnal, poisson_arrivals
+from gofr_tpu.loadlab.driver import Outcome, RunResult, run_trace
+from gofr_tpu.loadlab.scenario import (
+    ChaosEvent,
+    ChaosPlan,
+    acceptance_scenario,
+    acceptance_stack_config,
+)
+from gofr_tpu.loadlab.scorer import (
+    ScoreReport,
+    check_invariants,
+    records_from_jsonl,
+    score,
+)
+from gofr_tpu.loadlab.stack import ServingStack, StackConfig
+from gofr_tpu.loadlab.trace import (
+    BurstSpec,
+    TenantMix,
+    Trace,
+    TraceEvent,
+    TraceSpec,
+    generate_trace,
+)
+
+__all__ = [
+    "BurstSpec",
+    "ChaosEvent",
+    "ChaosPlan",
+    "Outcome",
+    "RunResult",
+    "ScoreReport",
+    "ServingStack",
+    "StackConfig",
+    "TenantMix",
+    "Trace",
+    "TraceEvent",
+    "TraceSpec",
+    "acceptance_scenario",
+    "acceptance_stack_config",
+    "burst_windows",
+    "check_invariants",
+    "constant",
+    "diurnal",
+    "generate_trace",
+    "poisson_arrivals",
+    "records_from_jsonl",
+    "run_trace",
+    "score",
+]

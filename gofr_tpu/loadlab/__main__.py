@@ -1,0 +1,103 @@
+"""CLI: run the canned chaos-under-load scenario and print the score.
+
+    python -m gofr_tpu.loadlab --seed 101 --horizon-s 12 --json out.json
+
+Builds the tiny CPU model, assembles the full stack (router + role-split
+replicas + autoscaler), replays the seeded trace with the mid-run kill /
+tenant storm / heartbeat partition, scores goodput per class, and exits
+non-zero if the robustness invariant is violated. The bench loadlab
+phase and `make loadcheck` drive the same path programmatically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m gofr_tpu.loadlab",
+        description="trace-driven chaos-under-load goodput run",
+    )
+    parser.add_argument("--seed", type=int, default=101)
+    parser.add_argument("--horizon-s", type=float, default=8.0)
+    parser.add_argument("--base-rps", type=float, default=4.0)
+    parser.add_argument("--no-chaos", action="store_true",
+                        help="clean-run control: same trace, no faults")
+    parser.add_argument("--json", metavar="PATH",
+                        help="write the full report JSON here")
+    parser.add_argument("--trace-out", metavar="PATH",
+                        help="also dump the generated trace as JSONL")
+    args = parser.parse_args(argv)
+
+    from gofr_tpu.loadlab import (
+        ServingStack,
+        acceptance_scenario,
+        check_invariants,
+        generate_trace,
+        run_trace,
+        score,
+    )
+    from gofr_tpu.loadlab.scenario import acceptance_stack_config
+    from gofr_tpu.models import llama
+
+    spec, plan, fault_window = acceptance_scenario(
+        args.seed, horizon_s=args.horizon_s, base_rps=args.base_rps
+    )
+    trace = generate_trace(spec)
+    if args.trace_out:
+        trace.to_jsonl(args.trace_out)
+    print(f"trace: {len(trace)} events over {trace.horizon_s:.1f}s "
+          f"fingerprint={trace.fingerprint()[:12]}", file=sys.stderr)
+
+    import jax
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    with tempfile.TemporaryDirectory(prefix="loadlab-") as export_dir:
+        stack_cfg = acceptance_stack_config(trace, export_dir=export_dir)
+        with ServingStack(cfg, params, stack_cfg) as stack:
+            result = run_trace(
+                stack, trace, plan=None if args.no_chaos else plan
+            )
+            timelines = stack.timelines()
+
+    report = score(result.outcomes, windows={"fault": fault_window})
+    violations = check_invariants(
+        result.outcomes, timelines, report=report,
+        fault_window=None if args.no_chaos else "fault",
+    )
+
+    payload = {
+        "seed": args.seed,
+        "trace_fingerprint": result.trace_fingerprint,
+        "duration_s": result.duration_s,
+        "stack": result.stack,
+        "chaos": result.chaos,
+        "actions": result.actions,
+        "report": report.to_dict(),
+        "report_fingerprint": report.fingerprint(),
+        "violations": violations,
+    }
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=2, sort_keys=True)
+    for name, bucket in sorted(report.per_class.items()):
+        print(f"{name:12s} n={bucket['n']:4d} goodput={bucket['goodput']} "
+              f"ttft_p99={bucket['ttft_p99_ms']}ms "
+              f"e2e_p99={bucket['e2e_p99_ms']}ms")
+    print(f"total goodput={report.total['goodput']} "
+          f"fingerprint={report.fingerprint()[:12]}")
+    if violations:
+        for v in violations:
+            print(f"VIOLATION: {v}", file=sys.stderr)
+        return 1
+    print("invariants: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,88 @@
+"""The arrival clock: seeded non-homogeneous Poisson processes.
+
+Production traffic is not a constant req/s knob — it is a Poisson process
+whose rate rides a diurnal curve and spikes in bursts (the vLLM-vs-TGI
+study's central methodological point: systems that look identical under
+constant load separate under realistic arrival processes). This module
+generates arrival OFFSETS (seconds from trace start) from a seeded
+``random.Random`` via Lewis-Shedler thinning: draw candidate arrivals
+from a homogeneous process at the envelope rate, keep each with
+probability ``rate(t) / rate_max``. Same seed → same offsets, exactly.
+
+Rate functions are plain ``f(t_s) -> requests_per_second`` callables so
+they compose: ``burst_windows(diurnal(...), [...])`` multiplies a storm
+into the curve.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Callable, Sequence
+
+RateFn = Callable[[float], float]
+
+
+def constant(rps: float) -> RateFn:
+    """Homogeneous Poisson at ``rps``."""
+    return lambda t: rps
+
+
+def diurnal(base_rps: float, peak_rps: float, period_s: float,
+            phase_s: float = 0.0) -> RateFn:
+    """A sinusoidal day: rate swings ``base → peak → base`` over
+    ``period_s``, starting at the trough (shift with ``phase_s``). A CI
+    trace compresses the "day" to seconds — the shape is what matters:
+    the ramp exercises the autoscaler's hysteresis edges the way a real
+    morning does."""
+    if peak_rps < base_rps:
+        raise ValueError("peak_rps must be >= base_rps")
+    mid = (base_rps + peak_rps) / 2.0
+    amp = (peak_rps - base_rps) / 2.0
+
+    def rate(t: float) -> float:
+        return mid - amp * math.cos(2.0 * math.pi * (t + phase_s) / period_s)
+
+    return rate
+
+
+def burst_windows(base: RateFn,
+                  windows: Sequence[tuple[float, float, float]]) -> RateFn:
+    """Multiply burst windows into a rate curve: each window is
+    ``(at_s, duration_s, multiplier)``. Overlapping windows compound —
+    two simultaneous 3× storms are a 9× spike, which is exactly how
+    independent incidents stack in production."""
+    wins = [(float(a), float(d), float(m)) for a, d, m in windows]
+
+    def rate(t: float) -> float:
+        r = base(t)
+        for at_s, dur_s, mult in wins:
+            if at_s <= t < at_s + dur_s:
+                r *= mult
+        return r
+
+    return rate
+
+
+def poisson_arrivals(rng: random.Random, rate: RateFn, horizon_s: float,
+                     rate_max: float | None = None) -> list[float]:
+    """Lewis-Shedler thinning: arrival offsets in ``[0, horizon_s)`` for
+    a non-homogeneous Poisson process with intensity ``rate``.
+    ``rate_max`` must dominate the rate function over the horizon; when
+    omitted it is probed on a coarse grid ×1.05 (exact for the piecewise
+    curves above, whose maxima sit on window edges the grid samples)."""
+    if rate_max is None:
+        steps = max(64, int(horizon_s * 4))
+        grid = [rate(horizon_s * i / steps) for i in range(steps + 1)]
+        rate_max = max(grid) * 1.05
+    if rate_max <= 0.0:
+        return []
+    out: list[float] = []
+    t = 0.0
+    while True:
+        # exponential inter-arrival at the envelope rate
+        t -= math.log(1.0 - rng.random()) / rate_max
+        if t >= horizon_s:
+            return out
+        if rng.random() * rate_max < rate(t):
+            out.append(t)

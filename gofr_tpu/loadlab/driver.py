@@ -1,0 +1,220 @@
+"""Open-loop trace replay against a :class:`ServingStack`.
+
+Open-loop on purpose (the vLLM-vs-TGI methodology's second pillar):
+arrivals fire at the trace's offsets whether or not the system has kept
+up — a closed loop would let a slow tier throttle its own load and hide
+the collapse the harness exists to measure. The driver walks one merged
+clock of trace events and chaos stack-actions, submits through the
+ROUTER (never a replica directly), and records one :class:`Outcome` per
+trace event: ok/error, finish reason, client-observed TTFT and e2e.
+
+Zero lost requests is driven from here: every submitted future is
+awaited with a hard timeout after the replay; a future that never
+settles becomes a ``lost`` outcome, which the scorer's invariant check
+turns into a failure. The chaos plan's :class:`FaultSchedule` is armed
+to the SAME t=0 as the trace clock, so "kill at 4.2 s" and "partition
+from 5.4 s" mean offsets on one shared timeline.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import threading
+import time
+from typing import Any
+
+from gofr_tpu import chaos
+from gofr_tpu.loadlab.scenario import ChaosPlan
+from gofr_tpu.loadlab.stack import ServingStack
+from gofr_tpu.loadlab.trace import Trace, TraceEvent
+from gofr_tpu.serving.router import RETRIABLE_ERRORS
+
+# terminal finish reasons that count as a served answer; everything else
+# (deadline_exceeded, cancel, error, shed, lost) is damage the scorer
+# attributes per class
+SERVED_REASONS = ("stop", "length", "kv_exhausted")
+
+
+@dataclasses.dataclass
+class Outcome:
+    """Client-side terminal record for one trace event."""
+
+    index: int
+    tenant: str
+    slo_class: str
+    at_s: float                  # scheduled arrival (trace time)
+    submitted_s: float           # actual submit offset on the run clock
+    ok: bool
+    finish_reason: str           # GenerationResult reason | error class name | "lost"
+    error: str | None = None
+    ttft_s: float | None = None  # engine-observed (submit→first token)
+    e2e_s: float | None = None   # client-observed (submit→settled)
+    replica_id: str | None = None
+    request_id: int | None = None
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class RunResult:
+    outcomes: list[Outcome]
+    duration_s: float
+    trace_fingerprint: str
+    stack: dict[str, Any]
+    chaos: dict[str, Any]
+    actions: list[dict[str, Any]]
+
+    @property
+    def lost(self) -> list[Outcome]:
+        return [o for o in self.outcomes if o.finish_reason == "lost"]
+
+
+def _settle(event: TraceEvent, fut: Any, submitted_s: float,
+            done_at: dict[int, float], t0: float,
+            timeout_s: float) -> Outcome:
+    base = dict(index=event.index, tenant=event.tenant,
+                slo_class=event.slo_class, at_s=event.at_s,
+                submitted_s=submitted_s)
+    try:
+        result = fut.result(timeout=timeout_s)
+    except Exception as exc:  # noqa: BLE001 - every error is an outcome here
+        if (isinstance(exc, (TimeoutError, concurrent.futures.TimeoutError))
+                and not fut.done()):
+            return Outcome(**base, ok=False, finish_reason="lost",
+                           error=type(exc).__name__)
+        settled = done_at.get(event.index)
+        e2e = (settled - t0 - submitted_s) if settled is not None else None
+        reason = ("deadline_exceeded"
+                  if type(exc).__name__ == "ErrorDeadlineExceeded"
+                  else type(exc).__name__)
+        return Outcome(**base, ok=False, finish_reason=reason,
+                       error=type(exc).__name__, e2e_s=e2e)
+    settled = done_at.get(event.index)
+    e2e = (settled - t0 - submitted_s) if settled is not None else None
+    return Outcome(
+        **base,
+        ok=result.finish_reason in SERVED_REASONS,
+        finish_reason=result.finish_reason,
+        ttft_s=getattr(result, "ttft_s", None),
+        e2e_s=e2e,
+        replica_id=getattr(result, "replica_id", None),
+        request_id=getattr(result, "request_id", None),
+    )
+
+
+def run_trace(stack: ServingStack, trace: Trace, *,
+              plan: ChaosPlan | None = None,
+              rates: dict[str, float] | None = None,
+              time_scale: float = 1.0,
+              settle_timeout_s: float = 60.0) -> RunResult:
+    """Replay ``trace`` against a STARTED stack, executing ``plan``'s
+    stack actions and injected-fault schedule on the same clock.
+    ``time_scale`` stretches (>1) or compresses (<1) the trace's arrival
+    offsets — chaos offsets scale identically, so the scenario keeps its
+    shape. Returns every outcome; never raises for request-level
+    failures (they ARE the data)."""
+    actions = list(plan.stack_actions()) if plan is not None else []
+    injector = plan.injector(rates) if plan is not None else None
+    if injector is None and rates:
+        injector = chaos.ChaosInjector(0, dict(rates))
+
+    pending: list[tuple[TraceEvent, Any, float]] = []
+    rejected: list[tuple[TraceEvent, BaseException, float]] = []
+    done_at: dict[int, float] = {}
+    action_log: list[dict[str, Any]] = []
+
+    def run_actions(t0: float) -> None:
+        # on its own thread: stack.kill blocks on engine.stop, which must
+        # not stall the open-loop arrival clock
+        for action in actions:
+            wait = t0 + action.at_s * time_scale - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            try:
+                victim = stack.kill(action.target)
+            except Exception as exc:  # noqa: BLE001 - log, keep replaying
+                victim = f"error:{type(exc).__name__}"
+            action_log.append({
+                "kind": action.kind, "at_s": action.at_s, "target": victim,
+                "fired_s": round(time.monotonic() - t0, 3),
+            })
+
+    def replay() -> tuple[float, threading.Thread | None]:
+        t0 = time.monotonic()
+        if injector is not None and injector.schedule is not None:
+            injector.schedule.arm(t0)
+        action_thread = None
+        if actions:
+            action_thread = threading.Thread(
+                target=run_actions, args=(t0,),
+                name="loadlab-actions", daemon=True,
+            )
+            action_thread.start()
+        for event in trace:
+            wait = t0 + event.at_s * time_scale - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            submitted_s = time.monotonic() - t0
+            try:
+                fut = stack.router.submit(
+                    event.prompt,
+                    max_new_tokens=event.max_new_tokens,
+                    temperature=0.0,
+                    tenant=event.tenant,
+                    adapter_id=event.adapter_id,
+                )
+            except Exception as exc:  # noqa: BLE001 - rejection is an outcome
+                rejected.append((event, exc, submitted_s))
+                continue
+            fut.add_done_callback(
+                lambda _f, idx=event.index: done_at.setdefault(
+                    idx, time.monotonic()
+                )
+            )
+            pending.append((event, fut, submitted_s))
+        return t0, action_thread
+
+    if injector is not None:
+        with chaos.active(injector):
+            t0, action_thread = replay()
+            # settlement happens with the injector still active: its
+            # scheduled windows live inside the horizon and are spent by
+            # now, so it is inert — uninstalling earlier would tear
+            # still-latched faults mid-flight.
+            outcomes = [
+                _settle(e, f, s, done_at, t0, settle_timeout_s)
+                for e, f, s in pending
+            ]
+        chaos_stats = injector.stats()
+    else:
+        t0, action_thread = replay()
+        outcomes = [
+            _settle(e, f, s, done_at, t0, settle_timeout_s)
+            for e, f, s in pending
+        ]
+        chaos_stats = {}
+    if action_thread is not None:
+        action_thread.join(timeout=30.0)  # gofrlint: disable=deadline-dropped -- harness-level cleanup bound; settle_timeout_s budgets request futures, not the action thread
+
+    for event, exc, submitted_s in rejected:
+        retriable = isinstance(exc, RETRIABLE_ERRORS)
+        outcomes.append(Outcome(
+            index=event.index, tenant=event.tenant,
+            slo_class=event.slo_class, at_s=event.at_s,
+            submitted_s=submitted_s, ok=False,
+            finish_reason=type(exc).__name__,
+            error=("retriable" if retriable else "non-retriable")
+            + ":" + type(exc).__name__,
+        ))
+    outcomes.sort(key=lambda o: o.index)
+    duration = time.monotonic() - t0
+    return RunResult(
+        outcomes=outcomes,
+        duration_s=round(duration, 3),
+        trace_fingerprint=trace.fingerprint(),
+        stack=stack.snapshot(),
+        chaos=chaos_stats,
+        actions=action_log,
+    )

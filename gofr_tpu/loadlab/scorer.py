@@ -1,0 +1,261 @@
+"""Goodput scoring + the robustness invariant audit.
+
+**Goodput** (the metric the serving studies converge on): the fraction of
+requests that were SERVED within their SLO class's latency target —
+per tenant, per class, and per named time window. Raw req/s rewards a
+system for completing requests whose answers arrived too late to matter;
+goodput doesn't. A request is *good* iff its terminal state is a served
+answer (``stop``/``length``/``kv_exhausted``) AND its client-observed
+e2e latency is within the class target.
+
+The scorer is a pure function: ``score(records, slo_by_class, windows)``
+→ :class:`ScoreReport`. Same records → byte-identical report
+(``fingerprint()``), which is what makes "scorer output stable across
+reruns of the same seed" a testable claim. Records come from either side
+of the wire:
+
+- the driver's client-side :class:`~gofr_tpu.loadlab.driver.Outcome`
+  list (the primary path — client-observed e2e is the honest number);
+- exported timeline JSONL (:func:`records_from_jsonl`) — the PR 9
+  flight-recorder view, for re-scoring a finished run from disk (the
+  future capacity planner reads the same format).
+
+The **invariant audit** (:func:`check_invariants`) asserts the
+robustness claim end-to-end after a chaos run: zero lost requests,
+exactly one terminal mark per engine-side request, and the class
+ordering — interactive goodput degrades LAST, the batch class absorbs
+the damage.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Any, Iterable
+
+from gofr_tpu.serving.tenancy import DEADLINE_CLASSES
+
+# default per-class e2e SLO targets: the deadline-class defaults from the
+# tenancy plane (the engine enforces them as deadlines; the scorer grades
+# against the same numbers, so "good" ≈ "inside its deadline class")
+DEFAULT_SLO_S = {name: dl for name, (_prio, dl) in DEADLINE_CLASSES.items()}
+
+
+@dataclasses.dataclass(frozen=True)
+class Record:
+    """The scorer's normalized input row."""
+
+    index: int
+    tenant: str
+    slo_class: str
+    t_s: float              # submit offset on the run clock
+    served: bool            # reached a served terminal
+    e2e_s: float | None     # client-observed latency (None: never served)
+    ttft_s: float | None = None
+    finish_reason: str = ""
+
+
+def _percentile(values: list[float], q: float) -> float:
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = min(int(q * len(ordered)), len(ordered) - 1)
+    return ordered[idx]
+
+
+def _bucket(records: list[Record], slo_by_class: dict[str, float]) -> dict[str, Any]:
+    n = len(records)
+    good = [
+        r for r in records
+        if r.served and r.e2e_s is not None
+        and r.e2e_s <= slo_by_class.get(r.slo_class, float("inf"))
+    ]
+    ttfts = [r.ttft_s for r in records if r.ttft_s is not None]
+    e2es = [r.e2e_s for r in records if r.e2e_s is not None]
+    return {
+        "n": n,
+        "served": sum(1 for r in records if r.served),
+        "good": len(good),
+        "goodput": round(len(good) / n, 6) if n else None,
+        "ttft_p50_ms": round(_percentile(ttfts, 0.50) * 1e3, 3),
+        "ttft_p99_ms": round(_percentile(ttfts, 0.99) * 1e3, 3),
+        "e2e_p50_ms": round(_percentile(e2es, 0.50) * 1e3, 3),
+        "e2e_p99_ms": round(_percentile(e2es, 0.99) * 1e3, 3),
+    }
+
+
+@dataclasses.dataclass
+class ScoreReport:
+    total: dict[str, Any]
+    per_class: dict[str, dict[str, Any]]
+    per_tenant: dict[str, dict[str, Any]]
+    windows: dict[str, dict[str, dict[str, Any]]]  # window -> class -> bucket
+    slo_by_class: dict[str, float]
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def fingerprint(self) -> str:
+        """Content hash of the canonical JSON report — two scoring passes
+        over the same records must collide here, byte for byte."""
+        payload = json.dumps(self.to_dict(), sort_keys=True).encode("utf-8")
+        return hashlib.sha256(payload).hexdigest()
+
+    def goodput(self, slo_class: str | None = None,
+                window: str | None = None) -> float | None:
+        if window is not None:
+            bucket = self.windows.get(window, {}).get(slo_class or "_total")
+        elif slo_class is not None:
+            bucket = self.per_class.get(slo_class)
+        else:
+            bucket = self.total
+        return bucket.get("goodput") if bucket else None
+
+
+def _normalize(rows: Iterable[Any]) -> list[Record]:
+    out: list[Record] = []
+    for row in rows:
+        if isinstance(row, Record):
+            out.append(row)
+            continue
+        # a driver Outcome (duck-typed: dataclass or dict)
+        get = row.get if isinstance(row, dict) else lambda k, d=None: getattr(row, k, d)
+        out.append(Record(
+            index=int(get("index", len(out))),
+            tenant=str(get("tenant", "default")),
+            slo_class=str(get("slo_class", "standard")),
+            t_s=float(get("submitted_s", get("t_s", 0.0)) or 0.0),
+            served=bool(get("ok", get("served", False))),
+            e2e_s=get("e2e_s"),
+            ttft_s=get("ttft_s"),
+            finish_reason=str(get("finish_reason", "") or ""),
+        ))
+    return out
+
+
+def score(rows: Iterable[Any], *,
+          slo_by_class: dict[str, float] | None = None,
+          windows: dict[str, tuple[float, float]] | None = None) -> ScoreReport:
+    """Score client-side outcome rows (driver Outcomes, Records, or
+    dicts). ``windows`` maps name → ``(start_s, end_s)`` on the run
+    clock; a request belongs to a window iff it was SUBMITTED inside it
+    (damage is attributed to when load arrived, not when it resolved)."""
+    records = _normalize(rows)
+    slo = dict(slo_by_class or DEFAULT_SLO_S)
+    classes = sorted({r.slo_class for r in records})
+    tenants = sorted({r.tenant for r in records})
+    report = ScoreReport(
+        total=_bucket(records, slo),
+        per_class={
+            c: _bucket([r for r in records if r.slo_class == c], slo)
+            for c in classes
+        },
+        per_tenant={
+            t: _bucket([r for r in records if r.tenant == t], slo)
+            for t in tenants
+        },
+        windows={},
+        slo_by_class=slo,
+    )
+    for name, (start_s, end_s) in (windows or {}).items():
+        inside = [r for r in records if start_s <= r.t_s < end_s]
+        by_class = {
+            c: _bucket([r for r in inside if r.slo_class == c], slo)
+            for c in sorted({r.slo_class for r in inside})
+        }
+        by_class["_total"] = _bucket(inside, slo)
+        report.windows[name] = by_class
+    return report
+
+
+def records_from_jsonl(paths: Iterable[str], class_of_tenant: dict[str, str],
+                       t0_unix: float) -> list[Record]:
+    """Rebuild scorer records from exported timeline JSONL
+    (:meth:`TimelineRecorder.export_jsonl` format). Engine-side view:
+    ``e2e_ms`` here is submit→terminal on the SERVING replica — a
+    failover re-run appears as its own line per replica, so this path is
+    for re-scoring and capacity planning, not the zero-lost audit (the
+    driver's client-side outcomes own that)."""
+    out: list[Record] = []
+    for path in paths:
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                obj = json.loads(line)
+                tenant = obj.get("tenant") or "default"
+                reason = obj.get("finish_reason") or ""
+                e2e_ms = obj.get("e2e_ms")
+                ttft_ms = obj.get("ttft_ms")
+                out.append(Record(
+                    index=int(obj.get("request_id", len(out))),
+                    tenant=tenant,
+                    slo_class=class_of_tenant.get(tenant, "standard"),
+                    t_s=max(float(obj.get("created_unix", t0_unix)) - t0_unix,
+                            0.0),
+                    served=reason in ("stop", "length", "kv_exhausted"),
+                    e2e_s=e2e_ms / 1e3 if e2e_ms is not None else None,
+                    ttft_s=ttft_ms / 1e3 if ttft_ms is not None else None,
+                    finish_reason=reason,
+                ))
+    return out
+
+
+def check_invariants(outcomes: Iterable[Any], timelines: Iterable[Any] = (),
+                     *, report: ScoreReport | None = None,
+                     fault_window: str | None = None) -> list[str]:
+    """The robustness invariant, as a list of violations (empty = holds):
+
+    1. **zero lost requests** — every trace event reached a terminal
+       outcome (no ``lost`` rows);
+    2. **exactly one terminal** — every engine-side request timeline is
+       terminal with ``terminal_marks == 1`` (two marks = two settlement
+       paths both thought they won; zero = a stranded request);
+    3. **class ordering** — interactive goodput ≥ batch goodput overall,
+       and STRICTLY greater inside the named fault window (the window
+       must contain traffic of both classes to be gradeable — the
+       acceptance scenario guarantees it by pinning the storm there).
+    """
+    violations: list[str] = []
+    outcomes = list(outcomes)
+    lost = [o for o in outcomes
+            if getattr(o, "finish_reason", None) == "lost"]
+    if lost:
+        violations.append(
+            f"lost requests: {[getattr(o, 'index', '?') for o in lost]}"
+        )
+    for tl in timelines:
+        terminal = getattr(tl, "terminal", None)
+        marks = getattr(tl, "terminal_marks", None)
+        rid = getattr(tl, "request_id", "?")
+        if not terminal:
+            violations.append(f"request {rid}: no terminal state recorded")
+        elif marks != 1:
+            violations.append(
+                f"request {rid}: terminal_marks={marks} (want exactly 1)"
+            )
+    if report is not None:
+        overall_i = report.goodput("interactive")
+        overall_b = report.goodput("batch")
+        if overall_i is not None and overall_b is not None \
+                and overall_i < overall_b:
+            violations.append(
+                f"class ordering: interactive goodput {overall_i:.3f} < "
+                f"batch {overall_b:.3f} overall"
+            )
+        if fault_window is not None:
+            win_i = report.goodput("interactive", window=fault_window)
+            win_b = report.goodput("batch", window=fault_window)
+            if win_i is None or win_b is None:
+                violations.append(
+                    f"fault window {fault_window!r} lacks traffic of both "
+                    "classes — the scenario is not gradeable"
+                )
+            elif win_i <= win_b:
+                violations.append(
+                    f"class ordering under chaos: interactive goodput "
+                    f"{win_i:.3f} <= batch {win_b:.3f} in {fault_window!r}"
+                )
+    return violations

@@ -1,0 +1,296 @@
+"""The trace: schema + seeded generator for production-shaped workloads.
+
+A trace is the harness's unit of reproducibility: a fully materialized,
+seed-deterministic list of :class:`TraceEvent` — WHO (tenant, adapter),
+WHAT (prompt text, output budget), WHEN (arrival offset). Generating and
+replaying are deliberately separate: the same trace can drive a 2-replica
+CPU tier in CI and an 8-chip TPU tier in a hardware round, and a
+regression reproduces from the trace file alone (``Trace.to_jsonl``).
+
+Shape knobs mirror what the serving studies say matters:
+
+- **heavy-tailed lengths** — prompt/output token counts are lognormal
+  (the documented shape of production LLM traffic: a fat tail of long
+  prompts behind a short median), clamped to the engine's sequence
+  budget;
+- **shared-prefix populations** — a Zipf-weighted draw over ``n`` prefix
+  groups: a handful of system prompts dominate, exercising the PR 10/11
+  prefix-cache tiers and the router's prefix affinity exactly the way a
+  production mix does;
+- **tenant + adapter mixes** — each event carries a tenant riding the
+  PR 15 SLO-class labels and optionally one of the tenant's LoRA
+  adapters;
+- **storm windows** — a burst pinned to one tenant (the tenant-storm
+  chaos scenario: the batch tenant floods, the interactive tenant must
+  not feel it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import random
+from typing import Any, Iterable
+
+from gofr_tpu.loadlab import arrival
+from gofr_tpu.serving.tenancy import DEADLINE_CLASSES
+
+_FILLER = "abcdefghijklmnopqrstuvwxyz0123456789 "
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantMix:
+    """One tenant in the workload mix. ``weight`` is its share of
+    background traffic; ``adapters`` are LoRA adapter ids sampled
+    uniformly for ``adapter_share`` of the tenant's requests (the stack
+    registers them at build time)."""
+
+    name: str
+    slo_class: str = "standard"
+    weight: float = 1.0
+    adapters: tuple[str, ...] = ()
+    adapter_share: float = 0.5
+
+    def __post_init__(self) -> None:
+        if self.slo_class not in DEADLINE_CLASSES:
+            raise ValueError(
+                f"tenant {self.name!r}: slo_class {self.slo_class!r} "
+                f"not in {sorted(DEADLINE_CLASSES)}"
+            )
+        if self.weight <= 0:
+            raise ValueError(f"tenant {self.name!r}: weight must be > 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class BurstSpec:
+    """A burst window. ``tenant=None`` scales the whole mix (a diurnal
+    spike); a named tenant gets a dedicated arrival stream at
+    ``rps × multiplier`` for the window — the tenant storm."""
+
+    at_s: float
+    duration_s: float
+    multiplier: float
+    tenant: str | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceSpec:
+    """Everything the generator needs, seed included. Token counts
+    assume the ByteTokenizer (≈1 token per character), which keeps the
+    spec meaningful on the CPU reference tier; a TPU trace scales the
+    same spec up."""
+
+    seed: int
+    horizon_s: float = 20.0
+    base_rps: float = 6.0
+    peak_rps: float | None = None       # None → homogeneous at base_rps
+    diurnal_period_s: float | None = None  # None → one period over horizon
+    bursts: tuple[BurstSpec, ...] = ()
+    tenants: tuple[TenantMix, ...] = (
+        TenantMix("gold", "interactive", weight=3.0),
+        TenantMix("silver", "standard", weight=2.0),
+        TenantMix("bulk", "batch", weight=1.0),
+    )
+    # lognormal length shapes: median tokens + sigma (log-space), clamped
+    prompt_median: int = 24
+    prompt_sigma: float = 0.6
+    prompt_max: int = 96
+    output_median: int = 6
+    output_sigma: float = 0.5
+    output_max: int = 24
+    # shared-prefix population: `prefix_share` of requests draw one of
+    # `prefix_groups` system prompts, Zipf-weighted (group k gets ~1/k)
+    prefix_groups: int = 4
+    prefix_share: float = 0.6
+    prefix_len: int = 40
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceEvent:
+    """One request of the trace, fully materialized."""
+
+    index: int
+    at_s: float          # arrival offset from trace start, seconds
+    tenant: str
+    slo_class: str       # denormalized from the tenant mix
+    prompt: str
+    max_new_tokens: int
+    adapter_id: str | None = None
+    prefix_group: int | None = None  # shared-prefix population id
+
+    def to_dict(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        return {k: v for k, v in d.items() if v is not None}
+
+
+class Trace:
+    """An immutable, sorted event list + the metadata to reproduce it."""
+
+    def __init__(self, events: Iterable[TraceEvent],
+                 meta: dict[str, Any] | None = None) -> None:
+        self.events = tuple(sorted(events, key=lambda e: (e.at_s, e.index)))
+        self.meta = dict(meta or {})
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def __iter__(self):
+        return iter(self.events)
+
+    @property
+    def horizon_s(self) -> float:
+        return float(self.meta.get(
+            "horizon_s", self.events[-1].at_s if self.events else 0.0
+        ))
+
+    def tenants(self) -> dict[str, str]:
+        """tenant -> slo_class, as materialized in the events."""
+        return {e.tenant: e.slo_class for e in self.events}
+
+    def fingerprint(self) -> str:
+        """Content hash of the canonical JSON — the determinism anchor:
+        same spec, same seed → same fingerprint, every run."""
+        payload = json.dumps(
+            [e.to_dict() for e in self.events], sort_keys=True
+        ).encode("utf-8")
+        return hashlib.sha256(payload).hexdigest()
+
+    def to_jsonl(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(json.dumps({"meta": self.meta}, sort_keys=True) + "\n")
+            for event in self.events:
+                fh.write(json.dumps(event.to_dict(), sort_keys=True) + "\n")
+
+    @classmethod
+    def from_jsonl(cls, path: str) -> "Trace":
+        meta: dict[str, Any] = {}
+        events: list[TraceEvent] = []
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                obj = json.loads(line)
+                if "meta" in obj and "index" not in obj:
+                    meta = obj["meta"]
+                    continue
+                events.append(TraceEvent(
+                    index=int(obj["index"]), at_s=float(obj["at_s"]),
+                    tenant=obj["tenant"], slo_class=obj["slo_class"],
+                    prompt=obj["prompt"],
+                    max_new_tokens=int(obj["max_new_tokens"]),
+                    adapter_id=obj.get("adapter_id"),
+                    prefix_group=obj.get("prefix_group"),
+                ))
+        return cls(events, meta)
+
+
+def _filler(rng: random.Random, n: int) -> str:
+    return "".join(rng.choice(_FILLER) for _ in range(n))
+
+
+def _lognormal_int(rng: random.Random, median: int, sigma: float,
+                   lo: int, hi: int) -> int:
+    import math
+
+    value = rng.lognormvariate(math.log(max(median, 1)), sigma)
+    return max(lo, min(hi, int(round(value))))
+
+
+def generate_trace(spec: TraceSpec) -> Trace:
+    """Materialize a :class:`TraceSpec` into a :class:`Trace`. Pure
+    function of the spec (its seed included): every draw comes from
+    streams keyed on ``spec.seed`` — regenerating yields an identical
+    fingerprint, which tests/test_loadlab.py pins."""
+    rng_arr = random.Random(f"loadlab:arrivals:{spec.seed}")
+    rng_evt = random.Random(f"loadlab:events:{spec.seed}")
+
+    # -- background arrival stream (diurnal curve × untargeted bursts) ----
+    if spec.peak_rps is not None and spec.peak_rps > spec.base_rps:
+        period = spec.diurnal_period_s or spec.horizon_s
+        base_fn = arrival.diurnal(spec.base_rps, spec.peak_rps, period)
+    else:
+        base_fn = arrival.constant(spec.base_rps)
+    untargeted = [
+        (b.at_s, b.duration_s, b.multiplier)
+        for b in spec.bursts if b.tenant is None
+    ]
+    rate_fn = arrival.burst_windows(base_fn, untargeted) if untargeted else base_fn
+    offsets = arrival.poisson_arrivals(rng_arr, rate_fn, spec.horizon_s)
+    streams: list[tuple[float, str | None]] = [(t, None) for t in offsets]
+
+    # -- tenant-storm streams: a dedicated Poisson burst pinned to one
+    # tenant, ON TOP of the background mix (the storm is extra traffic,
+    # not a re-labeling of existing traffic)
+    for i, burst in enumerate(spec.bursts):
+        if burst.tenant is None:
+            continue
+        rng_storm = random.Random(f"loadlab:storm:{spec.seed}:{i}")
+
+        def storm_rate(t: float, _b=burst) -> float:
+            if _b.at_s <= t < _b.at_s + _b.duration_s:
+                return spec.base_rps * _b.multiplier
+            return 0.0
+
+        for t in arrival.poisson_arrivals(
+            rng_storm, storm_rate, spec.horizon_s,
+            rate_max=spec.base_rps * burst.multiplier,
+        ):
+            streams.append((t, burst.tenant))
+
+    # -- per-event materialization ---------------------------------------
+    mixes = {m.name: m for m in spec.tenants}
+    names = [m.name for m in spec.tenants]
+    weights = [m.weight for m in spec.tenants]
+    # Zipf weights over the shared-prefix groups; prefix text is a pure
+    # function of (seed, group) so every run regenerates the same system
+    # prompts
+    prefix_rng = random.Random(f"loadlab:prefixes:{spec.seed}")
+    prefixes = [
+        f"sys{g:02d}|" + _filler(prefix_rng, max(spec.prefix_len - 6, 1))
+        for g in range(spec.prefix_groups)
+    ]
+    zipf = [1.0 / (g + 1) for g in range(spec.prefix_groups)]
+
+    events: list[TraceEvent] = []
+    for index, (at_s, pinned) in enumerate(
+        sorted(streams, key=lambda s: s[0])
+    ):
+        tenant = pinned or rng_evt.choices(names, weights=weights, k=1)[0]
+        mix = mixes[tenant]
+        prompt_len = _lognormal_int(
+            rng_evt, spec.prompt_median, spec.prompt_sigma, 2, spec.prompt_max
+        )
+        max_new = _lognormal_int(
+            rng_evt, spec.output_median, spec.output_sigma, 1, spec.output_max
+        )
+        group: int | None = None
+        if spec.prefix_groups and rng_evt.random() < spec.prefix_share:
+            group = rng_evt.choices(
+                range(spec.prefix_groups), weights=zipf, k=1
+            )[0]
+            head = prefixes[group]
+        else:
+            head = _filler(rng_evt, min(8, prompt_len))
+        body_len = max(prompt_len - len(head), 1)
+        prompt = (head + f" u{index} " + _filler(rng_evt, body_len))[
+            : max(prompt_len, len(head) + 1)
+        ]
+        adapter: str | None = None
+        if mix.adapters and rng_evt.random() < mix.adapter_share:
+            adapter = rng_evt.choice(list(mix.adapters))
+        events.append(TraceEvent(
+            index=index, at_s=round(at_s, 6), tenant=tenant,
+            slo_class=mix.slo_class, prompt=prompt, max_new_tokens=max_new,
+            adapter_id=adapter, prefix_group=group,
+        ))
+
+    meta = {
+        "seed": spec.seed,
+        "horizon_s": spec.horizon_s,
+        "base_rps": spec.base_rps,
+        "n_events": len(events),
+        "tenants": {m.name: m.slo_class for m in spec.tenants},
+    }
+    return Trace(events, meta)

@@ -64,7 +64,8 @@ from gofr_tpu.serving.autoscaler import (
     SimulatedPoolDriver,
 )
 from gofr_tpu.serving.supervisor import EngineSupervisor
-from gofr_tpu.serving.timeline import RequestTimeline, TimelineRecorder
+from gofr_tpu.serving.timeline import (RequestTimeline, TimelineExporter,
+                                       TimelineRecorder)
 from gofr_tpu.serving.tokenizer import ByteTokenizer, Tokenizer
 from gofr_tpu.serving.lora import AdapterRegistry, LoraAdapter
 from gofr_tpu.serving.tenancy import TenantPolicy, TenantRegistry
@@ -84,6 +85,7 @@ __all__ = [
     "ReplicaAnnouncer",
     "Heartbeat",
     "TimelineRecorder",
+    "TimelineExporter",
     "RequestTimeline",
     "DeviceTelemetry",
     "TieredPrefixCache",

@@ -113,6 +113,11 @@ class EngineConfig:
     # exceeds this many seconds (0 disables the threshold; deadline-aware
     # shedding is always on for requests that carry a deadline)
     shed_max_wait_s: float = 0.0
+    # cold-start service-time prior for the shed estimator (seconds): the
+    # EWMA is seeded only by completed requests, so the first burst after
+    # startup otherwise estimates 0.0 wait at any queue depth and sheds
+    # nothing until the queue is already doomed. 0 keeps never-shed-blind.
+    shed_cold_prior_s: float = 0.0
     # graceful drain: how long in-flight generations get to finish before
     # the remainder is failed with a retriable error
     drain_deadline_s: float = 30.0
@@ -188,6 +193,9 @@ class EngineConfig:
                 config.get_or_default("TPU_REQUESTZ_CAPACITY", "256")
             ),
             shed_max_wait_s=float(config.get_or_default("TPU_SHED_MAX_WAIT_S", "0")),
+            shed_cold_prior_s=float(
+                config.get_or_default("TPU_SHED_COLD_PRIOR_S", "0")
+            ),
             drain_deadline_s=float(
                 config.get_or_default("TPU_DRAIN_DEADLINE_S", "30")
             ),
@@ -536,7 +544,9 @@ class ServingEngine:
         self._wake = threading.Event()
         # request-lifecycle robustness state: the queue-wait estimator
         # behind load shedding, and the drain/wedge lifecycle flags
-        self._shed = QueueWaitEstimator()
+        self._shed = QueueWaitEstimator(
+            cold_prior_s=self.config.shed_cold_prior_s
+        )
         self._draining = False
         self._wedged = False
         self._stop_requested = False  # distinguishes "stopped" from "not yet started"

@@ -17,10 +17,20 @@ thread, one multiply on the submit path:
 queue_depth/max_slots is how many admission "waves" stand ahead of this
 request; each wave costs roughly one smoothed request duration. An empty
 queue estimates 0.0 — an idle engine must never shed, even when warm-up
-(compile time) has inflated the service-time EWMA. Bias-corrected EWMAs
-would be overkill: the first observation seeds the average directly, and
-until the first completion the estimator reports 0.0 — shedding blind on
-a cold engine would reject the very traffic that warms it.
+(compile time) has inflated the service-time EWMA.
+
+Cold start is the estimator's known blind spot (PR 18 loadlab found it):
+the service-time EWMA is seeded only by COMPLETED requests, so the first
+burst after startup estimates 0.0 however deep the queue gets, and nothing
+sheds until requests already doomed to time out have piled up. The blend:
+until the first completion, service time falls back to the warmest signal
+available — the TTFT EWMA (first tokens of the warming wave are a live
+lower bound on service time), then the configured ``cold_prior_s``. The
+prior defaults to 0.0 — never-shed-blind stays the out-of-the-box
+behavior, because a wrong prior on a cold engine would reject the very
+traffic that warms it — and deployments that know their service-time
+scale (the load harness, production configs) opt in via
+``TPU_SHED_COLD_PRIOR_S``.
 """
 
 from __future__ import annotations
@@ -31,10 +41,13 @@ import threading
 class QueueWaitEstimator:
     """Thread-safe EWMA estimator of queue wait for a slot-based engine."""
 
-    def __init__(self, alpha: float = 0.25) -> None:
+    def __init__(self, alpha: float = 0.25, cold_prior_s: float = 0.0) -> None:
         if not 0.0 < alpha <= 1.0:
             raise ValueError("alpha must be in (0, 1]")
+        if cold_prior_s < 0.0:
+            raise ValueError("cold_prior_s must be >= 0")
         self.alpha = alpha
+        self.cold_prior_s = cold_prior_s
         self._mu = threading.Lock()
         self._ttft_s: float | None = None
         self._req_s: float | None = None
@@ -55,13 +68,21 @@ class QueueWaitEstimator:
 
     def estimate_wait(self, queue_depth: int, max_slots: int) -> float:
         """Predicted seconds a request submitted NOW spends queued behind
-        the ``queue_depth`` requests ahead of it. 0.0 until the first
-        completion (never shed blind) and 0.0 at empty queue (an idle
-        engine never sheds)."""
+        the ``queue_depth`` requests ahead of it. 0.0 at empty queue (an
+        idle engine never sheds). Before the first completion the service
+        time blends down the cold-start ladder: TTFT EWMA if the warming
+        wave has produced first tokens, else ``cold_prior_s`` — which is
+        0.0 unless configured, preserving never-shed-blind by default."""
         with self._mu:
             req_s = self._req_s
-        if req_s is None or queue_depth <= 0:
+            ttft_s = self._ttft_s
+        if queue_depth <= 0:
             return 0.0
+        if req_s is None:
+            req_s = max(ttft_s if ttft_s is not None else 0.0,
+                        self.cold_prior_s)
+            if req_s <= 0.0:
+                return 0.0
         waves = queue_depth / max(max_slots, 1)
         return waves * req_s
 
@@ -70,4 +91,5 @@ class QueueWaitEstimator:
             return {
                 "ewma_ttft_s": self._ttft_s or 0.0,
                 "ewma_request_s": self._req_s or 0.0,
+                "cold_prior_s": self.cold_prior_s,
             }

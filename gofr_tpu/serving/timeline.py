@@ -28,6 +28,7 @@ get racy-but-consistent-enough snapshots of a live request by design.
 from __future__ import annotations
 
 import collections
+import json
 import threading
 import time
 from typing import Any
@@ -237,6 +238,52 @@ class RequestTimeline:
         return out
 
 
+class TimelineExporter:
+    """Streaming JSONL sink for completed timelines: one ``to_dict()``
+    line per terminal settlement, written as requests finish. The bounded
+    ``/requestz`` ring keeps the last 256 — a production-load run settles
+    millions, and the goodput scorer (gofr_tpu/loadlab/scorer.py) and the
+    capacity planner both need every one of them. Writes happen on the
+    settling thread (usually the detok executor) under the exporter's own
+    lock, NEVER under the recorder mutex — a slow disk must not stall
+    ``/requestz`` readers or the engine's settlement path."""
+
+    def __init__(self, path: str, *, append: bool = False) -> None:
+        self.path = path
+        self._mu = threading.Lock()
+        self._fh = open(path, "a" if append else "w", encoding="utf-8")
+        self._lines = 0
+
+    def write(self, tl: "RequestTimeline") -> None:
+        line = json.dumps(tl.to_dict(), sort_keys=True)
+        with self._mu:
+            if self._fh.closed:
+                return  # settled after close(): the ring still has it
+            self._fh.write(line + "\n")
+            self._lines += 1
+
+    @property
+    def lines(self) -> int:
+        with self._mu:
+            return self._lines
+
+    def flush(self) -> None:
+        with self._mu:
+            if not self._fh.closed:
+                self._fh.flush()
+
+    def close(self) -> None:
+        with self._mu:
+            if not self._fh.closed:
+                self._fh.close()
+
+    def __enter__(self) -> "TimelineExporter":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
 class TimelineRecorder:
     """The flight recorder: all in-flight timelines plus a bounded ring
     of the last ``capacity`` completed ones."""
@@ -260,6 +307,7 @@ class TimelineRecorder:
         self._reuse: "collections.OrderedDict[Any, int]" = (
             collections.OrderedDict()
         )
+        self._exporter: TimelineExporter | None = None
 
     def observe_prefix_reuse(self, key: Any) -> None:
         """Record one admission-time hit on a prefix-cache key (engine
@@ -284,6 +332,20 @@ class TimelineRecorder:
             self._inflight[request_id] = tl
         return tl
 
+    def export_jsonl(self, path: str, *, append: bool = False) -> TimelineExporter:
+        """Stream every subsequently-completed timeline to ``path`` as
+        JSONL (one ``to_dict()`` object per line). Returns the exporter;
+        the caller owns its lifetime (``close()`` or context-manage it —
+        a closed exporter silently stops receiving, it never unhooks
+        itself mid-settlement). One exporter at a time: re-calling
+        replaces the hook, the displaced exporter is closed."""
+        exporter = TimelineExporter(path, append=append)
+        with self._mu:
+            displaced, self._exporter = self._exporter, exporter
+        if displaced is not None:
+            displaced.close()
+        return exporter
+
     def finish(self, tl: RequestTimeline, reason: str) -> bool:
         """Terminal settlement for one timeline. Exactly the future-
         settlement winner calls this with effect; a second call (two
@@ -293,6 +355,14 @@ class TimelineRecorder:
         with self._mu:
             self._inflight.pop(tl.request_id, None)
             self._done.append(tl)
+            exporter = self._exporter
+        if exporter is not None:
+            # outside the recorder mutex: a slow disk stalls only the
+            # settling thread, never /requestz readers
+            try:
+                exporter.write(tl)
+            except Exception:
+                pass  # export is observability, never a settlement gate
         return True
 
     def get(self, request_id: int) -> RequestTimeline | None:

@@ -1,0 +1,418 @@
+"""GoodputLab: the trace-driven production-load harness (gofr_tpu.loadlab).
+
+Unit tests pin the deterministic substrate — seeded arrival processes,
+trace generation/fingerprints, the wall-clock FaultSchedule, the goodput
+scorer. The ``chaos``-marked acceptance tests replay the canned
+chaos-under-load scenario (mid-run replica kill + batch-tenant storm +
+heartbeat partition, all on one clock) against the FULL serving stack
+and assert the robustness invariant the harness exists for:
+
+    zero lost requests, exactly one terminal per request, and
+    interactive-class goodput STRICTLY above batch-class goodput inside
+    the fault window — the batch tier absorbs the damage.
+
+Seeds are FIXED (101/202/303, the repo-wide chaos convention): a failure
+reproduces with ``pytest tests/test_loadlab.py -k <seed>`` every time.
+"""
+
+import json
+import os
+import random
+
+import pytest
+
+from gofr_tpu import chaos
+from gofr_tpu.loadlab import (
+    BurstSpec,
+    ChaosEvent,
+    ChaosPlan,
+    TenantMix,
+    Trace,
+    TraceSpec,
+    acceptance_scenario,
+    acceptance_stack_config,
+    check_invariants,
+    generate_trace,
+    score,
+)
+from gofr_tpu.loadlab.arrival import (
+    burst_windows,
+    constant,
+    diurnal,
+    poisson_arrivals,
+)
+from gofr_tpu.loadlab.scorer import Record, records_from_jsonl
+from gofr_tpu.serving.shed import QueueWaitEstimator
+
+CHAOS_SEEDS = (101, 202, 303)
+
+
+# -- arrival processes --------------------------------------------------------
+
+def test_poisson_arrivals_deterministic_and_rate_shaped():
+    a = poisson_arrivals(random.Random("t"), constant(10.0), 20.0)
+    b = poisson_arrivals(random.Random("t"), constant(10.0), 20.0)
+    assert a == b  # same stream, same offsets
+    assert all(0.0 <= t < 20.0 for t in a)
+    assert a == sorted(a)
+    # ~10 rps over 20 s: well within 5 sigma of 200
+    assert 120 < len(a) < 290
+
+
+def test_diurnal_rate_trough_to_peak():
+    fn = diurnal(2.0, 10.0, period_s=100.0)
+    assert fn(0.0) == pytest.approx(2.0)        # starts at the trough
+    assert fn(50.0) == pytest.approx(10.0)      # peak at half period
+    assert fn(100.0) == pytest.approx(2.0)
+
+
+def test_burst_windows_multiply_and_compound():
+    fn = burst_windows(constant(1.0), [(5.0, 10.0, 4.0), (10.0, 2.0, 2.0)])
+    assert fn(0.0) == pytest.approx(1.0)
+    assert fn(6.0) == pytest.approx(4.0)
+    assert fn(11.0) == pytest.approx(8.0)       # overlapping windows compound
+    assert fn(16.0) == pytest.approx(1.0)
+
+
+# -- trace generation ---------------------------------------------------------
+
+def test_trace_same_seed_same_fingerprint():
+    spec, _plan, _win = acceptance_scenario(101)
+    assert generate_trace(spec).fingerprint() == \
+        generate_trace(spec).fingerprint()
+    other = acceptance_scenario(202)[0]
+    assert generate_trace(spec).fingerprint() != \
+        generate_trace(other).fingerprint()
+
+
+def test_trace_jsonl_round_trip(tmp_path):
+    trace = generate_trace(TraceSpec(seed=7, horizon_s=4.0, base_rps=5.0))
+    path = str(tmp_path / "trace.jsonl")
+    trace.to_jsonl(path)
+    back = Trace.from_jsonl(path)
+    assert back.fingerprint() == trace.fingerprint()
+    assert back.meta == trace.meta
+    assert back.horizon_s == trace.horizon_s
+
+
+def test_tenant_storm_adds_pinned_traffic_in_window():
+    base = TraceSpec(seed=9, horizon_s=10.0, base_rps=3.0)
+    storm = TraceSpec(
+        seed=9, horizon_s=10.0, base_rps=3.0,
+        bursts=(BurstSpec(at_s=4.0, duration_s=3.0, multiplier=8.0,
+                          tenant="bulk"),),
+    )
+    quiet, stormy = generate_trace(base), generate_trace(storm)
+    assert len(stormy) > len(quiet)  # storm is EXTRA traffic, not relabeled
+    extra = len(stormy) - len(quiet)
+    in_window = [e for e in stormy
+                 if e.tenant == "bulk" and 4.0 <= e.at_s < 7.0]
+    assert len(in_window) >= extra // 2  # the bulk of it lands in-window
+    outside = [e for e in stormy if not 4.0 <= e.at_s < 7.0]
+    quiet_outside = [e for e in quiet if not 4.0 <= e.at_s < 7.0]
+    assert len(outside) == len(quiet_outside)  # background untouched
+
+
+def test_trace_shapes_prefixes_adapters_lengths():
+    spec = TraceSpec(
+        seed=11, horizon_s=10.0, base_rps=8.0,
+        tenants=(TenantMix("gold", "interactive", weight=1.0,
+                           adapters=("ad-a", "ad-b"), adapter_share=0.5),),
+        prompt_max=48, output_max=12,
+    )
+    trace = generate_trace(spec)
+    assert len(trace) > 20
+    groups = {e.prefix_group for e in trace if e.prefix_group is not None}
+    assert groups  # shared-prefix population materialized
+    shared = [e for e in trace if e.prefix_group is not None]
+    assert len(shared) / len(trace) > 0.3   # prefix_share=0.6 default
+    # group 0 dominates (Zipf weighting)
+    by_group = sorted(groups)
+    count0 = sum(1 for e in shared if e.prefix_group == by_group[0])
+    assert count0 >= len(shared) / (len(groups) + 1)
+    adapters = {e.adapter_id for e in trace if e.adapter_id}
+    assert adapters <= {"ad-a", "ad-b"} and adapters
+    assert all(len(e.prompt) <= 48 + 16 for e in trace)
+    assert all(1 <= e.max_new_tokens <= 12 for e in trace)
+    # prompts sharing a group share their head (the actual cache key)
+    g0 = [e.prompt for e in shared if e.prefix_group == by_group[0]]
+    if len(g0) >= 2:
+        assert g0[0][:20] == g0[1][:20]
+
+
+def test_tenant_mix_validates_slo_class():
+    with pytest.raises(ValueError):
+        TenantMix("x", "platinum")
+    with pytest.raises(ValueError):
+        TenantMix("x", "standard", weight=0.0)
+
+
+# -- FaultSchedule (chaos wall-clock scheduling) ------------------------------
+
+def test_fault_schedule_one_shot_latches_at_offset():
+    sched = chaos.FaultSchedule(
+        [chaos.ScheduledFault("engine.step", at_s=1.0)], seed=1
+    )
+    sched.arm(epoch=100.0)
+    assert sched.claim("engine.step", now=100.5) is None   # before at_s
+    assert sched.claim("engine.step", now=101.2) is not None  # latched
+    assert sched.claim("engine.step", now=101.3) is None   # budget spent
+
+
+def test_fault_schedule_window_rate_and_unbounded_budget():
+    sched = chaos.FaultSchedule(
+        [chaos.ScheduledFault("router.route", at_s=2.0, duration_s=3.0,
+                              rate=1.0, max_faults=None)],
+        seed=2,
+    )
+    sched.arm(epoch=0.0)
+    assert sched.claim("router.route", now=1.0) is None    # pre-window
+    assert sched.claim("router.route", now=2.5) is not None
+    assert sched.claim("router.route", now=4.9) is not None  # unbounded
+    assert sched.claim("router.route", now=5.1) is None    # post-window
+
+
+def test_fault_schedule_unarmed_never_fires_and_validates_points():
+    sched = chaos.FaultSchedule(
+        [chaos.ScheduledFault("engine.step", at_s=0.0)], seed=3
+    )
+    assert sched.claim("engine.step", now=10.0) is None    # never armed
+    with pytest.raises(ValueError):
+        chaos.FaultSchedule(
+            [chaos.ScheduledFault("not.a.point", at_s=0.0)]
+        )
+
+
+def test_injector_composes_schedule_with_probability_rates():
+    sched = chaos.FaultSchedule(
+        [chaos.ScheduledFault("engine.step", at_s=0.0)], seed=4
+    )
+    inj = chaos.ChaosInjector(4, {"router.route": 0.0}, schedule=sched)
+    sched.arm(epoch=0.0)
+    with pytest.raises(chaos.ChaosFault):
+        inj.fire("engine.step")
+    inj.fire("engine.step")          # budget spent: clean
+    inj.fire("router.route")         # rate 0.0: clean
+    stats = inj.stats()
+    assert stats["engine.step"]["scheduled"] == 1
+    assert stats["engine.step"]["faults"] == 1
+    assert stats["router.route"] == {"calls": 1, "faults": 0, "scheduled": 0}
+
+
+def test_chaos_plan_compiles_events_and_rejects_unknown():
+    plan = ChaosPlan(
+        events=(
+            ChaosEvent("replica_kill", at_s=1.0),
+            ChaosEvent("heartbeat_partition", at_s=2.0, duration_s=1.0),
+            ChaosEvent("point_fault", at_s=3.0, target="engine.step"),
+        ),
+        seed=5,
+    )
+    assert [a.kind for a in plan.stack_actions()] == ["replica_kill"]
+    sched = plan.fault_schedule()
+    assert sched is not None
+    assert sched.points() == {"router.heartbeat", "engine.step"}
+    inj = plan.injector()
+    assert inj is not None and inj.schedule is not None
+    assert inj.schedule.points() == sched.points()
+    with pytest.raises(ValueError):
+        ChaosEvent("meteor_strike", at_s=0.0)
+    with pytest.raises(ValueError):
+        ChaosEvent("point_fault", at_s=0.0, target="not.a.point")
+    assert ChaosPlan(events=()).injector() is None
+
+
+# -- shed estimator cold-start prior (PR 18 satellite) ------------------------
+
+def test_estimator_cold_burst_with_prior_sheds():
+    """Regression: a cold-start burst used to estimate 0 s wait (no EWMA
+    yet), admitting a queue the engine then serves straight into 504s.
+    With a configured prior the very first estimate reflects the queue."""
+    legacy = QueueWaitEstimator()
+    assert legacy.estimate_wait(40, 4) == 0.0        # documented blind spot
+    est = QueueWaitEstimator(cold_prior_s=0.5)
+    assert est.estimate_wait(40, 4) == pytest.approx(5.0)  # 10 waves x 0.5
+    assert est.estimate_wait(0, 4) == 0.0            # idle never sheds
+    # TTFT evidence (warmer than the prior) wins the blend
+    est.observe_ttft(1.0)
+    assert est.estimate_wait(4, 4) == pytest.approx(1.0)
+    # full-request EWMA supersedes the ladder entirely
+    est.observe_request(2.0)
+    assert est.estimate_wait(4, 4) == pytest.approx(2.0)
+    assert est.snapshot()["cold_prior_s"] == pytest.approx(0.5)
+    with pytest.raises(ValueError):
+        QueueWaitEstimator(cold_prior_s=-1.0)
+
+
+# -- scorer -------------------------------------------------------------------
+
+def _rec(i, cls, t, e2e, served=True, tenant=None):
+    return Record(index=i, tenant=tenant or cls, slo_class=cls, t_s=t,
+                  served=served, e2e_s=e2e, ttft_s=e2e and e2e / 4,
+                  finish_reason="stop" if served else "error")
+
+
+def test_score_goodput_per_class_and_window():
+    rows = [
+        _rec(0, "interactive", 1.0, 0.5),
+        _rec(1, "interactive", 5.0, 3.0),    # served but past 2 s SLO
+        _rec(2, "batch", 1.0, 10.0),
+        _rec(3, "batch", 5.0, None, served=False),
+        _rec(4, "standard", 5.5, 1.0),
+    ]
+    rep = score(rows, windows={"storm": (4.0, 8.0)})
+    assert rep.per_class["interactive"]["goodput"] == pytest.approx(0.5)
+    assert rep.per_class["batch"]["goodput"] == pytest.approx(0.5)
+    assert rep.total["n"] == 5 and rep.total["served"] == 4
+    storm = rep.windows["storm"]
+    assert storm["_total"]["n"] == 3       # membership by submit offset
+    assert storm["interactive"]["goodput"] == 0.0
+    assert storm["standard"]["goodput"] == 1.0
+    assert rep.goodput("standard", window="storm") == 1.0
+    # same rows, second pass: byte-identical report
+    assert rep.fingerprint() == score(
+        rows, windows={"storm": (4.0, 8.0)}
+    ).fingerprint()
+
+
+def test_check_invariants_catches_each_violation():
+    class _TL:
+        def __init__(self, rid, terminal, marks):
+            self.request_id = rid
+            self.terminal = terminal
+            self.terminal_marks = marks
+
+    lost = [type("O", (), {"finish_reason": "lost", "index": 3})()]
+    assert any("lost" in v for v in check_invariants(lost))
+    vs = check_invariants([], [_TL(1, True, 1), _TL(2, False, 0),
+                               _TL(3, True, 2)])
+    assert len(vs) == 2
+    rep = score([_rec(0, "interactive", 1.0, 5.0),     # misses 2 s SLO
+                 _rec(1, "batch", 1.0, 1.0)],
+                windows={"fault": (0.0, 2.0)})
+    vs = check_invariants([], [], report=rep, fault_window="fault")
+    assert any("class ordering" in v for v in vs)
+    good = score([_rec(0, "interactive", 1.0, 0.5),
+                  _rec(1, "batch", 1.0, 100.0)],        # misses 60 s SLO
+                 windows={"fault": (0.0, 2.0)})
+    assert check_invariants([], [], report=good, fault_window="fault") == []
+
+
+def test_records_from_jsonl_rescores_exported_timelines(tmp_path):
+    path = tmp_path / "tl.jsonl"
+    rows = [
+        {"request_id": 1, "tenant": "gold", "finish_reason": "stop",
+         "created_unix": 1000.5, "ttft_ms": 40.0, "e2e_ms": 900.0},
+        {"request_id": 2, "tenant": "bulk", "finish_reason": "shed",
+         "created_unix": 1001.0, "ttft_ms": None, "e2e_ms": None},
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    recs = records_from_jsonl(
+        [str(path)], {"gold": "interactive", "bulk": "batch"}, t0_unix=1000.0
+    )
+    assert [r.slo_class for r in recs] == ["interactive", "batch"]
+    assert recs[0].served and recs[0].e2e_s == pytest.approx(0.9)
+    assert recs[0].t_s == pytest.approx(0.5)
+    assert not recs[1].served
+    rep = score(recs)
+    assert rep.per_class["interactive"]["goodput"] == 1.0
+    assert rep.per_class["batch"]["goodput"] == 0.0
+
+
+# -- acceptance: chaos under production load ---------------------------------
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_goodput_under_chaos_invariant(seed, tmp_path):
+    """The tentpole invariant, end to end on the REAL stack: replay the
+    seeded trace (storm + diurnal + adapters + shared prefixes) while a
+    replica dies mid-run and heartbeats partition; zero lost requests,
+    exactly-one-terminal per engine-side request, and interactive goodput
+    strictly above batch inside the fault window."""
+    import jax
+
+    from gofr_tpu.loadlab import ServingStack, run_trace
+    from gofr_tpu.models import llama
+
+    spec, plan, fault_window = acceptance_scenario(seed)
+    trace = generate_trace(spec)
+    assert {"interactive", "batch"} <= set(trace.tenants().values())
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    stack_cfg = acceptance_stack_config(trace, export_dir=str(tmp_path))
+    with ServingStack(cfg, params, stack_cfg) as stack:
+        result = run_trace(stack, trace, plan=plan)
+        timelines = stack.timelines()
+
+    # every trace event produced exactly one outcome, none lost
+    assert len(result.outcomes) == len(trace)
+    assert result.lost == []
+    # the kill actually happened, close to its scheduled offset
+    assert [a["kind"] for a in result.actions] == ["replica_kill"]
+    assert result.stack["killed"], "no replica was killed"
+    assert abs(result.actions[0]["fired_s"] - result.actions[0]["at_s"]) < 1.0
+    # the heartbeat partition actually dropped scheduled beats
+    assert result.chaos["router.heartbeat"]["scheduled"] > 0
+
+    report = score(result.outcomes, windows={"fault": fault_window})
+    violations = check_invariants(
+        result.outcomes, timelines, report=report, fault_window="fault"
+    )
+    assert violations == [], violations
+    # non-vacuous: the storm did real damage somewhere
+    assert report.per_class["batch"]["goodput"] < 1.0 or \
+        report.total["goodput"] < 1.0
+
+    # scorer is a pure function: re-scoring the same outcomes is
+    # byte-identical, and the trace regenerates to the same fingerprint
+    again = score(result.outcomes, windows={"fault": fault_window})
+    assert again.fingerprint() == report.fingerprint()
+    assert generate_trace(spec).fingerprint() == result.trace_fingerprint
+
+    # the per-replica JSONL exports hold the same story (every line a
+    # terminal timeline with exactly one mark)
+    paths = [os.path.join(str(tmp_path), f) for f in os.listdir(str(tmp_path))
+             if f.endswith(".timelines.jsonl")]
+    assert paths
+    exported = []
+    for p in paths:
+        with open(p, encoding="utf-8") as fh:
+            exported.extend(json.loads(line) for line in fh if line.strip())
+    assert exported
+    assert all(row["terminal"] and row["terminal_marks"] == 1
+               for row in exported)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_clean_run_control_full_goodput():
+    """Same trace, zero chaos: the tier must hold ~full goodput — proof
+    the chaos runs' damage comes from the injected faults, not from the
+    harness or an overloaded baseline outside the storm."""
+    import jax
+
+    from gofr_tpu.loadlab import ServingStack, run_trace
+    from gofr_tpu.models import llama
+
+    spec, _plan, fault_window = acceptance_scenario(101)
+    # the storm stays (it is trace shape, not chaos) — but no kill, no
+    # partition: shedding the flood is allowed, losing requests is not
+    trace = generate_trace(spec)
+    cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    with ServingStack(cfg, params, acceptance_stack_config(trace)) as stack:
+        result = run_trace(stack, trace)
+        timelines = stack.timelines()
+
+    assert result.lost == []
+    report = score(result.outcomes, windows={"fault": fault_window})
+    violations = check_invariants(
+        result.outcomes, timelines, report=report
+    )
+    assert violations == [], violations
+    # outside the storm the tier is comfortably provisioned
+    pre = score([o for o in result.outcomes if o.at_s < fault_window[0]])
+    assert pre.total["goodput"] is not None
+    assert pre.total["goodput"] >= 0.9
