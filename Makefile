@@ -58,7 +58,7 @@ ci: lint bench-check
 # interactive-class goodput strictly above batch inside the fault
 # window (seeds in tests/test_loadlab.py::CHAOS_SEEDS).
 chaos:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py tests/test_supervisor.py tests/test_pubsub_chaos.py tests/test_router_chaos.py tests/test_disagg.py tests/test_loadlab.py -q -m chaos
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py tests/test_supervisor.py tests/test_pubsub_chaos.py tests/test_router_chaos.py tests/test_disagg.py tests/test_loadlab.py tests/test_reclaim.py -q -m chaos
 
 # goodput ratchet gate (docs/robustness.md, docs/performance.md#bench-ratchet):
 # one deterministic chaos-under-load trace (seed 101) through the full

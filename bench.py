@@ -657,6 +657,60 @@ def _loadlab_goodput(cfg: Any, params: Any, on_tpu: bool) -> dict:
     }
 
 
+def _loadlab_reclamation(cfg: Any, params: Any, on_tpu: bool) -> dict:
+    """Goodput under a reclamation storm (PR 19, docs/robustness.md#the-
+    reclamation-plane): the canned reclamation scenario — mixed fleet
+    with two preemptible decode replicas, a notice storm reclaiming both
+    mid-burst — replayed open-loop against the FULL stack. The ratchet
+    metric is interactive-class goodput while the plane drains, evacuates
+    committed KV to the survivors, and backfills (direction:"max"): the
+    claim under grade is that reclamation is a batch-class event. Raises
+    on any invariant violation, lost request, or dropped notice."""
+    from gofr_tpu.loadlab import (
+        ServingStack,
+        check_invariants,
+        generate_trace,
+        reclamation_scenario,
+        reclamation_stack_config,
+        run_trace,
+        score,
+    )
+
+    spec, plan, _window = reclamation_scenario(101, horizon_s=5.0,
+                                               base_rps=3.0)
+    trace = generate_trace(spec)
+    stack_cfg = reclamation_stack_config(trace)
+    with ServingStack(cfg, params, stack_cfg) as stack:
+        result = run_trace(stack, trace, plan=plan)
+        timelines = stack.timelines()
+    report = score(result.outcomes)
+    violations = check_invariants(
+        result.outcomes, timelines, report=report, fault_window=None
+    )
+    if violations:
+        raise RuntimeError(f"reclamation invariant violated: {violations}")
+    if result.lost:
+        raise RuntimeError(f"reclamation lost {len(result.lost)} requests")
+    if result.stack["notices_total"] < 1:
+        raise RuntimeError("reclamation storm delivered no notices")
+    inter = report.per_class["interactive"]
+    return {
+        "goodput_under_reclamation": inter["goodput"],
+        "goodput_total": report.total["goodput"],
+        "goodput_batch": report.per_class["batch"]["goodput"],
+        "n_requests": report.total["n"],
+        "notices_total": result.stack["notices_total"],
+        "notices_dropped_total": result.stack["notices_dropped_total"],
+        "kv_evacuations_total": result.stack["kv_evacuations_total"],
+        "kv_evacuations_failed_total": result.stack[
+            "kv_evacuations_failed_total"
+        ],
+        "scale_ups": result.stack["scale_ups"],
+        "trace_fingerprint": result.trace_fingerprint,
+        "report_fingerprint": report.fingerprint(),
+    }
+
+
 def _router_warm_prefix(cfg: Any, params: Any, on_tpu: bool) -> dict:
     """Warm-prefix TTFT at multi-replica scale (ROADMAP item 3, AIBrix
     multi-tier KV pooling arXiv:2504.03648): two in-process replicas
@@ -1577,6 +1631,21 @@ def _run_benchmarks(platform: str, init_error: str | None, wall_start: float) ->
         if "error" not in ll_line:
             _append_local_record(ll_line)
 
+    # --- goodput under a reclamation storm (PR 19 reclamation plane) -------
+    def run_reclamation() -> dict:
+        if params is None:
+            raise RuntimeError("skipped: headline phase failed to build params")
+        return _loadlab_reclamation(cfg, params, on_tpu)
+
+    reclaim_line = _phase_line(
+        f"loadlab_goodput_under_reclamation_{model_kind}_{platform}",
+        "fraction", run_reclamation, value_key="goodput_under_reclamation",
+        on_tpu=on_tpu and not init_error, init_error=init_error,
+    )
+    print(json.dumps(reclaim_line), flush=True)
+    if "error" not in reclaim_line:
+        _append_local_record(reclaim_line)
+
     # --- framework-only phases (no TPU dependence at all) ------------------
     echo_line = _phase_line(
         "grpc_unary_echo_req_per_s", "req/s", _grpc_unary_echo,
@@ -1830,6 +1899,19 @@ def _run_loadlab_only() -> int:
             failed = True
         else:
             _append_local_record(line)
+
+    reclaim_line = _phase_line(
+        f"loadlab_goodput_under_reclamation_{model_kind}_{platform}",
+        "fraction",
+        lambda: _loadlab_reclamation(cfg, params, on_tpu),
+        value_key="goodput_under_reclamation",
+        on_tpu=on_tpu and not init_error, init_error=init_error,
+    )
+    print(json.dumps(reclaim_line), flush=True)
+    if "error" in reclaim_line:
+        failed = True
+    else:
+        _append_local_record(reclaim_line)
     return 1 if failed else 0
 
 

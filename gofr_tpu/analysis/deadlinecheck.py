@@ -896,7 +896,7 @@ BOUNDARY_CLASSES: dict[str, set[str]] = {
     "LocalReplica": {"submit"},
     "HTTPReplica": {"submit", "fetch_kv"},
     "ServingEngine": {"submit"},
-    "KVMigrator": {"fetch_chain", "fetch_handoff"},
+    "KVMigrator": {"fetch_chain", "fetch_handoff", "evacuate_chain"},
     "AdapterRegistry": {"acquire"},
 }
 BOUNDARY_FUNCS: set[str] = {"run_stream"}

@@ -87,7 +87,7 @@ DISPATCH_ZONES: dict[str, set[str] | str] = {
     },
     "gofr_tpu/serving/prefix_index.py": {
         "fetch_chain", "fetch_one", "fetch_handoff", "fetch_one_handoff",
-        "locate", "longest_chain", "observe",
+        "evacuate_chain", "locate", "longest_chain", "observe",
     },
     # disaggregation plane: the autoscaler's control loop must stay on
     # interruptible Event.wait pacing, and the remote-stream transport's

@@ -53,6 +53,8 @@ POINTS = (
     "scale.decision",   # autoscaler control-loop decision (skipped round)
     "tenant.preempt",   # preemption ladder (faulted = skipped, advisory)
     "lora.upload",      # async adapter upload (faulted = requeue, transient)
+    "replica.reclaim",  # reclamation-notice delivery (faulted = notice lost)
+    "kv.evacuate",      # reclaim-side bulk KV push (source dies mid-push)
 )
 
 

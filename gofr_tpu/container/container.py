@@ -350,6 +350,25 @@ class Container:
             "app_lora_adapter_residency",
             "LoRA adapters resident in the device factor tables",
         )
+        # the reclamation plane (serving/engine.py begin_reclaim +
+        # prefix_index.py evacuate_chain, docs/robustness.md "The
+        # reclamation plane"): provider notices honored, committed KV
+        # moved to survivors, and how much of each notice deadline the
+        # drain ladder actually consumed
+        m.new_counter(
+            "app_replica_reclamations_total",
+            "Reclamation notices accepted by this replica's drain ladder",
+        )
+        m.new_counter(
+            "app_kv_evacuations_total",
+            "KV evacuation batches pushed to survivors during reclaim "
+            "(label outcome = committed|failed|skipped)",
+        )
+        m.new_histogram(
+            "app_reclaim_drain_seconds",
+            "Wall time from reclamation notice to engine stop",
+            buckets=(0.1, 0.25, 0.5, 1, 2, 5, 10, 30, 60, 120),
+        )
 
     # -- accessors mirroring the reference's API ------------------------------
     @property

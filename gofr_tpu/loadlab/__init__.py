@@ -28,16 +28,27 @@ Module map (docs/robustness.md "Goodput under production load"):
   execution against the stack;
 - :mod:`gofr_tpu.loadlab.scorer` — goodput scoring + the robustness
   invariant audit (zero lost, exactly-one terminal, class ordering);
+- :mod:`gofr_tpu.loadlab.planner` — the trace-replay capacity planner
+  (fleet-mix grid × reclamation-rate schedules → min-cost mix meeting
+  per-class SLOs; ``python -m gofr_tpu.loadlab plan``);
 - ``python -m gofr_tpu.loadlab`` — the CLI front door.
 """
 
 from gofr_tpu.loadlab.arrival import burst_windows, constant, diurnal, poisson_arrivals
 from gofr_tpu.loadlab.driver import Outcome, RunResult, run_trace
+from gofr_tpu.loadlab.planner import (
+    FleetMix,
+    PlanReport,
+    PlannerConfig,
+    plan,
+)
 from gofr_tpu.loadlab.scenario import (
     ChaosEvent,
     ChaosPlan,
     acceptance_scenario,
     acceptance_stack_config,
+    reclamation_scenario,
+    reclamation_stack_config,
 )
 from gofr_tpu.loadlab.scorer import (
     ScoreReport,
@@ -59,7 +70,10 @@ __all__ = [
     "BurstSpec",
     "ChaosEvent",
     "ChaosPlan",
+    "FleetMix",
     "Outcome",
+    "PlanReport",
+    "PlannerConfig",
     "RunResult",
     "ScoreReport",
     "ServingStack",
@@ -75,8 +89,11 @@ __all__ = [
     "constant",
     "diurnal",
     "generate_trace",
+    "plan",
     "poisson_arrivals",
     "records_from_jsonl",
+    "reclamation_scenario",
+    "reclamation_stack_config",
     "run_trace",
     "score",
 ]

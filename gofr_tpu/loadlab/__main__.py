@@ -1,6 +1,7 @@
 """CLI: run the canned chaos-under-load scenario and print the score.
 
     python -m gofr_tpu.loadlab --seed 101 --horizon-s 12 --json out.json
+    python -m gofr_tpu.loadlab plan --seed 101 --json plan.json
 
 Builds the tiny CPU model, assembles the full stack (router + role-split
 replicas + autoscaler), replays the seeded trace with the mid-run kill /
@@ -18,6 +19,14 @@ import tempfile
 
 
 def main(argv: list[str] | None = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "plan":
+        # the capacity planner front door: virtual-time grid sweep, no
+        # jax, no stack — see gofr_tpu/loadlab/planner.py
+        from gofr_tpu.loadlab.planner import main as plan_main
+
+        return plan_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="python -m gofr_tpu.loadlab",
         description="trace-driven chaos-under-load goodput run",

@@ -133,7 +133,18 @@ def run_trace(stack: ServingStack, trace: Trace, *,
             if wait > 0:
                 time.sleep(wait)
             try:
-                victim = stack.kill(action.target)
+                if action.kind == "replica_notice":
+                    victim = stack.notice(
+                        action.target,
+                        deadline_s=getattr(action, "deadline_s", None),
+                    ) or "no-preemptible-replica"
+                elif action.kind == "notice_storm":
+                    noticed = stack.notice_storm(
+                        deadline_s=getattr(action, "deadline_s", None)
+                    )
+                    victim = ",".join(noticed) or "no-preemptible-replica"
+                else:
+                    victim = stack.kill(action.target)
             except Exception as exc:  # noqa: BLE001 - log, keep replaying
                 victim = f"error:{type(exc).__name__}"
             action_log.append({

@@ -1,0 +1,494 @@
+"""Trace-replay capacity planner: the cheapest fleet that holds its SLOs.
+
+The reclamation plane (docs/robustness.md) makes preemptible capacity
+SAFE — a notice drains, evacuates KV, and degrades batch goodput only.
+This module answers the question that safety raises: *how much* of the
+fleet should be preemptible? Buying all on-demand wastes money the
+reclamation plane exists to save; buying all preemptible puts the
+interactive SLO at the mercy of the provider's reclamation rate.
+
+The planner replays a loadlab trace through a VIRTUAL-TIME model of the
+tier for every fleet mix in a grid (N on-demand × M preemptible decode
+replicas) crossed with a schedule of reclamation rates, and reports the
+minimum-cost mix whose per-class goodput meets its SLO floor under
+EVERY rate in the schedule. The fleet itself is built and noticed
+through the real :class:`~gofr_tpu.serving.autoscaler.SimulatedPoolDriver`
+— the same scale-up/notice/preemptible bookkeeping the serving stack
+uses, including the ``replica.reclaim`` chaos point on notice delivery
+(a faulted delivery is a LOST notice: the replica keeps serving, and the
+planner's grade reflects the luck) — only the request service itself is
+simulated, so a full grid sweep runs in milliseconds with zero device
+work and bit-identical output for a fixed (trace, seed).
+
+Replay semantics mirror the live tier's policies:
+
+- **routing** — interactive-class arrivals prefer on-demand replicas
+  (the router's reclamation-aware steering); batch prefers preemptible
+  (that is what the discount buys); everything picks the earliest free
+  slot within its preference tier.
+- **notice** — a noticed replica admits nothing from the notice onward.
+  In-flight work finishing inside the drain share of the notice budget
+  completes; the rest retries on a survivor — WARM (remaining work
+  only, plus a small migration charge) when evacuation is on, COLD
+  (full re-prefill + decode) in the no-evacuation control.
+- **grading** — a request is good when it finishes inside its
+  SLO-class deadline (:data:`~gofr_tpu.serving.tenancy.DEADLINE_CLASSES`);
+  a request with no surviving replica to land on is LOST, which fails
+  every floor.
+
+CLI: ``python -m gofr_tpu.loadlab plan`` (docs/performance.md "Capacity
+planning").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+from typing import Any
+
+from gofr_tpu.serving.autoscaler import SimulatedPoolDriver
+from gofr_tpu.serving.tenancy import DEADLINE_CLASSES
+
+__all__ = [
+    "FleetMix", "PlannerConfig", "PlanReport", "plan", "simulate_mix",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetMix:
+    """One candidate decode fleet: ``on_demand`` dedicated replicas plus
+    ``preemptible`` discounted ones."""
+
+    on_demand: int
+    preemptible: int
+
+    @property
+    def total(self) -> int:
+        return self.on_demand + self.preemptible
+
+    def cost(self, cfg: "PlannerConfig", horizon_s: float) -> float:
+        """Fleet cost over the trace horizon, in price-units (prices are
+        per replica-hour, like the cloud bills them)."""
+        hourly = (self.on_demand * cfg.on_demand_price
+                  + self.preemptible * cfg.preemptible_price)
+        return round(hourly * horizon_s / 3600.0, 6)
+
+
+@dataclasses.dataclass
+class PlannerConfig:
+    """Planner knobs (docs/performance.md has the table). Service-rate
+    defaults are calibrated to the tiny-CPU reference tier; production
+    planning feeds measured rates in."""
+
+    # grid: inclusive ranges of decode replica counts to sweep (a
+    # zero-on-demand column is legal — the planner exists to show when
+    # it stops being safe)
+    on_demand_min: int = 0
+    on_demand_max: int = 4
+    preemptible_min: int = 0
+    preemptible_max: int = 4
+    # expected reclamation notices per preemptible replica-hour; the mix
+    # must hold its floors under EVERY rate listed (0.0 = calm market as
+    # the control point)
+    reclamation_rates: tuple[float, ...] = (0.0, 60.0)
+    notice_deadline_s: float = 2.0
+    # share of the notice budget reserved for KV evacuation — in-flight
+    # work fitting the remaining drain share completes on the doomed
+    # replica (mirrors EngineConfig.reclaim_evacuate_frac)
+    evacuate_frac: float = 0.35
+    # prices per replica-hour; the ~70% discount is the planner's whole
+    # reason to prefer preemptible capacity
+    on_demand_price: float = 1.0
+    preemptible_price: float = 0.3
+    # virtual service model: tokens/second one replica sustains, and its
+    # concurrent slots — calibrated so one replica saturates around the
+    # acceptance trace's base rate (the sweep must DISCRIMINATE; a model
+    # where one replica absorbs everything grades every mix equal)
+    tokens_per_s: float = 200.0
+    slots: int = 2
+    # retry charges when a notice preempts in-flight work
+    retry_delay_s: float = 0.1        # failover re-route latency
+    migration_s: float = 0.05         # warm-resume evacuation charge
+    evacuation: bool = True           # False = no-evacuation control
+    # per-class goodput floors a mix must meet under every rate
+    slo_floors: dict[str, float] = dataclasses.field(
+        default_factory=lambda: {
+            "interactive": 0.98, "standard": 0.90, "batch": 0.50,
+        }
+    )
+
+    def mixes(self) -> list[FleetMix]:
+        out = []
+        for n in range(self.on_demand_min, self.on_demand_max + 1):
+            for m in range(self.preemptible_min, self.preemptible_max + 1):
+                if n + m >= 1:
+                    out.append(FleetMix(n, m))
+        return out
+
+
+class _SimReplica:
+    """A LocalReplica-compatible stub the pool driver can own: health,
+    drain, and begin_reclaim are bookkeeping — service time lives in the
+    planner's virtual clock."""
+
+    def __init__(self, replica_id: str, role: str,
+                 preemptible: bool = False) -> None:
+        self.replica_id = replica_id
+        self.role = role
+        self.preemptible = preemptible
+        self.reclaimed_deadline_s: float | None = None
+
+    def health_check(self) -> dict[str, Any]:
+        return {"status": "UP", "details": {}}
+
+    def drain(self, deadline_s: float | None = None) -> None:
+        self.reclaimed_deadline_s = deadline_s
+
+    def begin_reclaim(self, deadline_s: float | None = None,
+                      **_kw: Any) -> dict[str, Any]:
+        self.reclaimed_deadline_s = deadline_s
+        return {"accepted": True}
+
+
+class _NullRouter:
+    """The driver registers replicas somewhere; the planner has no
+    routing tier — policy is replayed directly."""
+
+    def add_replica(self, handle: Any, role: str | None = None) -> None:
+        pass
+
+    def remove_replica(self, replica_id: str) -> None:
+        pass
+
+
+class _Server:
+    """Virtual-time state for one replica: per-slot busy-until clocks
+    plus the (delivered) notice time after which nothing is admitted."""
+
+    __slots__ = ("rid", "preemptible", "free", "notice_at")
+
+    def __init__(self, rid: str, preemptible: bool, slots: int) -> None:
+        self.rid = rid
+        self.preemptible = preemptible
+        self.free = [0.0] * slots
+        self.notice_at: float | None = None
+
+    def earliest(self) -> tuple[float, int]:
+        slot = min(range(len(self.free)), key=lambda i: (self.free[i], i))
+        return self.free[slot], slot
+
+    def admits(self, t: float) -> bool:
+        return self.notice_at is None or t < self.notice_at
+
+
+def _notice_times(rid: str, seed: int, rate_per_hour: float,
+                  horizon_s: float) -> list[float]:
+    """Deterministic Poisson notice arrivals for one preemptible
+    replica. Only the FIRST delivered notice matters (the replica is
+    gone after it), but later ones let a chaos-dropped first notice be
+    followed by a delivered second — exactly the provider's behavior."""
+    if rate_per_hour <= 0:
+        return []
+    rng = random.Random(f"{seed}:{rid}:reclaim")
+    out, t = [], 0.0
+    while True:
+        t += rng.expovariate(rate_per_hour / 3600.0)
+        if t >= horizon_s:
+            return out
+        out.append(round(t, 6))
+
+
+def simulate_mix(trace: Any, mix: FleetMix, rate_per_hour: float,
+                 cfg: PlannerConfig, seed: int) -> dict[str, Any]:
+    """Replay ``trace`` against one fleet mix under one reclamation
+    rate. Returns per-class goodput plus notice/evacuation counters."""
+    driver = SimulatedPoolDriver(
+        _NullRouter(),
+        lambda role, rid, preemptible=False: _SimReplica(
+            rid, role, preemptible
+        ),
+    )
+    if mix.on_demand:
+        driver.scale_up("decode", mix.on_demand)
+    if mix.preemptible:
+        driver.scale_up("decode", mix.preemptible, preemptible=True)
+    preemptible = set(driver.preemptible_ids("decode"))
+    servers = {
+        rid: _Server(rid, rid in preemptible, cfg.slots)
+        for rid in driver.replica_ids("decode")
+    }
+    horizon = float(getattr(trace, "horizon_s", 0.0) or (
+        trace.events[-1].at_s if trace.events else 0.0
+    ))
+    # deliver the rate's notice schedule through the REAL driver (the
+    # replica.reclaim chaos point sits on delivery; a faulted delivery
+    # is a lost notice and the server keeps admitting)
+    notices_delivered = 0
+    for rid in sorted(preemptible):
+        for at_s in _notice_times(rid, seed, rate_per_hour, horizon):
+            if servers[rid].notice_at is not None:
+                break
+            if driver.notice(rid, deadline_s=cfg.notice_deadline_s):
+                servers[rid].notice_at = at_s
+                notices_delivered += 1
+    drain_share = cfg.notice_deadline_s * (
+        1.0 - min(max(cfg.evacuate_frac, 0.0), 0.9)
+    )
+    per_class: dict[str, dict[str, int]] = {}
+    evacuations = retries = lost = 0
+    # preemptive-priority approximation of the engine's scheduler
+    # (stepplan priority order + the _maybe_preempt ladder): a class
+    # books capacity as if every LOWER class did not exist, so a batch
+    # flood queues behind interactive instead of ahead of it — exactly
+    # what the live tier's preemption plane guarantees. Each class
+    # replays in arrival order within its pass.
+    ordered = sorted(
+        trace.events,
+        key=lambda e: (
+            DEADLINE_CLASSES.get(e.slo_class, (1, 10.0))[0],
+            e.at_s, e.index,
+        ),
+    )
+    for event in ordered:
+        klass = event.slo_class
+        bucket = per_class.setdefault(klass, {"n": 0, "good": 0})
+        bucket["n"] += 1
+        service_s = (
+            len(event.prompt) + event.max_new_tokens
+        ) / cfg.tokens_per_s
+        deadline_s = DEADLINE_CLASSES.get(klass, (1, 10.0))[1]
+        t = event.at_s
+        candidates = [s for s in servers.values() if s.admits(t)]
+        if not candidates:
+            lost += 1
+            continue
+        # the router's steering, replayed: interactive prefers
+        # on-demand, batch prefers the discounted capacity; within a
+        # preference tier, earliest free slot wins (stable by rid)
+        if klass == "interactive":
+            prefer = [s for s in candidates if not s.preemptible]
+        elif klass == "batch":
+            prefer = [s for s in candidates if s.preemptible]
+        else:
+            prefer = []
+        pool = prefer or candidates
+        server = min(pool, key=lambda s: (s.earliest()[0], s.rid))
+        free_at, slot = server.earliest()
+        start = max(t, free_at)
+        finish = start + service_s
+        if server.notice_at is not None and start >= server.notice_at:
+            # the slot only frees AFTER the notice: this server never
+            # runs it — fall back to the widest admitting pool
+            fallback = [
+                s for s in candidates
+                if s is not server and s.admits(t)
+            ]
+            if not fallback:
+                lost += 1
+                continue
+            server = min(fallback, key=lambda s: (s.earliest()[0], s.rid))
+            free_at, slot = server.earliest()
+            start = max(t, free_at)
+            finish = start + service_s
+        if server.notice_at is not None and finish > server.notice_at:
+            # in-flight when the notice lands: the drain share of the
+            # budget lets it complete — past that it is preempted and
+            # retried on a survivor
+            if finish <= server.notice_at + drain_share:
+                server.free[slot] = finish  # fits the drain budget
+            else:
+                cut = server.notice_at
+                done_s = max(cut - start, 0.0)
+                server.free[slot] = cut
+                survivors = [
+                    s for s in servers.values()
+                    if s is not server and s.admits(cut + cfg.retry_delay_s)
+                ]
+                if not survivors:
+                    lost += 1
+                    continue
+                retries += 1
+                if cfg.evacuation:
+                    remaining = service_s - done_s + cfg.migration_s
+                    evacuations += 1
+                else:
+                    remaining = service_s  # cold re-prefill, from zero
+                s2 = min(survivors, key=lambda s: (s.earliest()[0], s.rid))
+                free2, slot2 = s2.earliest()
+                start2 = max(cut + cfg.retry_delay_s, free2)
+                finish = start2 + remaining
+                s2.free[slot2] = finish
+        else:
+            server.free[slot] = finish
+        if finish - t <= deadline_s:
+            bucket["good"] += 1
+    goodput = {
+        klass: round(b["good"] / b["n"], 4) if b["n"] else 1.0
+        for klass, b in sorted(per_class.items())
+    }
+    return {
+        "rate_per_hour": rate_per_hour,
+        "goodput": goodput,
+        "counts": {k: b["n"] for k, b in sorted(per_class.items())},
+        "notices_delivered": notices_delivered,
+        "notices_dropped": driver.notices_dropped_total,
+        "retries": retries,
+        "evacuations": evacuations,
+        "lost": lost,
+    }
+
+
+@dataclasses.dataclass
+class PlanReport:
+    """The sweep's output: every (mix, rate) cell plus the winner."""
+
+    trace_fingerprint: str
+    seed: int
+    horizon_s: float
+    grid: list[dict[str, Any]]
+    best: dict[str, Any] | None
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def fingerprint(self) -> str:
+        import hashlib
+
+        blob = json.dumps(self.to_dict(), sort_keys=True).encode("utf-8")
+        return hashlib.blake2b(blob, digest_size=16).hexdigest()
+
+
+def plan(trace: Any, cfg: PlannerConfig | None = None,
+         seed: int = 0) -> PlanReport:
+    """Sweep the fleet grid × reclamation-rate schedule over ``trace``
+    and pick the cheapest mix meeting every SLO floor under every rate.
+    Fully deterministic for a fixed (trace, cfg, seed): ties break by
+    (cost, total replicas, fewer preemptible)."""
+    cfg = cfg or PlannerConfig()
+    horizon = float(getattr(trace, "horizon_s", 0.0))
+    grid: list[dict[str, Any]] = []
+    feasible: list[tuple[float, int, int, FleetMix, dict[str, Any]]] = []
+    for mix in cfg.mixes():
+        runs = [
+            simulate_mix(trace, mix, rate, cfg, seed)
+            for rate in cfg.reclamation_rates
+        ]
+        # the mix is graded on its WORST goodput over the rate schedule
+        worst = {
+            klass: min(r["goodput"].get(klass, 1.0) for r in runs)
+            for klass in sorted(
+                {k for r in runs for k in r["goodput"]}
+            )
+        }
+        lost = sum(r["lost"] for r in runs)
+        meets = lost == 0 and all(
+            worst.get(klass, 0.0) >= floor
+            for klass, floor in cfg.slo_floors.items()
+            if any(klass in r["goodput"] for r in runs)
+        )
+        cost = mix.cost(cfg, horizon)
+        cell = {
+            "on_demand": mix.on_demand,
+            "preemptible": mix.preemptible,
+            "cost": cost,
+            "meets_slo": meets,
+            "worst_goodput": worst,
+            "runs": runs,
+        }
+        grid.append(cell)
+        if meets:
+            feasible.append(
+                (cost, mix.total, mix.preemptible, mix, cell)
+            )
+    best = None
+    if feasible:
+        feasible.sort(key=lambda f: (f[0], f[1], f[2]))
+        _cost, _total, _pre, mix, cell = feasible[0]
+        best = {
+            "on_demand": mix.on_demand,
+            "preemptible": mix.preemptible,
+            "cost": cell["cost"],
+            "worst_goodput": cell["worst_goodput"],
+        }
+    return PlanReport(
+        trace_fingerprint=trace.fingerprint(),
+        seed=seed,
+        horizon_s=horizon,
+        grid=grid,
+        best=best,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    """``python -m gofr_tpu.loadlab plan``: generate the acceptance
+    trace shape, sweep the grid, print the winner, optionally dump the
+    full JSON report."""
+    import argparse
+    import sys
+
+    parser = argparse.ArgumentParser(
+        prog="python -m gofr_tpu.loadlab plan",
+        description="trace-replay capacity planner over fleet mixes",
+    )
+    parser.add_argument("--seed", type=int, default=101)
+    parser.add_argument("--horizon-s", type=float, default=60.0)
+    parser.add_argument("--base-rps", type=float, default=8.0)
+    parser.add_argument("--trace", metavar="PATH",
+                        help="replay this JSONL trace instead of "
+                             "generating one")
+    parser.add_argument("--on-demand-max", type=int, default=4)
+    parser.add_argument("--preemptible-max", type=int, default=4)
+    parser.add_argument("--rates", default="0,60",
+                        help="comma-separated reclamation rates "
+                             "(notices per replica-hour)")
+    parser.add_argument("--notice-deadline-s", type=float, default=2.0)
+    parser.add_argument("--no-evacuation", action="store_true",
+                        help="control: a notice is a cold kill")
+    parser.add_argument("--json", metavar="PATH",
+                        help="write the full report JSON here")
+    args = parser.parse_args(argv)
+
+    from gofr_tpu.loadlab.trace import Trace, generate_trace
+    from gofr_tpu.loadlab.scenario import reclamation_scenario
+
+    if args.trace:
+        trace = Trace.from_jsonl(args.trace)
+    else:
+        spec, _plan, _window = reclamation_scenario(
+            args.seed, horizon_s=args.horizon_s, base_rps=args.base_rps
+        )
+        trace = generate_trace(spec)
+    cfg = PlannerConfig(
+        on_demand_max=args.on_demand_max,
+        preemptible_max=args.preemptible_max,
+        reclamation_rates=tuple(
+            float(r) for r in args.rates.split(",") if r.strip()
+        ),
+        notice_deadline_s=args.notice_deadline_s,
+        evacuation=not args.no_evacuation,
+    )
+    report = plan(trace, cfg, seed=args.seed)
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as fh:
+            json.dump(report.to_dict(), fh, indent=2, sort_keys=True)
+    print(f"trace: {len(trace)} events over {report.horizon_s:.1f}s "
+          f"fingerprint={report.trace_fingerprint[:12]}",
+          file=sys.stderr)
+    for cell in report.grid:
+        mark = "OK " if cell["meets_slo"] else "---"
+        gp = " ".join(
+            f"{k}={v}" for k, v in cell["worst_goodput"].items()
+        )
+        print(f"{mark} on_demand={cell['on_demand']} "
+              f"preemptible={cell['preemptible']} "
+              f"cost={cell['cost']:.4f} {gp}")
+    if report.best is None:
+        print("no mix meets the SLO floors — widen the grid or relax "
+              "the floors", file=sys.stderr)
+        return 1
+    print(f"best: on_demand={report.best['on_demand']} "
+          f"preemptible={report.best['preemptible']} "
+          f"cost={report.best['cost']:.4f} "
+          f"report_fingerprint={report.fingerprint()[:12]}")
+    return 0

@@ -6,10 +6,14 @@ unrelated things go wrong at known times?". A :class:`ChaosPlan` is the
 declarative answer: a list of :class:`ChaosEvent` at wall-clock offsets
 relative to the run's t=0, split by kind into
 
-- **stack actions** (``replica_kill``) — executed by the load driver
-  against the :class:`~gofr_tpu.loadlab.stack.ServingStack` (an abrupt
-  kill: announcer silenced, engine hard-stopped; the router must
-  DISCOVER the death through missed beats + retriable errors);
+- **stack actions** (``replica_kill``, ``replica_notice``,
+  ``notice_storm``) — executed by the load driver against the
+  :class:`~gofr_tpu.loadlab.stack.ServingStack`. A kill is abrupt
+  (announcer silenced, engine hard-stopped; the router must DISCOVER the
+  death through missed beats + retriable errors); a notice is the
+  ORDERLY reclamation path (drain-with-deadline, batch shed, KV
+  evacuation — docs/robustness.md "The reclamation plane"), and a
+  notice storm notices EVERY live preemptible replica at once;
 - **injected faults** (``heartbeat_partition``, ``point_fault``) —
   compiled into a :class:`gofr_tpu.chaos.FaultSchedule` and installed
   through the standard injector, so they compose with per-point
@@ -27,7 +31,8 @@ from typing import Any
 
 from gofr_tpu import chaos
 
-KINDS = ("replica_kill", "heartbeat_partition", "point_fault")
+KINDS = ("replica_kill", "replica_notice", "notice_storm",
+         "heartbeat_partition", "point_fault")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -42,6 +47,9 @@ class ChaosEvent:
     duration_s: float = 0.0
     target: str | None = None
     rate: float = 1.0
+    # reclamation-notice budget for replica_notice / notice_storm
+    # (None = the stack's configured notice_deadline_s)
+    deadline_s: float | None = None
 
     def __post_init__(self) -> None:
         if self.kind not in KINDS:
@@ -65,7 +73,8 @@ class ChaosPlan:
     def stack_actions(self) -> list[ChaosEvent]:
         """Events the driver executes against the stack, in time order."""
         return sorted(
-            (e for e in self.events if e.kind == "replica_kill"),
+            (e for e in self.events
+             if e.kind in ("replica_kill", "replica_notice", "notice_storm")),
             key=lambda e: e.at_s,
         )
 
@@ -158,6 +167,70 @@ def acceptance_scenario(seed: int, *, horizon_s: float = 8.0,
     )
     fault_window = (storm.at_s, round(storm.at_s + storm.duration_s, 3))
     return spec, plan, fault_window
+
+
+def reclamation_scenario(seed: int, *, horizon_s: float = 8.0,
+                         base_rps: float = 4.0):
+    """The canned reclamation-under-load scenario the A/B acceptance
+    test and the bench reclamation phase share: the acceptance trace's
+    tenant mix on a MIXED fleet (see :func:`reclamation_stack_config`),
+    with a notice STORM — every preemptible replica reclaimed at once —
+    landing mid-run while a batch-tenant burst is in flight. The claim
+    under grade: reclamation degrades batch goodput only; interactive
+    goodput holds its SLO floor because the router steers it onto
+    on-demand capacity and the reclaim ladder evacuates committed KV to
+    the survivors. Returns ``(TraceSpec, ChaosPlan, fault_window)``."""
+    from gofr_tpu.loadlab.trace import BurstSpec, TenantMix, TraceSpec
+
+    storm_at = round(horizon_s * 0.40, 3)
+    burst = BurstSpec(
+        at_s=round(horizon_s * 0.30, 3),
+        duration_s=round(horizon_s * 0.35, 3),
+        multiplier=6.0, tenant="bulk",
+    )
+    spec = TraceSpec(
+        seed=seed,
+        horizon_s=horizon_s,
+        base_rps=base_rps,
+        peak_rps=base_rps * 2.0,
+        bursts=(burst,),
+        output_median=8,
+        output_max=16,
+        tenants=(
+            TenantMix("gold", "interactive", weight=3.0),
+            TenantMix("silver", "standard", weight=2.0),
+            TenantMix("bulk", "batch", weight=1.0),
+        ),
+    )
+    plan = ChaosPlan(
+        events=(
+            ChaosEvent("notice_storm", at_s=storm_at, deadline_s=2.0),
+        ),
+        seed=seed,
+    )
+    fault_window = (burst.at_s, round(burst.at_s + burst.duration_s, 3))
+    return spec, plan, fault_window
+
+
+def reclamation_stack_config(trace: Any, **overrides: Any):
+    """The tuned mixed-fleet :class:`StackConfig` for the reclamation
+    scenario — shared by the bench reclamation phase and the A/B test:
+    one prefill + three decode replicas of which TWO are preemptible, so
+    the storm reclaims half the decode pool while on-demand capacity
+    (plus autoscaler backfill) absorbs the interactive class."""
+    from gofr_tpu.loadlab.stack import StackConfig
+
+    kw: dict[str, Any] = dict(
+        roles=("prefill", "decode", "decode", "decode"),
+        preemptible={"decode": 2},
+        tenants=trace.tenants(),
+        max_slots=4,
+        shed_cold_prior_s=0.05,
+        shed_max_wait_s=0.5,
+        notice_deadline_s=2.0,
+    )
+    kw.update(overrides)
+    return StackConfig(**kw)
 
 
 def acceptance_stack_config(trace: Any, **overrides: Any):

@@ -35,6 +35,7 @@ from gofr_tpu.serving import (
     RouterConfig,
     ServingEngine,
     local_engine_fetcher,
+    local_engine_store,
 )
 from gofr_tpu.serving.autoscaler import (
     Autoscaler,
@@ -72,6 +73,14 @@ class StackConfig:
     autoscale_up_wait_s: float = 0.35
     autoscale_up_stable_s: float = 0.5
     autoscale_interval_s: float = 0.25
+    # role -> how many of that role's INITIAL replicas are preemptible
+    # capacity (reclamation notices only ever target these; scale-up
+    # backfill is always on-demand). {} = all-on-demand fleet.
+    preemptible: dict[str, int] = dataclasses.field(default_factory=dict)
+    # notice budget handed to ServingEngine.begin_reclaim when a
+    # reclamation notice lands (docs/robustness.md "The reclamation
+    # plane")
+    notice_deadline_s: float = 2.0
     # tenant -> slo class for the shared registry; adapter ids registered
     # on every engine's LoRA table
     tenants: dict[str, str] = dataclasses.field(default_factory=dict)
@@ -110,6 +119,9 @@ class ServingStack:
             broker=self.broker,
         )
         self.tenant_registry = TenantRegistry()
+        # the router steers interactive-class traffic off preemptible
+        # capacity; it needs the registry to resolve a request's class
+        self.router.use_tenants(self.tenant_registry)
         for name, slo_class in self.config.tenants.items():
             self.tenant_registry.set_policy(
                 TenantPolicy(name=name, deadline_class=slo_class)
@@ -145,7 +157,8 @@ class ServingStack:
         self._started = False
 
     # -- the pool factory (runs on the autoscaler thread too) ---------------
-    def _build_replica(self, role: str, rid: str) -> LocalReplica:
+    def _build_replica(self, role: str, rid: str,
+                       preemptible: bool = False) -> LocalReplica:
         migrator = KVMigrator(rid, self.router.prefix_index)
         lora = None
         if self.config.adapters:
@@ -167,6 +180,7 @@ class ServingStack:
                 shed_cold_prior_s=self.config.shed_cold_prior_s,
                 shed_max_wait_s=self.config.shed_max_wait_s,
                 role=role,
+                preemptible=preemptible,
             ),
             ByteTokenizer(self.model_cfg.vocab_size),
             kv_migrator=migrator,
@@ -179,11 +193,19 @@ class ServingStack:
                 os.path.join(self.config.export_dir, f"{rid}.timelines.jsonl")
             )
         with self._mu:
-            # warm-migration mesh: full peering, both directions
+            # warm-migration mesh: full peering, both directions — pull
+            # fetchers for handoff/affinity migration AND push stores
+            # for reclamation evacuation (serving/prefix_index.py)
             for other_rid, other_engine in self.engines.items():
                 migrator.add_peer(other_rid, local_engine_fetcher(other_engine))
+                migrator.add_push_peer(
+                    other_rid, local_engine_store(other_engine)
+                )
                 self.migrators[other_rid].add_peer(
                     rid, local_engine_fetcher(engine)
+                )
+                self.migrators[other_rid].add_push_peer(
+                    rid, local_engine_store(engine)
                 )
             self.engines[rid] = engine
             self.migrators[rid] = migrator
@@ -216,7 +238,12 @@ class ServingStack:
         self._started = True
         self.router.start()
         for role in dict.fromkeys(self.config.roles):
-            self.pool.scale_up(role, self.config.roles.count(role))
+            total = self.config.roles.count(role)
+            spot = min(self.config.preemptible.get(role, 0), total)
+            if total - spot:
+                self.pool.scale_up(role, total - spot)
+            if spot:
+                self.pool.scale_up(role, spot, preemptible=True)
         import time as _time
 
         deadline = _time.monotonic() + ready_timeout_s
@@ -317,6 +344,45 @@ class ServingStack:
         engine.stop()
         return rid
 
+    def notice(self, rid: str | None = None,
+               deadline_s: float | None = None) -> str | None:
+        """Reclamation notice: the cloud provider wants a preemptible
+        replica back in ``deadline_s`` seconds. Unlike :meth:`kill` this
+        is the ORDERLY path — the pool driver delivers the notice (a
+        chaos fault at ``replica.reclaim`` models a LOST notice, never a
+        kill) and the engine runs its drain → evacuate → stop ladder.
+        Picks the first live preemptible replica when ``rid`` is None;
+        returns the target id (None when no preemptible replica is
+        live)."""
+        if deadline_s is None:
+            deadline_s = self.config.notice_deadline_s
+        with self._mu:
+            if rid is None:
+                spot = [
+                    r for r in self.pool.preemptible_ids()
+                    if r not in self.killed
+                ]
+                if not spot:
+                    return None
+                rid = sorted(spot)[0]
+        self.pool.notice(rid, deadline_s=deadline_s)
+        return rid
+
+    def notice_storm(self, deadline_s: float | None = None) -> list[str]:
+        """Every live preemptible replica noticed at once — the
+        worst-case reclamation event the batch-goodput-only degradation
+        claim is asserted against."""
+        if deadline_s is None:
+            deadline_s = self.config.notice_deadline_s
+        with self._mu:
+            spot = sorted(
+                r for r in self.pool.preemptible_ids()
+                if r not in self.killed
+            )
+        for rid in spot:
+            self.pool.notice(rid, deadline_s=deadline_s)
+        return spot
+
     # -- audit surface -------------------------------------------------------
     def timelines(self) -> list[Any]:
         """Every RequestTimeline the tier ever recorded — all replicas,
@@ -333,6 +399,7 @@ class ServingStack:
         with self._mu:
             rids = list(self.engines)
             killed = list(self.killed)
+            migrators = list(self.migrators.values())
         return {
             "replicas": rids,
             "killed": killed,
@@ -344,4 +411,13 @@ class ServingStack:
             ),
             "routed_total": self.router.routed_total,
             "failovers_total": self.router.failovers_total,
+            "preemptible": sorted(self.pool.preemptible_ids()),
+            "notices_total": self.pool.notices_total,
+            "notices_dropped_total": self.pool.notices_dropped_total,
+            "kv_evacuations_total": sum(
+                m.evacuations_total for m in migrators
+            ),
+            "kv_evacuations_failed_total": sum(
+                m.failed_evacuations_total for m in migrators
+            ),
         }

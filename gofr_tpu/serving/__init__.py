@@ -57,6 +57,7 @@ from gofr_tpu.serving.prefix_index import (
     KVMigrator,
     PrefixIndex,
     local_engine_fetcher,
+    local_engine_store,
 )
 from gofr_tpu.serving.autoscaler import (
     Autoscaler,
@@ -93,6 +94,7 @@ __all__ = [
     "PrefixIndex",
     "KVMigrator",
     "local_engine_fetcher",
+    "local_engine_store",
     "Autoscaler",
     "AutoscalerConfig",
     "SimulatedPoolDriver",

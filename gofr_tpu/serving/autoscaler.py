@@ -117,13 +117,26 @@ class ReplicaPoolDriver:
         """Live (non-draining) replica ids of this role."""
         raise NotImplementedError
 
-    def scale_up(self, role: str, n: int) -> list[str]:
-        """Add ``n`` replicas to the role's pool; returns their ids."""
+    def scale_up(self, role: str, n: int, *,
+                 preemptible: bool = False) -> list[str]:
+        """Add ``n`` replicas to the role's pool; returns their ids.
+        ``preemptible`` requests reclaimable (spot) capacity — cheaper,
+        but the provider may :meth:`notice` it back at any time."""
         raise NotImplementedError
 
     def begin_drain(self, replica_id: str) -> None:
         """Start the victim's graceful drain (never blocks the caller,
         never kills in-flight work)."""
+        raise NotImplementedError
+
+    def notice(self, replica_id: str, deadline_s: float = 5.0) -> bool:
+        """Deliver a reclamation notice (docs/robustness.md "The
+        reclamation plane"): the provider takes ``replica_id``'s machine
+        back in ``deadline_s`` seconds. Implementations run the
+        replica's ``begin_reclaim`` ladder (deadline-bounded drain +
+        bulk KV evacuation) without blocking the caller, and report the
+        event to a wired ``on_notice`` observer. Returns False when the
+        notice was lost in delivery."""
         raise NotImplementedError
 
     def reap(self, replica_id: str) -> bool:
@@ -144,16 +157,24 @@ class SimulatedPoolDriver(ReplicaPoolDriver):
 
     def __init__(self, router: Any,
                  factory: Callable[[str, str], Any],
-                 *, on_reap: Callable[[Any], None] | None = None) -> None:
+                 *, on_reap: Callable[[Any], None] | None = None,
+                 on_notice: Callable[..., None] | None = None) -> None:
         self.router = router
         self.factory = factory
         self._on_reap = on_reap
+        # reclamation observer: called (replica_id, role=, deadline_s=)
+        # after a notice is DELIVERED — the autoscaler self-wires here
+        # (Autoscaler.observe_notice) to backfill outside its hysteresis
+        self.on_notice = on_notice
         self._mu = threading.Lock()
         self._handles: dict[str, Any] = {}
         self._roles: dict[str, str] = {}
+        self._preemptible: set[str] = set()
         self._draining: set[str] = set()
         self._drained: set[str] = set()  # drain call returned
         self._next = 0
+        self.notices_total = 0
+        self.notices_dropped_total = 0  # replica.reclaim chaos faults
 
     # -- driver surface --------------------------------------------------------
     def replica_ids(self, role: str) -> list[str]:
@@ -163,19 +184,68 @@ class SimulatedPoolDriver(ReplicaPoolDriver):
                 if r == role and rid not in self._draining
             ]
 
-    def scale_up(self, role: str, n: int) -> list[str]:
+    def preemptible_ids(self, role: str | None = None) -> list[str]:
+        """Live (non-draining) preemptible replica ids — the notice-storm
+        injectors and the capacity planner enumerate the reclaimable
+        share of the fleet through this."""
+        with self._mu:
+            return [
+                rid for rid, r in self._roles.items()
+                if (role is None or r == role)
+                and rid in self._preemptible
+                and rid not in self._draining
+            ]
+
+    def role_of(self, replica_id: str) -> str | None:
+        with self._mu:
+            return self._roles.get(replica_id)
+
+    def scale_up(self, role: str, n: int, *,
+                 preemptible: bool = False) -> list[str]:
         out = []
         for _ in range(n):
             with self._mu:
                 self._next += 1
                 rid = f"{role}-{self._next}"
-            handle = self.factory(role, rid)
+            handle = self._make(role, rid, preemptible)
             with self._mu:
                 self._handles[rid] = handle
                 self._roles[rid] = role
+                if preemptible:
+                    self._preemptible.add(rid)
             self.router.add_replica(handle, role=role)
             out.append(rid)
         return out
+
+    def _make(self, role: str, rid: str, preemptible: bool) -> Any:
+        """Build one replica. Existing 2-arg factories keep working; a
+        factory declaring ``preemptible`` (or **kwargs) receives the
+        capacity class so it can set ``EngineConfig.preemptible`` and
+        the handle attribute the router's steering reads."""
+        if preemptible:
+            try:
+                import inspect
+
+                params = inspect.signature(self.factory).parameters
+                accepts = "preemptible" in params or any(
+                    p.kind == p.VAR_KEYWORD for p in params.values()
+                )
+            except (TypeError, ValueError):
+                accepts = False
+            if accepts:
+                return self.factory(role, rid, preemptible=True)
+            handle = self.factory(role, rid)
+            # best-effort: mark the handle (and its engine) so the
+            # heartbeat + router steering still see the capacity class
+            try:
+                handle.preemptible = True
+                engine = getattr(handle, "engine", None)
+                if engine is not None:
+                    engine.preemptible = True
+            except Exception:
+                pass
+            return handle
+        return self.factory(role, rid)
 
     def begin_drain(self, replica_id: str) -> None:
         with self._mu:
@@ -201,6 +271,63 @@ class SimulatedPoolDriver(ReplicaPoolDriver):
             target=run, daemon=True, name=f"drain-{replica_id}"
         ).start()
 
+    def notice(self, replica_id: str, deadline_s: float = 5.0) -> bool:
+        """Reclamation-notice injector: delivers the provider's
+        "machine goes away in ``deadline_s`` seconds" webhook to the
+        replica. The ``replica.reclaim`` chaos point sits ON delivery —
+        a fault there means the notice is LOST (counted; the replica
+        keeps serving until the machine actually dies, which the loadlab
+        kill path models separately) — never a kill here. A delivered
+        notice runs the replica's ``begin_reclaim`` ladder
+        (deadline-bounded drain + bulk KV evacuation; plain ``drain``
+        when the handle predates the reclamation plane) on a daemon
+        thread and reports to ``on_notice`` so the autoscaler can
+        backfill immediately."""
+        with self._mu:
+            handle = self._handles.get(replica_id)
+            role = self._roles.get(replica_id)
+        if handle is None:
+            return False
+        try:
+            chaos.maybe_fail("replica.reclaim")
+        except Exception:
+            self.notices_dropped_total += 1
+            return False
+        self.notices_total += 1
+        with self._mu:
+            already = replica_id in self._draining
+            self._draining.add(replica_id)
+        if not already:
+            engine = getattr(handle, "engine", None)
+            reclaim = getattr(handle, "begin_reclaim", None) or getattr(
+                engine, "begin_reclaim", None
+            )
+            drain = getattr(handle, "drain", None) or getattr(
+                engine, "drain", None
+            )
+
+            def run() -> None:
+                try:
+                    if reclaim is not None:
+                        reclaim(deadline_s)
+                    elif drain is not None:
+                        drain(deadline_s)
+                finally:
+                    with self._mu:
+                        self._drained.add(replica_id)
+
+            threading.Thread(
+                target=run, daemon=True, name=f"reclaim-{replica_id}"
+            ).start()
+        if self.on_notice is not None:
+            try:
+                self.on_notice(
+                    replica_id, role=role, deadline_s=deadline_s
+                )
+            except Exception:
+                pass  # the observer must not break notice delivery
+        return True
+
     def _idle(self, handle: Any) -> bool:
         try:
             health = handle.health_check() or {}
@@ -224,6 +351,7 @@ class SimulatedPoolDriver(ReplicaPoolDriver):
         with self._mu:
             self._handles.pop(replica_id, None)
             self._roles.pop(replica_id, None)
+            self._preemptible.discard(replica_id)
             self._draining.discard(replica_id)
             self._drained.discard(replica_id)
         if self._on_reap is not None:
@@ -277,7 +405,13 @@ class Autoscaler:
         self.scale_ups_total = 0
         self.scale_downs_total = 0
         self.decisions_skipped_total = 0  # scale.decision chaos faults
+        self.notices_observed_total = 0  # reclamation forced-drains seen
         self.decisions: list[dict[str, Any]] = []  # bounded action log
+        # reclamation wiring: a SimulatedPoolDriver-shaped driver exposes
+        # on_notice — self-wire the forced-drain observer unless the
+        # caller already installed one
+        if getattr(driver, "on_notice", False) is None:
+            driver.on_notice = self.observe_notice
 
     # -- lifecycle -------------------------------------------------------------
     def start(self) -> None:
@@ -392,6 +526,38 @@ class Autoscaler:
             self.scale_downs_total += 1
             self._record(role, "down", [victim], wait, hbm, current - 1)
 
+    def observe_notice(self, replica_id: str, *, role: str | None = None,
+                       deadline_s: float | None = None) -> None:
+        """A reclamation notice is a FORCED drain from outside the
+        control loop: the victim is already reclaiming (the driver ran
+        its ladder at delivery), so hysteresis and cooldown do not apply
+        — this round's job is (a) adopt the victim into the reap cycle
+        (drain-never-kill: reap waits for idle exactly like a scale-down
+        victim) and (b) backfill the lost capacity IMMEDIATELY, ahead of
+        the queue-wait signal the notice will cause seconds from now.
+        The backfill is ON-DEMAND capacity by construction (scale_up's
+        default): replacing reclaimed spot with more spot mid-storm
+        would just get noticed again."""
+        self.notices_observed_total += 1
+        with self._mu:
+            self._reaping.add(replica_id)
+        if role is None:
+            role_of = getattr(self.driver, "role_of", None)
+            role = role_of(replica_id) if role_of is not None else None
+        if role is None or role not in self.roles:
+            return  # not a pool this scaler sizes: adopt-for-reap only
+        cfg = self.config
+        current = len(self.driver.replica_ids(role))
+        if current >= cfg.max_replicas:
+            return
+        added = self.driver.scale_up(role, 1)
+        self.scale_ups_total += 1
+        self._record(
+            role, "backfill", added,
+            self.router.membership.aggregate_queue_wait(role),
+            None, current + 1,
+        )
+
     def _pick_victim(self, role: str) -> str | None:
         """Least-loaded live replica of the role — draining the emptiest
         pod loses the least warm KV and finishes fastest."""
@@ -442,5 +608,6 @@ class Autoscaler:
             "scale_ups_total": self.scale_ups_total,
             "scale_downs_total": self.scale_downs_total,
             "decisions_skipped_total": self.decisions_skipped_total,
+            "notices_observed_total": self.notices_observed_total,
             "decisions": list(self.decisions[-16:]),
         }

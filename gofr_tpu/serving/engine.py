@@ -129,6 +129,16 @@ class EngineConfig:
     # capable of both phases (the crash-safety degrade path re-prefills
     # on a decode replica when a handoff source dies).
     role: str = "unified"
+    # preemptible capacity class (docs/robustness.md "The reclamation
+    # plane"): True marks this replica as running on reclaimable
+    # (spot) capacity — the flag rides the membership heartbeat, the
+    # router steers interactive-class tenants off it when on-demand
+    # candidates exist, and `begin_reclaim` is expected to arrive.
+    preemptible: bool = False
+    # reclamation drain: fraction of the notice budget reserved for the
+    # bulk KV evacuation AFTER in-flight work drains (the push must not
+    # start with zero wire budget left)
+    reclaim_evacuate_frac: float = 0.35
     # multi-tenant preemption (serving/tenancy.py, docs/serving.md
     # "Multi-tenancy"): when a strictly higher class waits and the batch
     # is full (slots or KV pages), pause the lowest-priority decode row —
@@ -200,6 +210,12 @@ class EngineConfig:
                 config.get_or_default("TPU_DRAIN_DEADLINE_S", "30")
             ),
             role=config.get_or_default("TPU_REPLICA_ROLE", "unified"),
+            preemptible=config.get_or_default(
+                "TPU_REPLICA_PREEMPTIBLE", "0"
+            ) not in ("0", "false", "off"),
+            reclaim_evacuate_frac=float(config.get_or_default(
+                "TPU_RECLAIM_EVACUATE_FRAC", "0.35"
+            )),
             tenant_preempt=config.get_or_default(
                 "TPU_TENANT_PREEMPT", "1"
             ) not in ("0", "false", "off"),
@@ -386,6 +402,12 @@ class ServingEngine:
             )
         # read by the membership announcer (heartbeat role) and /routerz
         self.role = self.config.role
+        # preemptible capacity class (docs/robustness.md "The reclamation
+        # plane"): rides the heartbeat; begin_reclaim() is the notice path
+        self.preemptible = self.config.preemptible
+        self._reclaiming = False
+        self._reclaim_deadline: float | None = None  # absolute monotonic
+        self._reclaim_swept = False  # batch shed done for this notice
         self.tokenizer: Tokenizer = tokenizer or ByteTokenizer(cfg.vocab_size)
         self._metrics = metrics
         self._logger = logger
@@ -825,6 +847,248 @@ class ServingEngine:
         self.stop(join_timeout=join_timeout)
         return drained
 
+    # ------------------------------------------------------- reclamation plane
+    def reclaim_remaining_s(self) -> float | None:
+        """Remaining seconds of an in-progress reclamation notice (None
+        when not reclaiming) — the membership announcer puts this on the
+        heartbeat so the router/autoscaler read the budget without asking
+        the doomed replica a second question."""
+        deadline = self._reclaim_deadline
+        if not self._reclaiming or deadline is None:
+            return None
+        return max(deadline - time.monotonic(), 0.0)
+
+    def begin_reclaim(self, deadline_s: float | None = None, *,
+                      join_timeout: float = 10.0) -> dict[str, Any]:
+        """The reclamation-notice ladder (docs/robustness.md "The
+        reclamation plane"): the provider takes this machine back in
+        ``deadline_s`` seconds whether or not we finish, so every second
+        of the budget is spent in strict value order —
+
+        1. stop admitting (health flips RECLAIMING — zero new routes —
+           and ``submit`` raises a retriable 503 the router's candidate
+           walk retries on a survivor);
+        2. shed batch-class rows NOW via the preemption ladder's warm
+           page-out (:meth:`_reclaim_sweep`, engine thread) — their
+           committed chunks join the evacuation, the requests settle
+           retriable;
+        3. drain: in-flight interactive/standard streams finish inside
+           the drain share of the budget, the remainder fails retriable
+           (exactly :meth:`drain`'s contract);
+        4. bulk-evacuate committed KV (prefix chains + paged-out spans)
+           to a survivor over the migration transport
+           (:meth:`_evacuate_kv`, two-phase: partial pushes are
+           discarded whole);
+        5. stop — the pool driver reaps a drained replica, never a
+           serving one.
+
+        Runs from any thread (the pool driver's notice thread); returns
+        a summary dict. ``reclaim_evacuate_frac`` reserves the tail of
+        the notice for step 4 so the push never starts with zero wire
+        budget."""
+        if deadline_s is None:
+            deadline_s = self.config.drain_deadline_s
+        notice_t0 = time.monotonic()
+        with self._lifecycle_mu:
+            if self._reclaiming or self._stop_requested or self._wedged:
+                return {"accepted": False, "reason": "lifecycle-owned"}
+            self._reclaim_deadline = notice_t0 + max(float(deadline_s), 0.0)
+            self._reclaiming = True
+            self._reclaim_swept = False
+        if self._metrics:
+            self._metrics.increment_counter("app_replica_reclamations_total")
+        if self._logger:
+            self._logger.warn(
+                f"reclamation notice: {deadline_s:g}s to drain + evacuate"
+            )
+        # stamp every in-flight timeline: /requestz shows which requests
+        # a notice touched, whatever their terminal state turns out to be
+        with self._count_lock:
+            inflight = list(self._by_id.values())
+        for req in inflight:
+            tl = req.timeline
+            if tl is not None:
+                tl.stamp("reclaim")
+        summary: dict[str, Any] = {
+            "accepted": True, "deadline_s": float(deadline_s),
+            "inflight": len(inflight),
+        }
+        if not self._running:
+            # never started / already stopped: nothing drains, but the
+            # committed cache may still hold chains worth saving
+            summary["drained"] = True
+            summary["evacuation"] = self._evacuate_kv(
+                self._reclaim_deadline - time.monotonic()
+            )
+            self.stop(join_timeout=join_timeout)
+            self._reclaiming = False
+            self._reclaim_deadline = None
+            return summary
+        # drain share of the notice: the evacuation reserve comes off the
+        # top so the push starts with real wire budget left
+        evac_frac = min(max(self.config.reclaim_evacuate_frac, 0.0), 0.9)
+        drain_budget = max(float(deadline_s) * (1.0 - evac_frac), 0.0)
+        self._draining = True
+        self._idle.clear()
+        self._wake.set()
+        drained = self._idle.wait(timeout=drain_budget)
+        if drained:
+            remaining = drain_budget - (time.monotonic() - notice_t0)
+            drained = self._detok_idle.wait(timeout=max(remaining, 0.0))
+        if not drained:
+            # same contract as drain() past its deadline: the remainder
+            # fails retriable — never killed mid-write, never stranded
+            with self._count_lock:
+                remainder = list(self._by_id.values())
+            for req in remainder:
+                self._settle_future(req, ErrorServiceUnavailable(
+                    "replica reclaiming; retry on another replica",
+                    retry_after=0.5,
+                ))
+                req.canceled = True
+                try:
+                    self._sched.cancel(req.id)
+                except KeyError:
+                    pass
+            if self._logger and remainder:
+                self._logger.warn(
+                    f"reclaim drain budget passed with {len(remainder)} "
+                    "request(s) in flight; failed them retriable"
+                )
+            self._wake.set()
+            # bounded slot-reclaim grace, same as drain(): the notice
+            # deadline still caps the whole ladder
+            # gofrlint: disable=deadline-dropped -- post-budget cleanup grace; the evacuation step below re-derives its budget from the absolute notice deadline
+            self._idle.wait(timeout=min(
+                2.0, max(self._reclaim_deadline - time.monotonic(), 0.0)
+            ))
+        summary["drained"] = drained
+        summary["evacuation"] = self._evacuate_kv(
+            self._reclaim_deadline - time.monotonic()
+        )
+        self.stop(join_timeout=join_timeout)
+        if self._metrics:
+            self._metrics.record_histogram(
+                "app_reclaim_drain_seconds", time.monotonic() - notice_t0
+            )
+        self._reclaiming = False
+        self._reclaim_deadline = None
+        return summary
+
+    def _reclaim_sweep(self) -> bool:
+        """Engine-thread arm of the notice ladder: shed batch-class work
+        immediately so the drain budget serves interactive streams.
+        Queued batch requests fail retriable without prefilling; active
+        batch rows take the preemption ladder's warm page-out
+        (``_preempt(reclaim=True)``) — their committed chunk spans land
+        in the prefix cache, whence the bulk evacuation carries them to
+        a survivor. Rows with device work in flight are skipped this
+        iteration and swept on the next (preempting under an in-flight
+        block would free pages the dispatched device work still
+        writes)."""
+        from gofr_tpu.serving.tenancy import DEADLINE_CLASSES
+
+        threshold = DEADLINE_CLASSES["batch"][0]
+        did = False
+        with self._count_lock:
+            queued = [
+                r for r in self._by_id.values()
+                if r.slot is None and not r.canceled
+                and r.priority >= threshold
+            ]
+        for req in queued:
+            self._settle_future(req, ErrorServiceUnavailable(
+                "replica reclaiming; retry on another replica",
+                retry_after=0.5,
+            ))
+            req.canceled = True
+            try:
+                self._sched.cancel(req.id)
+            except KeyError:
+                pass
+            did = True
+        for slot, req in enumerate(self.slots):
+            if req is None or req.priority < threshold or req.canceled:
+                continue
+            cursor = self._cursors.get(slot)
+            if self._slot_in_flight(slot, req) or (
+                cursor is not None and cursor.in_flight > 0
+            ):
+                continue  # pipeline drains first; next iteration sweeps
+            self._preempt(slot, reclaim=True)
+            did = True
+        return did
+
+    def _evacuate_kv(self, deadline: float | None) -> dict[str, Any]:
+        """Bulk-evacuate the committed prefix-cache contents (prefill
+        chains, chunk spans, paged-out rows — device AND host tiers) to
+        one surviving replica through the migrator's push side
+        (:meth:`KVMigrator.evacuate_chain`). Two-phase by construction:
+        the survivor commits the batch whole or not at all, so an
+        interrupted push degrades to re-prefill — never a corrupt chain
+        believed complete. Advisory end to end: every failure returns an
+        outcome, nothing raises past here."""
+        cache = self._prefix_cache
+        migrator = self._kv_migrator
+        out: dict[str, Any] = {"entries": 0, "committed": 0,
+                               "target": None, "outcome": "skipped"}
+        if (cache is None or migrator is None
+                or not hasattr(migrator, "evacuate_chain")):
+            if self._metrics:
+                self._metrics.increment_counter(
+                    "app_kv_evacuations_total", outcome="skipped"
+                )
+            return out
+        entries: list[tuple[Any, Any]] = []
+        try:
+            # PrefixCache and TieredPrefixCache both enumerate via
+            # keys() (the tiered one spans device + host); an injected
+            # container cache without it simply has nothing to evacuate
+            keys = list(cache.keys()) if hasattr(cache, "keys") else []
+            reader = cache.peek if hasattr(cache, "peek") else cache.get
+            for key in keys:
+                val = reader(key)
+                if val is None:
+                    continue
+                entries.append((key, val))
+        except Exception:
+            out["outcome"] = "harvest_error"
+            if self._metrics:
+                self._metrics.increment_counter(
+                    "app_kv_evacuations_total", outcome="harvest_error"
+                )
+            return out
+        out["entries"] = len(entries)
+        if not entries:
+            out["outcome"] = "empty"
+            if self._metrics:
+                self._metrics.increment_counter(
+                    "app_kv_evacuations_total", outcome="empty"
+                )
+            return out
+        try:
+            committed = migrator.evacuate_chain(entries, deadline=deadline)
+        except Exception:
+            committed = None
+        if committed:
+            target, n = committed
+            out.update(committed=int(n), target=target, outcome="committed")
+        else:
+            # no survivor accepted (all reclaiming/down, deadline spent,
+            # or a chaos fault tore the push): survivors re-prefill
+            out["outcome"] = "degraded"
+        if self._metrics:
+            self._metrics.increment_counter(
+                "app_kv_evacuations_total", outcome=out["outcome"]
+            )
+        if self._logger:
+            self._logger.info(
+                f"kv evacuation: {out['outcome']} "
+                f"({out['committed']}/{out['entries']} entries"
+                + (f" -> {out['target']}" if out["target"] else "") + ")"
+            )
+        return out
+
     def warm_restart(self, join_timeout: float = 5.0) -> bool:
         """Self-healing restart, driven by the supervisor's watchdog when
         the loop thread hung, crashed, or keeps poisoning its device state.
@@ -1083,6 +1347,14 @@ class ServingEngine:
             # polled (serving/device_telemetry.py) — the heartbeat
             # announcer reads its HBM headroom from the same sample
             details["device"] = self.device_telemetry.last_sample()
+        if self.preemptible:
+            details["preemptible"] = True
+        if self._reclaiming:
+            remaining = self.reclaim_remaining_s()
+            details["reclaim"] = {
+                "deadline_s": round(remaining, 3)
+                if remaining is not None else None,
+            }
         sup = self._supervisor
         if sup is not None:
             details["supervisor"] = sup.snapshot()
@@ -1098,6 +1370,11 @@ class ServingEngine:
             status = "RESTARTING"
         elif not self._running:
             status = "DOWN"
+        elif self._reclaiming:
+            # a reclamation notice outranks a plain drain: same zero-new-
+            # routes contract, plus a hard external deadline the router
+            # and autoscaler read off the beat
+            status = "RECLAIMING"
         elif self._draining:
             status = "DRAINING"
         elif sup_state == "SUSPECT":
@@ -1464,6 +1741,11 @@ class ServingEngine:
                 # slot is admitted in this same iteration, so a waiting
                 # higher class pays at most one loop latency
                 did_work = self._maybe_preempt()
+                if self._reclaiming:
+                    # a reclamation notice sheds batch-class rows NOW
+                    # (warm page-out, retriable failure) so the remaining
+                    # drain budget serves interactive streams only
+                    did_work |= self._reclaim_sweep()
                 plan = self._plan_step()
                 did_work |= self._admit(plan)
                 if any(s is not None for s in self.slots):
@@ -2317,7 +2599,7 @@ class ServingEngine:
         self._preempt(victim)
         return True
 
-    def _preempt(self, slot: int) -> None:
+    def _preempt(self, slot: int, *, reclaim: bool = False) -> None:
         """Pause one decode row: page its committed whole-chunk KV spans
         out into the prefix cache (whence device-LRU pressure demotes
         them to the PR 11 host-RAM spill tier), free the slot + pages,
@@ -2327,14 +2609,23 @@ class ServingEngine:
         recomputes, and the NEXT token samples — emitted tokens are
         preserved and never re-emitted. The ``tenant.preempt`` chaos
         point makes the policy advisory by construction: a fault there
-        skips this preemption, never corrupts the row."""
+        skips this preemption, never corrupts the row.
+
+        ``reclaim=True`` is the reclamation-notice variant
+        (:meth:`_reclaim_sweep`): same warm page-out — the spans then
+        ride the bulk evacuation to a survivor — but the row settles
+        RETRIABLE instead of requeueing (this replica is doomed; the
+        router's retry lands on a survivor whose re-prefill the
+        evacuated chunks make warm). Not advisory: the chaos point for
+        the notice path is ``replica.reclaim`` at delivery."""
         req = self.slots[slot]
         if req is None:
             return
-        try:
-            chaos.maybe_fail("tenant.preempt")
-        except Exception:
-            return  # advisory: a faulted preemption is a skipped one
+        if not reclaim:
+            try:
+                chaos.maybe_fail("tenant.preempt")
+            except Exception:
+                return  # advisory: a faulted preemption is a skipped one
         ids = req.serve_ids
         resident = int(self.cache_len[slot])
         # page out whole chunk-boundary spans below the resident length —
@@ -2365,8 +2656,9 @@ class ServingEngine:
         req.preemptions += 1
         tl = req.timeline
         if tl is not None:
-            tl.stamp(f"preempted:{req.preemptions}")
-        if self._metrics:
+            tl.stamp("reclaim-preempted" if reclaim
+                     else f"preempted:{req.preemptions}")
+        if self._metrics and not reclaim:
             self._metrics.increment_counter(
                 "app_tenant_preemptions_total",
                 tenant=req.tenant or "default",
@@ -2398,6 +2690,16 @@ class ServingEngine:
             sched.release(slot)
         except KeyError:
             pass
+        if reclaim:
+            # doomed replica: never requeue here — settle retriable so
+            # the router's candidate walk retries on a survivor (whose
+            # boundary walk finds the evacuated spans)
+            req.canceled = True
+            self._settle_future(req, ErrorServiceUnavailable(
+                "replica reclaiming; retry on another replica",
+                retry_after=0.5,
+            ))
+            return
         try:
             sched.submit(
                 req.id, len(req.serve_ids), req.max_new_tokens,

@@ -278,6 +278,15 @@ class TieredPrefixCache:
             return value
         return self._host.get(key)
 
+    def keys(self) -> list[Hashable]:
+        """All resident keys, device tier first (LRU order within each
+        tier) — the bulk-evacuation walk enumerates both tiers: a
+        paged-out span demoted to host RAM is exactly the KV a doomed
+        replica most needs to push out."""
+        device = self._device.keys()
+        seen = set(device)
+        return device + [k for k in self._host.keys() if k not in seen]
+
     def evict(self, key: Hashable) -> None:
         self._device.evict(key)
         self._host.pop(key)

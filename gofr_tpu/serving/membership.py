@@ -45,14 +45,20 @@ RESTARTING = "RESTARTING"
 DRAINING = "DRAINING"
 WEDGED = "WEDGED"
 DOWN = "DOWN"
+# RECLAIMING: a preemptible replica under a reclamation notice — it is
+# DRAINING with a hard external deadline (the provider takes the machine
+# back whether or not we finish), so it additionally evacuates committed
+# KV to survivors. Like DRAINING it receives zero new routes, ever.
+RECLAIMING = "RECLAIMING"
 
 # gauge encoding for app_router_replica_state
 STATE_VALUES = {
     UP: 0, SUSPECT: 1, RESTARTING: 2, DRAINING: 3, WEDGED: 4, DOWN: 5,
+    RECLAIMING: 6,
 }
 
 # states that may receive new routes (SUSPECT only as a last resort)
-_NEVER_ROUTE = (DRAINING, WEDGED, RESTARTING, DOWN)
+_NEVER_ROUTE = (DRAINING, WEDGED, RESTARTING, DOWN, RECLAIMING)
 
 # replica roles (disaggregated prefill/decode serving, ROADMAP item 2,
 # AIBrix arXiv:2504.03648): a UNIFIED replica serves whole generations;
@@ -87,6 +93,15 @@ class Heartbeat:
     kv_free_frac: float = 1.0   # paged-KV pool headroom (0..1)
     hbm_free_frac: float | None = None  # device HBM headroom, if known
     ts: float = 0.0             # publisher wall clock, informational only
+    # preemptible capacity class (ROADMAP item 5, AIBrix
+    # arXiv:2504.03648): True marks a replica the provider may reclaim on
+    # short notice. Rides the beat so router steering and the capacity
+    # planner see the fleet's actual cost mix, live.
+    preemptible: bool = False
+    # remaining seconds of an in-progress reclamation notice (None when
+    # not reclaiming) — the router/autoscaler read the budget off the
+    # beat instead of asking the doomed replica.
+    reclaim_deadline_s: float | None = None
     # distributed prefix index (serving/prefix_index.py): a BOUNDED
     # [key, tier] advertisement of this replica's cached prefixes —
     # piggybacked here so the index rides the same idempotent per-replica
@@ -119,6 +134,8 @@ class _ReplicaView:
         self.kv_free_frac = 1.0
         self.hbm_free_frac: float | None = None
         self.forced_down_reason: str | None = None  # breaker-open etc.
+        self.preemptible = False
+        self.reclaim_deadline_s: float | None = None
 
     def effective_state(self, now: float, suspect_after: float,
                         down_after: float) -> str:
@@ -147,6 +164,10 @@ class _ReplicaView:
             "slots_free": self.slots_free,
             "kv_free_frac": round(self.kv_free_frac, 4),
         }
+        if self.preemptible:
+            out["preemptible"] = True
+        if self.reclaim_deadline_s is not None:
+            out["reclaim_deadline_s"] = round(self.reclaim_deadline_s, 3)
         if self.hbm_free_frac is not None:
             out["hbm_free_frac"] = round(self.hbm_free_frac, 4)
         if self.last_seen is not None:
@@ -171,17 +192,19 @@ class MembershipTable:
         self._mu = threading.Lock()
         self._replicas: dict[str, _ReplicaView] = {}
 
-    def register(self, replica_id: str, role: str = ROLE_UNIFIED) -> None:
+    def register(self, replica_id: str, role: str = ROLE_UNIFIED, *,
+                 preemptible: bool = False) -> None:
         """Pre-register a replica (the router knows its handles up front);
-        it stays SUSPECT until its first heartbeat arrives. ``role`` is
-        the registration-time default — the replica's own heartbeats are
-        authoritative and overwrite it."""
+        it stays SUSPECT until its first heartbeat arrives. ``role`` and
+        ``preemptible`` are the registration-time defaults — the
+        replica's own heartbeats are authoritative and overwrite them."""
         with self._mu:
             view = self._replicas.setdefault(
                 replica_id, _ReplicaView(replica_id, role)
             )
             if view.last_seen is None:
                 view.role = role  # never heard from: registration decides
+                view.preemptible = preemptible
 
     def forget(self, replica_id: str) -> None:
         with self._mu:
@@ -210,6 +233,11 @@ class MembershipTable:
             view.slots_free = int(hb.slots_free)
             view.kv_free_frac = float(hb.kv_free_frac)
             view.hbm_free_frac = hb.hbm_free_frac
+            view.preemptible = bool(hb.preemptible)
+            view.reclaim_deadline_s = (
+                float(hb.reclaim_deadline_s)
+                if hb.reclaim_deadline_s is not None else None
+            )
             if hb.state == UP and view.forced_down_reason is not None:
                 # a FRESH healthy announcement outranks a stale breaker
                 # verdict: the replica proved liveness after the breaker
@@ -293,6 +321,14 @@ class MembershipTable:
         pool = up if up else suspect
         pool.sort(key=lambda v: (v.queue_wait_s, -v.slots_free, v.replica_id))
         return [v.replica_id for v in pool]
+
+    def is_preemptible(self, replica_id: str) -> bool:
+        """Whether the replica runs on reclaimable capacity (as last
+        registered or reported) — the router's interactive-class
+        steering keys on this."""
+        with self._mu:
+            view = self._replicas.get(replica_id)
+            return view.preemptible if view is not None else False
 
     def role_of(self, replica_id: str) -> str:
         with self._mu:
@@ -453,6 +489,17 @@ class ReplicaAnnouncer:
                     prefix_keys = None  # the index is advisory: never
                     # let it break the heartbeat the router's failure
                     # detection depends on
+        # reclamation plane: the capacity class and, mid-notice, the
+        # remaining evacuation budget ride the same beat the router's
+        # failure detection already trusts
+        preemptible = bool(getattr(self.engine, "preemptible", False))
+        reclaim_deadline = None
+        remaining = getattr(self.engine, "reclaim_remaining_s", None)
+        if remaining is not None:
+            try:
+                reclaim_deadline = remaining()
+            except Exception:
+                reclaim_deadline = None
         with self._seq_mu:
             self._seq += 1
             seq = self._seq
@@ -468,6 +515,8 @@ class ReplicaAnnouncer:
             hbm_free_frac=hbm,
             ts=time.time(),
             prefix_keys=prefix_keys,
+            preemptible=preemptible,
+            reclaim_deadline_s=reclaim_deadline,
         )
 
     def beat(self) -> bool:

@@ -53,6 +53,7 @@ __all__ = [
     "PrefixIndex",
     "KVMigrator",
     "local_engine_fetcher",
+    "local_engine_store",
     "encode_entry",
     "decode_entry",
 ]
@@ -208,6 +209,56 @@ def local_engine_fetcher(engine: Any) -> Callable[[list[str]], dict[str, tuple]]
     return fetch
 
 
+def local_engine_store(engine: Any) -> Callable[[list[tuple]], int]:
+    """Survivor-side commit for an in-process bulk evacuation
+    (:meth:`KVMigrator.evacuate_chain`): stages every pushed entry,
+    audits the batch, then commits it into the target engine's prefix
+    cache whole — or not at all (a mid-commit failure evicts what this
+    batch already wrote, so a torn push can never leave a partial set
+    masquerading as a complete one). REFUSES (raises) when the target is
+    itself reclaiming/draining/stopped: a notice storm must never
+    evacuate KV onto capacity that is about to need evacuating."""
+
+    def store(entries: list[tuple]) -> int:
+        cache = getattr(engine, "_prefix_cache", None)
+        if cache is None:
+            raise RuntimeError("evacuation target has no prefix cache")
+        if (getattr(engine, "_reclaiming", False)
+                or getattr(engine, "draining", False)
+                or not getattr(engine, "_running", True)):
+            raise RuntimeError(
+                "evacuation target is reclaiming/draining/stopped"
+            )
+        # phase one: audit the whole batch before any commit — one
+        # malformed entry rejects the push entire
+        staged: list[tuple[Any, tuple]] = []
+        for item in entries:
+            try:
+                key, value = item
+            except (TypeError, ValueError) as exc:
+                raise ValueError(f"malformed evacuation entry: {exc}")
+            if value is None or len(value) != 3:
+                raise ValueError("malformed evacuation entry value")
+            staged.append((key, value))
+        # phase two: commit; a failure mid-batch discards the whole
+        # batch (survivors degrade to re-prefill, never trust a torn set)
+        committed: list[Any] = []
+        try:
+            for key, value in staged:
+                cache.put(key, value)
+                committed.append(key)
+        except Exception:
+            for key in committed:
+                try:
+                    cache.evict(key)
+                except Exception:
+                    pass
+            raise
+        return len(committed)
+
+    return store
+
+
 class KVMigrator:
     """The admitting replica's pull side of warm KV migration.
 
@@ -240,9 +291,14 @@ class KVMigrator:
         self._peers: dict[str, Callable[[list[str]], dict[str, tuple]]] = {}
         self._peer_bounded: dict[str, bool] = {}
         self._suppressed_until: dict[str, float] = {}
+        # push side (reclamation evacuation): replica_id -> store(entries)
+        self._push_peers: dict[str, Callable[[list[tuple]], int]] = {}
+        self._push_bounded: dict[str, bool] = {}
         self.migrations_total = 0
         self.handoffs_total = 0
         self.failed_fetches_total = 0
+        self.evacuations_total = 0
+        self.failed_evacuations_total = 0
 
     def add_peer(self, replica_id: str,
                  fetch: Callable[[list[str]], dict[str, tuple]]) -> None:
@@ -264,6 +320,26 @@ class KVMigrator:
     def remove_peer(self, replica_id: str) -> None:
         self._peers.pop(replica_id, None)
         self._peer_bounded.pop(replica_id, None)
+
+    def add_push_peer(self, replica_id: str,
+                      store: Callable[[list[tuple]], int]) -> None:
+        """Register a survivor the bulk evacuation may push to:
+        ``store(entries) -> committed count`` with all-or-nothing commit
+        semantics (:func:`local_engine_store` in-process; a remote store
+        takes a ``timeout`` kwarg, detected like :meth:`add_peer`)."""
+        self._push_peers[replica_id] = store
+        try:
+            import inspect
+
+            self._push_bounded[replica_id] = (
+                "timeout" in inspect.signature(store).parameters
+            )
+        except (TypeError, ValueError):
+            self._push_bounded[replica_id] = False
+
+    def remove_push_peer(self, replica_id: str) -> None:
+        self._push_peers.pop(replica_id, None)
+        self._push_bounded.pop(replica_id, None)
 
     def _peer_kwargs(self, replica_id: str,
                      deadline: float | None) -> dict[str, float]:
@@ -416,3 +492,69 @@ class KVMigrator:
         (re-prefill). Same 2PC/backoff contract as :meth:`fetch_handoff`."""
         got = self.fetch_handoff([(0, 1, key)], source, deadline=deadline)
         return got[0][2] if got else None
+
+    # -- reclamation-notice bulk evacuation (push side) -------------------------
+    def evacuate_chain(
+        self, entries: list[tuple], deadline: float | None = None,
+    ) -> tuple[str, int] | None:
+        """Push this replica's committed KV entries to ONE surviving
+        peer under a reclamation notice (docs/robustness.md "The
+        reclamation plane"). ``entries`` is ``[(key, (logits, k, v)),
+        ...]`` straight off the local prefix cache; ``deadline`` is the
+        notice's REMAINING budget in seconds and threads into every wire
+        call — a spent budget degrades to re-prefill on the survivors
+        without touching the wire, and a bounded (remote) store's
+        transport timeout is clamped to it.
+
+        Two-phase like the handoff fetch: the store commits the batch
+        whole or raises (:func:`local_engine_store`), so a source dying
+        mid-push — the ``kv.evacuate`` chaos point — leaves the survivor
+        clean, never holding a partial set it believes complete. A
+        refusing/failed survivor is suppressed for ``failure_backoff_s``
+        and the next one is tried; returns ``(replica_id, committed)``
+        on success, None when no survivor accepted (degrade: survivors
+        re-prefill)."""
+        if not entries or not self._push_peers:
+            return None
+        t0 = time.monotonic()
+        for rid in sorted(self._push_peers):
+            remaining = (
+                None if deadline is None
+                else deadline - (time.monotonic() - t0)
+            )
+            if remaining is not None and remaining <= 0:
+                return None  # budget spent: never start a push that
+                # cannot finish — a torn commit helps nobody
+            until = self._suppressed_until.get(rid)
+            if until is not None and time.monotonic() < until:
+                continue
+            store = self._push_peers[rid]
+            kwargs: dict[str, float] = {}
+            if self._push_bounded.get(rid):
+                kwargs["timeout"] = (
+                    self.fetch_timeout_s if remaining is None
+                    else min(self.fetch_timeout_s, remaining)
+                )
+            try:
+                chaos.maybe_fail("kv.evacuate")
+                n = store(entries, **kwargs)
+            except Exception as exc:
+                # the push tore (source dying, survivor refusing, chaos
+                # fault): the store's all-or-nothing contract means the
+                # survivor holds nothing from this batch — try the next
+                self.failed_evacuations_total += 1
+                self._suppressed_until[rid] = (
+                    time.monotonic() + self.failure_backoff_s
+                )
+                if self._logger is not None:
+                    self._logger.warn(
+                        f"KV evacuation push to {rid} failed; "
+                        f"trying next survivor: {exc}"
+                    )
+                continue
+            self._suppressed_until.pop(rid, None)
+            self.evacuations_total += 1
+            if self._metrics is not None:
+                self._metrics.increment_counter("app_kv_migrations_total")
+            return (rid, int(n))
+        return None

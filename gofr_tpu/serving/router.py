@@ -203,6 +203,9 @@ class LocalReplica:
         # disaggregation role seed for membership registration; the
         # engine's announcer remains authoritative via heartbeats
         self.role = role or getattr(engine, "role", None) or ms.ROLE_UNIFIED
+        # preemptible capability seed, mirrored from the engine config —
+        # heartbeats are authoritative after registration
+        self.preemptible = bool(getattr(engine, "preemptible", False))
 
     def submit(self, prompt: str | list[int], **kw: Any) -> Any:
         return self.engine.submit(prompt, **kw)
@@ -550,6 +553,12 @@ class Router:
         self._metrics = metrics
         self._logger = logger
         self._tracer = tracer
+        # shared TenantRegistry (serving/tenancy.py), wired by the
+        # container/stack via use_tenants: lets the candidate walk
+        # resolve a request's SLO class, so interactive traffic is
+        # steered off preemptible capacity (docs/robustness.md "The
+        # reclamation plane"). None = no steering (class unknown).
+        self._tenants: Any = None
         self.membership = ms.MembershipTable(
             suspect_after_s=self.config.suspect_after_s or 3.0,
             down_after_s=self.config.down_after_s or 10.0,
@@ -608,6 +617,9 @@ class Router:
     def use_tracer(self, tracer: Any) -> None:
         self._tracer = tracer
 
+    def use_tenants(self, registry: Any) -> None:
+        self._tenants = registry
+
     def connect(self) -> None:
         pass
 
@@ -623,6 +635,7 @@ class Router:
         self.membership.register(
             handle.replica_id,
             role or getattr(handle, "role", None) or ms.ROLE_UNIFIED,
+            preemptible=bool(getattr(handle, "preemptible", False)),
         )
 
     def remove_replica(self, replica_id: str) -> None:
@@ -737,9 +750,23 @@ class Router:
         )
 
     # -- routing ---------------------------------------------------------------
+    def _is_interactive(self, tenant: str | None) -> bool:
+        """True when ``tenant`` resolves to the interactive deadline
+        class in the wired TenantRegistry. No registry (or a lookup
+        failure) means the class is unknown — no steering, never a
+        routing error."""
+        if self._tenants is None:
+            return False
+        try:
+            policy = self._tenants.policy(tenant)
+        except Exception:
+            return False
+        return getattr(policy, "deadline_class", None) == "interactive"
+
     def _candidates_for(self, prompt: Any,
                         role: str | None = None,
-                        adapter_id: str | None = None) -> tuple[list[str], bool]:
+                        adapter_id: str | None = None,
+                        tenant: str | None = None) -> tuple[list[str], bool]:
         """Ordered candidate replicas for a new request: the prefix-
         affine replica first (when healthy and under the spill bound),
         then every other routable replica by least estimated wait.
@@ -776,6 +803,23 @@ class Router:
                 spilled = True
             else:
                 routable = [affine] + [r for r in routable if r != affine]
+        if tenant is not None and self._is_interactive(tenant):
+            # reclamation-aware steering: interactive-class traffic
+            # prefers on-demand capacity — a preemptible replica can be
+            # noticed away mid-stream, and an interactive SLO has no
+            # budget for the resulting retry. Stable partition: the
+            # affinity/spill order is preserved within each half, and a
+            # pure-preemptible pool routes normally (steering picks
+            # among candidates, it never shrinks the set).
+            on_demand = [
+                r for r in routable
+                if not self.membership.is_preemptible(r)
+            ]
+            if on_demand and len(on_demand) < len(routable):
+                back = set(on_demand)
+                routable = on_demand + [
+                    r for r in routable if r not in back
+                ]
         return routable, spilled
 
     def submit(
@@ -815,7 +859,7 @@ class Router:
         if ms.ROLE_PREFILL in present and ms.ROLE_DECODE in present:
             return self._submit_disagg(req)
         candidates, spilled = self._candidates_for(
-            prompt, adapter_id=kw.get("adapter_id")
+            prompt, adapter_id=kw.get("adapter_id"), tenant=kw.get("tenant")
         )
         if not candidates:
             with self._stats_mu:
@@ -884,6 +928,7 @@ class Router:
             candidates, _ = self._candidates_for(
                 req.prompt, role=ms.ROLE_PREFILL,
                 adapter_id=req.kw.get("adapter_id"),
+                tenant=req.kw.get("tenant"),
             )
             prefill_fut = None
             for replica_id in candidates:
@@ -1050,7 +1095,8 @@ class Router:
             if req.future.done():
                 return
             candidates, _ = self._candidates_for(
-                req.prompt, role=req.phase_role
+                req.prompt, role=req.phase_role,
+                tenant=req.kw.get("tenant"),
             )
             with req.mu:
                 tried = set(req.tried)
@@ -1260,7 +1306,10 @@ class Router:
             # phase_role restricts the re-route to the decode pool on a
             # disaggregated tier: a failover must never land generation
             # work on a prefill-only replica
-            candidates, _ = self._candidates_for(req.prompt, role=req.phase_role)
+            candidates, _ = self._candidates_for(
+                req.prompt, role=req.phase_role,
+                tenant=req.kw.get("tenant"),
+            )
             with req.mu:
                 tried = set(req.tried)
             ordered = [c for c in candidates if c not in tried] or candidates
@@ -1353,7 +1402,9 @@ class Router:
             ):
                 return
             tried = set(req.tried)
-        candidates, _ = self._candidates_for(req.prompt, role=req.phase_role)
+        candidates, _ = self._candidates_for(
+            req.prompt, role=req.phase_role, tenant=req.kw.get("tenant")
+        )
         for replica_id in candidates:
             if replica_id in tried:
                 continue

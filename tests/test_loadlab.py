@@ -33,6 +33,8 @@ from gofr_tpu.loadlab import (
     acceptance_stack_config,
     check_invariants,
     generate_trace,
+    reclamation_scenario,
+    reclamation_stack_config,
     score,
 )
 from gofr_tpu.loadlab.arrival import (
@@ -416,3 +418,112 @@ def test_clean_run_control_full_goodput():
     pre = score([o for o in result.outcomes if o.at_s < fault_window[0]])
     assert pre.total["goodput"] is not None
     assert pre.total["goodput"] >= 0.9
+
+
+# -- acceptance: the reclamation plane (A/B vs abrupt-kill control) ----------
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_reclamation_storm_degrades_batch_only(seed, tmp_path):
+    """A notice storm reclaims every preemptible replica mid-burst: the
+    plane must deliver the notices, evacuate committed KV to survivors,
+    lose nothing, and hold interactive goodput — batch absorbs the
+    damage (the class the preemptible capacity was bought for)."""
+    import jax
+
+    from gofr_tpu.loadlab import ServingStack, run_trace
+    from gofr_tpu.models import llama
+
+    spec, plan, _window = reclamation_scenario(
+        seed, horizon_s=5.0, base_rps=3.0
+    )
+    trace = generate_trace(spec)
+    cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    stack_cfg = reclamation_stack_config(trace, export_dir=str(tmp_path))
+    with ServingStack(cfg, params, stack_cfg) as stack:
+        reclaimed_before = sorted(stack.pool.preemptible_ids())
+        result = run_trace(stack, trace, plan=plan)
+        timelines = stack.timelines()
+
+    # the storm fired and noticed BOTH preemptible decode replicas
+    assert [a["kind"] for a in result.actions] == ["notice_storm"]
+    assert sorted(result.actions[0]["target"].split(",")) == reclaimed_before
+    assert result.stack["notices_total"] == len(reclaimed_before) == 2
+    assert result.stack["notices_dropped_total"] == 0
+
+    # nothing lost, every trace event settled exactly once
+    assert len(result.outcomes) == len(trace)
+    assert result.lost == []
+
+    report = score(result.outcomes)
+    violations = check_invariants(
+        result.outcomes, timelines, report=report, fault_window=None
+    )
+    assert violations == [], violations
+
+    # the claim under grade: interactive holds its floor on the
+    # surviving on-demand capacity
+    assert report.per_class["interactive"]["goodput"] >= 0.9, report.per_class
+
+    # exported timelines: exactly one terminal mark per request
+    paths = [os.path.join(str(tmp_path), f) for f in os.listdir(str(tmp_path))
+             if f.endswith(".timelines.jsonl")]
+    assert paths
+    exported = []
+    for p in paths:
+        with open(p, encoding="utf-8") as fh:
+            exported.extend(json.loads(line) for line in fh if line.strip())
+    assert exported
+    assert all(row["terminal"] and row["terminal_marks"] == 1
+               for row in exported)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_reclamation_plane_beats_abrupt_kill_control():
+    """A/B on the same trace and the same victims: the orderly notice
+    path (drain + KV evacuation) must not serve less than the abrupt-kill
+    control, and only the plane performs evacuations — the control's
+    router has to DISCOVER the deaths through missed beats."""
+    import jax
+
+    from gofr_tpu.loadlab import ServingStack, run_trace
+    from gofr_tpu.loadlab.scenario import ChaosEvent, ChaosPlan
+    from gofr_tpu.models import llama
+
+    spec, plan, _window = reclamation_scenario(
+        101, horizon_s=5.0, base_rps=3.0
+    )
+    trace = generate_trace(spec)
+    cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    def run(with_plan):
+        with ServingStack(cfg, params, reclamation_stack_config(trace)) as st:
+            victims = sorted(st.pool.preemptible_ids())
+            res = run_trace(st, trace, plan=with_plan)
+        return victims, res
+
+    victims_a, plane = run(plan)
+
+    storm_at = plan.events[0].at_s
+    kill_plan = ChaosPlan(events=tuple(
+        ChaosEvent("replica_kill", at_s=storm_at, target=rid)
+        for rid in victims_a
+    ), seed=101)
+    victims_b, control = run(kill_plan)
+    assert victims_b == victims_a  # deterministic replica ids: same A/B targets
+
+    assert plane.lost == []
+    plane_report = score(plane.outcomes)
+    control_report = score(control.outcomes)
+
+    # only the plane evacuates; the control loses the committed KV
+    assert plane.stack["kv_evacuations_total"] >= 1
+    assert control.stack["kv_evacuations_total"] == 0
+    # orderly reclamation never serves less than abrupt death
+    assert (plane_report.total["goodput"]
+            >= control_report.total["goodput"]), (
+        plane_report.total, control_report.total)
