@@ -56,9 +56,15 @@ ci: lint bench-check
 # fires a mid-run replica kill + tenant storm + heartbeat partition,
 # asserting zero lost requests, exactly-one terminal per request, and
 # interactive-class goodput strictly above batch inside the fault
-# window (seeds in tests/test_loadlab.py::CHAOS_SEEDS).
+# window (seeds in tests/test_loadlab.py::CHAOS_SEEDS), and the HA
+# plane (docs/robustness.md "The HA plane"): router death mid-stream
+# with a keyed Last-Event-ID re-attach on the survivor router
+# (token-identical suffix), duplicate keyed submits across a two-router
+# split brain (exactly one admission tier-wide), and stale-epoch
+# fencing at the engine wire, under router.claim / stream.resume fault
+# schedules (seeds in tests/test_ha.py::CHAOS_SEEDS).
 chaos:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py tests/test_supervisor.py tests/test_pubsub_chaos.py tests/test_router_chaos.py tests/test_disagg.py tests/test_loadlab.py tests/test_reclaim.py -q -m chaos
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py tests/test_supervisor.py tests/test_pubsub_chaos.py tests/test_router_chaos.py tests/test_disagg.py tests/test_loadlab.py tests/test_reclaim.py tests/test_ha.py -q -m chaos
 
 # goodput ratchet gate (docs/robustness.md, docs/performance.md#bench-ratchet):
 # one deterministic chaos-under-load trace (seed 101) through the full

@@ -711,6 +711,55 @@ def _loadlab_reclamation(cfg: Any, params: Any, on_tpu: bool) -> dict:
     }
 
 
+def _loadlab_router_crash(cfg: Any, params: Any, on_tpu: bool) -> dict:
+    """Goodput through a control-plane death (docs/robustness.md "The HA
+    plane"): the canned router-crash scenario — an HA router pair over
+    one heartbeat log, the ACTIVE router killed abruptly mid-burst, the
+    standby promoted by pointer swap — replayed open-loop against the
+    FULL stack. The ratchet metric is TOTAL tier goodput through the
+    crash (direction:"max"): the claim under grade is that a router
+    process dying costs at most its in-flight failover capability, never
+    the data plane — replicas keep serving and the survivor routes the
+    rest of the trace. Raises on any invariant violation or when the
+    crash never fired."""
+    from gofr_tpu.loadlab import (
+        ServingStack,
+        check_invariants,
+        generate_trace,
+        router_crash_scenario,
+        router_crash_stack_config,
+        run_trace,
+        score,
+    )
+
+    spec, plan, fault_window = router_crash_scenario(101, horizon_s=5.0,
+                                                     base_rps=3.0)
+    trace = generate_trace(spec)
+    stack_cfg = router_crash_stack_config(trace)
+    with ServingStack(cfg, params, stack_cfg) as stack:
+        result = run_trace(stack, trace, plan=plan)
+        timelines = stack.timelines()
+    report = score(result.outcomes, windows={"fault": fault_window})
+    violations = check_invariants(
+        result.outcomes, timelines, report=report, fault_window=None
+    )
+    if violations:
+        raise RuntimeError(f"router-crash invariant violated: {violations}")
+    if result.stack.get("router_crashes", 0) < 1:
+        raise RuntimeError("router crash never fired")
+    return {
+        "goodput_under_router_crash": report.total["goodput"],
+        "goodput_interactive": report.per_class["interactive"]["goodput"],
+        "goodput_batch": report.per_class["batch"]["goodput"],
+        "goodput_fault_window_total": report.goodput(window="fault"),
+        "n_requests": report.total["n"],
+        "router_crashes": result.stack["router_crashes"],
+        "routed_total": result.stack["routed_total"],
+        "trace_fingerprint": result.trace_fingerprint,
+        "report_fingerprint": report.fingerprint(),
+    }
+
+
 def _router_warm_prefix(cfg: Any, params: Any, on_tpu: bool) -> dict:
     """Warm-prefix TTFT at multi-replica scale (ROADMAP item 3, AIBrix
     multi-tier KV pooling arXiv:2504.03648): two in-process replicas
@@ -1646,6 +1695,22 @@ def _run_benchmarks(platform: str, init_error: str | None, wall_start: float) ->
     if "error" not in reclaim_line:
         _append_local_record(reclaim_line)
 
+    # --- goodput through a control-plane death (PR 20 HA plane) ------------
+    def run_router_crash() -> dict:
+        if params is None:
+            raise RuntimeError("skipped: headline phase failed to build params")
+        return _loadlab_router_crash(cfg, params, on_tpu)
+
+    crash_line = _phase_line(
+        f"loadlab_goodput_under_router_crash_{model_kind}_{platform}",
+        "fraction", run_router_crash,
+        value_key="goodput_under_router_crash",
+        on_tpu=on_tpu and not init_error, init_error=init_error,
+    )
+    print(json.dumps(crash_line), flush=True)
+    if "error" not in crash_line:
+        _append_local_record(crash_line)
+
     # --- framework-only phases (no TPU dependence at all) ------------------
     echo_line = _phase_line(
         "grpc_unary_echo_req_per_s", "req/s", _grpc_unary_echo,
@@ -1849,9 +1914,10 @@ def _cli(argv: list[str]) -> int | None:
 
 
 def _run_loadlab_only() -> int:
-    """The `make loadcheck` entry: one seeded chaos-under-load run on the
-    current backend, three contract lines, evidence appended to
-    BENCH_LOCAL.jsonl for ``--check`` to gate. Exit 1 when the phase
+    """The `make loadcheck` entry: seeded chaos-under-load runs on the
+    current backend (baseline, reclamation, router-crash phases), one
+    contract line per ratcheted metric, evidence appended to
+    BENCH_LOCAL.jsonl for ``--check`` to gate. Exit 1 when a phase
     errors (including an invariant violation) so CI fails loudly."""
     try:
         platform, init_error = _acquire_backend()
@@ -1912,6 +1978,19 @@ def _run_loadlab_only() -> int:
         failed = True
     else:
         _append_local_record(reclaim_line)
+
+    crash_line = _phase_line(
+        f"loadlab_goodput_under_router_crash_{model_kind}_{platform}",
+        "fraction",
+        lambda: _loadlab_router_crash(cfg, params, on_tpu),
+        value_key="goodput_under_router_crash",
+        on_tpu=on_tpu and not init_error, init_error=init_error,
+    )
+    print(json.dumps(crash_line), flush=True)
+    if "error" in crash_line:
+        failed = True
+    else:
+        _append_local_record(crash_line)
     return 1 if failed else 0
 
 

@@ -892,14 +892,16 @@ def deadlinecheck_rules() -> list[Rule]:
 # (analysis/deadlinetrace.py): Class → methods, plus module-level
 # functions. Every runtime-observed crossing site must appear here.
 BOUNDARY_CLASSES: dict[str, set[str]] = {
-    "Router": {"submit"},
-    "LocalReplica": {"submit"},
-    "HTTPReplica": {"submit", "fetch_kv"},
+    # HA plane: the keyed re-attach walk carries the caller's deadline
+    # through the same replica tiers submit does
+    "Router": {"submit", "resume"},
+    "LocalReplica": {"submit", "resume"},
+    "HTTPReplica": {"submit", "fetch_kv", "resume"},
     "ServingEngine": {"submit"},
     "KVMigrator": {"fetch_chain", "fetch_handoff", "evacuate_chain"},
     "AdapterRegistry": {"acquire"},
 }
-BOUNDARY_FUNCS: set[str] = {"run_stream"}
+BOUNDARY_FUNCS: set[str] = {"run_stream", "open_resume"}
 
 
 def build_boundary_table(paths: list[str]) -> dict:

@@ -12,7 +12,9 @@ remaining budget is handed from one component to the next —
 - ``HTTPReplica.fetch_kv`` and ``KVMigrator.fetch_chain`` /
   ``fetch_handoff`` (cross-replica KV migration bounds);
 - ``AdapterRegistry.acquire`` (the LoRA upload wait);
-- ``remote.run_stream`` (the SSE stream open + per-frame budget),
+- ``remote.run_stream`` (the SSE stream open + per-frame budget);
+- ``Router.resume`` / ``LocalReplica.resume`` / ``HTTPReplica.resume``
+  / ``remote.open_resume`` (the HA plane's keyed re-attach walk),
 
 and asserts two invariants on every crossing, per thread:
 
@@ -225,6 +227,16 @@ def install() -> DeadlineTraceMonitor:
         _wrap_boundary(AdapterRegistry, "acquire", "AdapterRegistry.acquire",
                        _kw("timeout", pos=2))
         _wrap_boundary(remote, "run_stream", "remote.run_stream",
+                       _kw("timeout"))
+        # HA plane: keyed re-attach rides the same budget discipline —
+        # Router.resume's deadline flows to the replica handle, which
+        # hands open_resume the remaining window as its head timeout
+        _wrap_boundary(Router, "resume", "Router.resume", _kw("deadline"))
+        _wrap_boundary(LocalReplica, "resume", "LocalReplica.resume",
+                       _kw("deadline"))
+        _wrap_boundary(HTTPReplica, "resume", "HTTPReplica.resume",
+                       _kw("deadline"))
+        _wrap_boundary(remote, "open_resume", "remote.open_resume",
                        _kw("timeout"))
     except Exception:
         uninstall()

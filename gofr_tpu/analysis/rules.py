@@ -101,6 +101,10 @@ DISPATCH_ZONES: dict[str, set[str] | str] = {
     # unbounded (the lora-upload WORKER — _upload — is off-thread by
     # design, like the kv-spill worker, and stays out of the zone)
     "gofr_tpu/serving/tenancy.py": "*",
+    # HA plane: the idempotency registry + replay ring sit directly on
+    # the submit/admission path (engine thread + handler threads) — pure
+    # lock-guarded data structures, and they must stay that way
+    "gofr_tpu/serving/dedup.py": "*",
     "gofr_tpu/serving/lora.py": {
         "acquire", "release", "tables", "slot_factors", "prefetch",
         "register", "deregister", "known", "residency",
@@ -127,6 +131,10 @@ ROUTER_RETRY_ZONES: dict[str, set[str] | str] = {
         # deliberately-broad settle-on-anything catches carry reasoned
         # suppressions (a narrow catch would strand the future)
         "_run_unary", "_run_stream",
+        # HA plane: the keyed re-attach walk classifies per-replica
+        # outcomes exactly like submit's candidate walk, and the resume
+        # transport worker settles the future like _run_stream
+        "resume", "_run_resume",
     },
 }
 ROUTER_RETRIABLE_NAMES = {
@@ -134,6 +142,8 @@ ROUTER_RETRIABLE_NAMES = {
     "ErrorServiceUnavailable", "ErrorTooManyRequests",
     "CircuitBreakerError", "ChaosFault", "ConnectionError",
     "ErrorDeadlineExceeded",   # terminal: settles the request, never retried
+    "ErrorStaleEpoch",         # fence rejection: router re-stamps and fails over
+    "ErrorEntityNotFound",     # resume walk: replica doesn't hold the key — try the next
 }
 
 # decode hot path: ONE annotated sync point per N-step block (engine.py

@@ -55,6 +55,8 @@ POINTS = (
     "lora.upload",      # async adapter upload (faulted = requeue, transient)
     "replica.reclaim",  # reclamation-notice delivery (faulted = notice lost)
     "kv.evacuate",      # reclaim-side bulk KV push (source dies mid-push)
+    "router.claim",     # idempotency fast-path lookup (faulted = cold walk)
+    "stream.resume",    # keyed re-attach admission (faulted = retriable)
 )
 
 

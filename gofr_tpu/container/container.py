@@ -287,6 +287,11 @@ class Container:
             "Prefill admissions hedged on a second replica after the "
             "p99-based delay",
         )
+        m.new_counter(
+            "app_router_last_resort_routes_total",
+            "Routes dispatched into a SUSPECT-only candidate pool (no UP "
+            "replica anywhere: best-effort routing, the tier is coasting)",
+        )
         m.new_gauge(
             "app_router_queue_wait_seconds",
             "Mean reported queue-wait EWMA across live replicas (the "

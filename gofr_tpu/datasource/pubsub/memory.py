@@ -93,6 +93,26 @@ class InMemoryBroker:
                 committer=_commit, nacker=_nack, message_id=str(offset),
             )
 
+    def group_view(self, consumer_group: str) -> "InMemoryBroker":
+        """A second consumer identity over the SAME log (docs/robustness.md
+        "The HA plane"): shares topics, offsets and the data-available
+        condition, differs only in group. Two routers in an HA pair each
+        take their own view so BOTH observe every heartbeat — group
+        offsets are keyed (group, topic), so the views never steal each
+        other's messages."""
+        view = InMemoryBroker.__new__(InMemoryBroker)
+        view.consumer_group = consumer_group
+        view.poll_timeout = self.poll_timeout
+        view._topics = self._topics
+        view._offsets = self._offsets
+        view._pending = self._pending
+        view._lock = self._lock
+        view._data_available = self._data_available
+        view._logger = self._logger
+        view._metrics = self._metrics
+        view._closed = False
+        return view
+
     # -- topic admin (kafka.go topic create/delete) ----------------------------
     def create_topic(self, name: str) -> None:
         with self._lock:

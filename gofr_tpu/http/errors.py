@@ -184,6 +184,24 @@ class ErrorRequestEntityTooLarge(HTTPError):
         return "request exceeds this replica's serving capacity"
 
 
+class ErrorStaleEpoch(HTTPError):
+    """HA-plane addition (docs/robustness.md "The HA plane"): the caller
+    presented a fence epoch older than the replica's current one — it is
+    acting on membership state from before a ``warm_restart`` /
+    ``begin_reclaim`` / re-registration, so its view of this replica's
+    scheduler, KV residency and request registry is stale. 409 and NOT
+    retriable: a fenced zombie must refresh its membership view (the
+    heartbeat gossips the current epoch), never blind-retry the same
+    stale claim."""
+
+    status_code = 409
+    level = Level.WARN
+
+    @classmethod
+    def default_message(cls) -> str:
+        return "stale fence epoch; refresh membership and retry"
+
+
 class ErrorDeadlineExceeded(HTTPError):
     """Request-lifecycle addition: the caller's deadline passed before the
     request produced a result (expired in queue, or shed at admission after

@@ -49,6 +49,8 @@ from gofr_tpu.loadlab.scenario import (
     acceptance_stack_config,
     reclamation_scenario,
     reclamation_stack_config,
+    router_crash_scenario,
+    router_crash_stack_config,
 )
 from gofr_tpu.loadlab.scorer import (
     ScoreReport,
@@ -94,6 +96,8 @@ __all__ = [
     "records_from_jsonl",
     "reclamation_scenario",
     "reclamation_stack_config",
+    "router_crash_scenario",
+    "router_crash_stack_config",
     "run_trace",
     "score",
 ]

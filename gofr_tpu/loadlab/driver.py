@@ -143,6 +143,12 @@ def run_trace(stack: ServingStack, trace: Trace, *,
                         deadline_s=getattr(action, "deadline_s", None)
                     )
                     victim = ",".join(noticed) or "no-preemptible-replica"
+                elif action.kind == "router_crash":
+                    # control-plane death: the ACTIVE router dies, the
+                    # standby is promoted by pointer swap — the submit
+                    # loop reads stack.router per arrival, so the next
+                    # event already rides the survivor
+                    victim = stack.crash_router()
                 else:
                     victim = stack.kill(action.target)
             except Exception as exc:  # noqa: BLE001 - log, keep replaying

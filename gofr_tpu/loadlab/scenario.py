@@ -7,7 +7,7 @@ declarative answer: a list of :class:`ChaosEvent` at wall-clock offsets
 relative to the run's t=0, split by kind into
 
 - **stack actions** (``replica_kill``, ``replica_notice``,
-  ``notice_storm``) — executed by the load driver against the
+  ``notice_storm``, ``router_crash``) — executed by the load driver against the
   :class:`~gofr_tpu.loadlab.stack.ServingStack`. A kill is abrupt
   (announcer silenced, engine hard-stopped; the router must DISCOVER the
   death through missed beats + retriable errors); a notice is the
@@ -31,7 +31,7 @@ from typing import Any
 
 from gofr_tpu import chaos
 
-KINDS = ("replica_kill", "replica_notice", "notice_storm",
+KINDS = ("replica_kill", "replica_notice", "notice_storm", "router_crash",
          "heartbeat_partition", "point_fault")
 
 
@@ -74,7 +74,8 @@ class ChaosPlan:
         """Events the driver executes against the stack, in time order."""
         return sorted(
             (e for e in self.events
-             if e.kind in ("replica_kill", "replica_notice", "notice_storm")),
+             if e.kind in ("replica_kill", "replica_notice", "notice_storm",
+                           "router_crash")),
             key=lambda e: e.at_s,
         )
 
@@ -210,6 +211,72 @@ def reclamation_scenario(seed: int, *, horizon_s: float = 8.0,
     )
     fault_window = (burst.at_s, round(burst.at_s + burst.duration_s, 3))
     return spec, plan, fault_window
+
+
+def router_crash_scenario(seed: int, *, horizon_s: float = 8.0,
+                          base_rps: float = 4.0):
+    """The canned control-plane-death scenario the HA acceptance test
+    and the bench router-crash phase share (docs/robustness.md "The HA
+    plane"): the ACTIVE router dies abruptly at 40% of the horizon while
+    a batch-tenant burst straddles the crash. The standby router —
+    consuming the same heartbeat stream under its own consumer group the
+    whole run — is promoted by pointer swap; arrivals after the crash
+    flow through it with zero re-registration. The claim under grade:
+    control-plane death costs at most the in-flight failover capability
+    of the dead router, never the data plane — replicas keep serving,
+    and tier goodput holds a committed floor through the crash. Returns
+    ``(TraceSpec, ChaosPlan, fault_window)``."""
+    from gofr_tpu.loadlab.trace import BurstSpec, TenantMix, TraceSpec
+
+    crash_at = round(horizon_s * 0.40, 3)
+    burst = BurstSpec(
+        at_s=round(horizon_s * 0.30, 3),
+        duration_s=round(horizon_s * 0.35, 3),
+        multiplier=6.0, tenant="bulk",
+    )
+    spec = TraceSpec(
+        seed=seed,
+        horizon_s=horizon_s,
+        base_rps=base_rps,
+        peak_rps=base_rps * 2.0,
+        bursts=(burst,),
+        output_median=8,
+        output_max=16,
+        tenants=(
+            TenantMix("gold", "interactive", weight=3.0),
+            TenantMix("silver", "standard", weight=2.0),
+            TenantMix("bulk", "batch", weight=1.0),
+        ),
+    )
+    plan = ChaosPlan(
+        events=(
+            ChaosEvent("router_crash", at_s=crash_at),
+        ),
+        seed=seed,
+    )
+    fault_window = (burst.at_s, round(burst.at_s + burst.duration_s, 3))
+    return spec, plan, fault_window
+
+
+def router_crash_stack_config(trace: Any, **overrides: Any):
+    """The tuned :class:`StackConfig` for the router-crash scenario —
+    shared by the bench phase and the HA test: the acceptance tier with
+    a STANDBY router armed. The autoscaler is off: it rides the control
+    plane under test (its pool driver is bound to the router that dies),
+    and a scale-up wedged against a dead membership view is a separate
+    failure mode this scenario does not grade."""
+    from gofr_tpu.loadlab.stack import StackConfig
+
+    kw: dict[str, Any] = dict(
+        tenants=trace.tenants(),
+        max_slots=4,
+        shed_cold_prior_s=0.05,
+        shed_max_wait_s=0.5,
+        standby_router=True,
+        autoscale=False,
+    )
+    kw.update(overrides)
+    return StackConfig(**kw)
 
 
 def reclamation_stack_config(trace: Any, **overrides: Any):

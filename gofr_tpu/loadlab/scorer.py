@@ -214,9 +214,11 @@ def check_invariants(outcomes: Iterable[Any], timelines: Iterable[Any] = (),
        terminal with ``terminal_marks == 1`` (two marks = two settlement
        paths both thought they won; zero = a stranded request);
     3. **class ordering** — interactive goodput ≥ batch goodput overall,
-       and STRICTLY greater inside the named fault window (the window
-       must contain traffic of both classes to be gradeable — the
-       acceptance scenario guarantees it by pinning the storm there).
+       and STRICTLY greater inside the named fault window whenever
+       interactive lost anything at all — a perfect interactive score
+       satisfies the ordering vacuously (the window must contain
+       traffic of both classes to be gradeable — the acceptance
+       scenario guarantees it by pinning the storm there).
     """
     violations: list[str] = []
     outcomes = list(outcomes)
@@ -253,7 +255,11 @@ def check_invariants(outcomes: Iterable[Any], timelines: Iterable[Any] = (),
                     f"fault window {fault_window!r} lacks traffic of both "
                     "classes — the scenario is not gradeable"
                 )
-            elif win_i <= win_b:
+            elif win_i < 1.0 and win_i <= win_b:
+                # strictness only bites when interactive actually lost
+                # something: a fast host can absorb the whole fault
+                # (both classes perfect), and zero interactive loss
+                # cannot be mis-ordered
                 violations.append(
                     f"class ordering under chaos: interactive goodput "
                     f"{win_i:.3f} <= batch {win_b:.3f} in {fault_window!r}"

@@ -94,6 +94,12 @@ class StackConfig:
     # backlog the horizon never drains
     warmup: bool = True
     warmup_concurrency: int = 8
+    # HA pair (docs/robustness.md "The HA plane"): build a SECOND router
+    # over its own consumer-group view of the same heartbeat log, so
+    # both routers observe every beat all run long. crash_router()
+    # promotes it by pointer swap — the replica-side dedup registry and
+    # epoch fence are what make the pair safe, not router coordination.
+    standby_router: bool = False
 
 
 class ServingStack:
@@ -109,19 +115,35 @@ class ServingStack:
         self.params = params
         self.config = config or StackConfig()
         self.broker = InMemoryBroker(consumer_group="loadlab-router")
-        self.router = Router(
-            RouterConfig(
-                heartbeat_s=self.config.heartbeat_s,
-                suspect_after_s=self.config.suspect_after_s,
-                down_after_s=self.config.down_after_s,
-                spill_wait_s=0.25,
-            ),
-            broker=self.broker,
+        router_cfg = RouterConfig(
+            heartbeat_s=self.config.heartbeat_s,
+            suspect_after_s=self.config.suspect_after_s,
+            down_after_s=self.config.down_after_s,
+            spill_wait_s=0.25,
         )
+        self.router = Router(router_cfg, broker=self.broker)
         self.tenant_registry = TenantRegistry()
         # the router steers interactive-class traffic off preemptible
         # capacity; it needs the registry to resolve a request's class
         self.router.use_tenants(self.tenant_registry)
+        # the HA pair: the standby consumes the SAME heartbeat log under
+        # its own consumer group (both routers see every beat), stays
+        # warm all run, and is promoted by crash_router()'s pointer swap
+        self.standby: Router | None = None
+        self.routers: list[Router] = [self.router]
+        self.router_crashes = 0
+        if self.config.standby_router:
+            self.standby = Router(
+                RouterConfig(
+                    heartbeat_s=self.config.heartbeat_s,
+                    suspect_after_s=self.config.suspect_after_s,
+                    down_after_s=self.config.down_after_s,
+                    spill_wait_s=0.25,
+                ),
+                broker=self.broker.group_view("loadlab-router-b"),
+            )
+            self.standby.use_tenants(self.tenant_registry)
+            self.routers.append(self.standby)
         for name, slo_class in self.config.tenants.items():
             self.tenant_registry.set_policy(
                 TenantPolicy(name=name, deadline_class=slo_class)
@@ -219,6 +241,12 @@ class ServingStack:
         announcer.start()
         with self._mu:
             self.announcers[rid] = announcer
+            standby = self.standby
+        if standby is not None:
+            # the standby needs its own handle registered (the pool
+            # driver only registers with the primary); membership state
+            # still comes from the shared heartbeat stream
+            standby.add_replica(LocalReplica(rid, engine, role=role))
         return LocalReplica(rid, engine, role=role)
 
     def _on_reap(self, handle: Any) -> None:
@@ -236,7 +264,8 @@ class ServingStack:
         if self._started:
             return self
         self._started = True
-        self.router.start()
+        for router in self.routers:
+            router.start()
         for role in dict.fromkeys(self.config.roles):
             total = self.config.roles.count(role)
             spot = min(self.config.preemptible.get(role, 0), total)
@@ -254,8 +283,14 @@ class ServingStack:
             for role in dict.fromkeys(self.config.roles)
         }
         while _time.monotonic() < deadline:
+            # EVERY router in the HA pair must see the full tier: a
+            # standby promoted before its membership warmed would route
+            # into a half-known fleet
             have = {
-                role: len(self.router.membership.candidates(role=role))
+                role: min(
+                    len(r.membership.candidates(role=role))
+                    for r in self.routers
+                )
                 for role in want
             }
             if all(have[role] >= n for role, n in want.items()):
@@ -304,7 +339,8 @@ class ServingStack:
             exporters = list(self.exporters.values())
         for announcer in announcers:
             announcer.stop(final_beat=False)
-        self.router.stop()
+        for router in self.routers:
+            router.stop()
         for rid, engine in engines:
             if rid not in self.killed:
                 engine.stop()
@@ -343,6 +379,30 @@ class ServingStack:
             announcer.stop(final_beat=False)  # dies silent, like a process
         engine.stop()
         return rid
+
+    def crash_router(self) -> str:
+        """Abrupt death of the ACTIVE router (docs/robustness.md "The HA
+        plane"). The standby — warm on the same heartbeat stream under
+        its own consumer group all run — is promoted by pointer swap
+        FIRST (the driver reads ``stack.router`` per submit, so the very
+        next arrival rides the survivor), then the dead router is
+        hard-stopped. Requests in flight on the dead router keep
+        settling (their replica attempts are live; settlement callbacks
+        run on replica threads), but its failover machinery dies with
+        it — exactly a process crash's blast radius. The replica-side
+        dedup registry + epoch fence are what make the promoted router
+        safe against double-serving, not any router-to-router handshake."""
+        with self._mu:
+            if self.standby is None:
+                raise RuntimeError(
+                    "no standby router (StackConfig.standby_router=False, "
+                    "or already crashed once)"
+                )
+            old, self.router = self.router, self.standby
+            self.standby = None
+            self.router_crashes += 1
+        old.stop()
+        return "router"
 
     def notice(self, rid: str | None = None,
                deadline_s: float | None = None) -> str | None:
@@ -409,8 +469,9 @@ class ServingStack:
             "scale_downs": (
                 self.autoscaler.scale_downs_total if self.autoscaler else 0
             ),
-            "routed_total": self.router.routed_total,
-            "failovers_total": self.router.failovers_total,
+            "routed_total": sum(r.routed_total for r in self.routers),
+            "failovers_total": sum(r.failovers_total for r in self.routers),
+            "router_crashes": self.router_crashes,
             "preemptible": sorted(self.pool.preemptible_ids()),
             "notices_total": self.pool.notices_total,
             "notices_dropped_total": self.pool.notices_dropped_total,

@@ -31,14 +31,17 @@ import numpy as np
 from gofr_tpu import chaos
 from gofr_tpu.http.errors import (
     ErrorDeadlineExceeded,
+    ErrorEntityNotFound,
     ErrorInvalidParam,
     ErrorRequestEntityTooLarge,
     ErrorServiceUnavailable,
+    ErrorStaleEpoch,
     ErrorTooManyRequests,
 )
 from gofr_tpu.models import llama
 from gofr_tpu.native.runtime import QueueFull, Scheduler
 from gofr_tpu.serving import batch as batch_ops
+from gofr_tpu.serving.dedup import DedupEntry, DedupRegistry, ReplayGap, ReplayStream
 from gofr_tpu.serving.shed import QueueWaitEstimator
 from gofr_tpu.serving.stepplan import ChunkCursor, StepPlan, StepPlanner
 from gofr_tpu.serving.timeline import TimelineRecorder
@@ -146,6 +149,19 @@ class EngineConfig:
     # and the row resumes warm with its emitted tokens intact. Off = the
     # A/B control: a tenant storm then starves higher classes.
     tenant_preempt: bool = True
+    # HA plane (docs/robustness.md "The HA plane"): bounded per-request
+    # emitted-frame ring for idempotency-keyed requests — a client (or a
+    # second router) re-attaching after a router/transport death replays
+    # the acked-but-unseen suffix token-identically instead of re-running
+    # the generation. Sized in frames (tokens + 1 terminal).
+    stream_replay_tokens: int = 512
+    # terminal entries retained in the idempotency dedup registry (LRU);
+    # live entries are bounded by in-flight requests and don't count
+    idem_capacity: int = 1024
+    # grace window after a keyed stream's client vanishes mid-generation:
+    # the request keeps running this long awaiting a resume re-attach
+    # before it is canceled like an unkeyed disconnect would be
+    stream_orphan_grace_s: float = 10.0
 
     @classmethod
     def from_config(cls, config: Any) -> "EngineConfig":
@@ -219,6 +235,13 @@ class EngineConfig:
             tenant_preempt=config.get_or_default(
                 "TPU_TENANT_PREEMPT", "1"
             ) not in ("0", "false", "off"),
+            stream_replay_tokens=int(
+                config.get_or_default("TPU_STREAM_REPLAY_TOKENS", "512")
+            ),
+            idem_capacity=int(config.get_or_default("TPU_IDEM_CAPACITY", "1024")),
+            stream_orphan_grace_s=float(
+                config.get_or_default("TPU_STREAM_ORPHAN_GRACE_S", "10")
+            ),
         )
 
 
@@ -255,6 +278,7 @@ class _Request:
         "canceled", "stop_ids", "priority", "dispatched", "deadline",
         "kv_exhausted", "timeline", "trace_ctx", "prefill_only",
         "handoff_from", "tenant", "adapter_id", "adapter_slot", "preemptions",
+        "idem_key", "replay",
     )
 
     def __init__(self, rid: int, prompt_ids: list[int], max_new_tokens: int,
@@ -301,6 +325,11 @@ class _Request:
         self.adapter_id: str | None = None
         self.adapter_slot = 0
         self.preemptions = 0
+        # HA plane: the request's Idempotency-Key (duplicates attach
+        # instead of dispatching) and its bounded emitted-frame ring
+        # (serving/dedup.py ReplayStream); both None for unkeyed requests
+        self.idem_key: str | None = None
+        self.replay: Any = None
         # absolute perf_counter time the caller stops caring; None = forever
         self.deadline = (self.created + deadline) if deadline else None
 
@@ -601,6 +630,17 @@ class ServingEngine:
         # _lifecycle_mu → _submit_mu → _count_lock.
         self._submit_mu = threading.Lock()
         self._supervisor: Any = None  # EngineSupervisor backref (health)
+        # -- HA plane (docs/robustness.md "The HA plane") ------------------
+        # fence epoch: monotonic, bumped by warm_restart / begin_reclaim /
+        # announcer re-register and gossiped on the heartbeat. A caller
+        # presenting fence_epoch != current is acting on a pre-restart view
+        # of this replica and is rejected (ErrorStaleEpoch) before any
+        # scheduler state is touched — the zombie-router fence.
+        self.epoch = 1
+        # idempotency dedup registry: the replica-side exactly-once
+        # authority — duplicates attach to the live future or replay the
+        # stored terminal; _try_resolve stays the one terminal gate.
+        self._dedup = DedupRegistry(self.config.idem_capacity)
 
     @classmethod
     def from_checkpoint(
@@ -895,6 +935,10 @@ class ServingEngine:
             self._reclaim_deadline = notice_t0 + max(float(deadline_s), 0.0)
             self._reclaiming = True
             self._reclaim_swept = False
+            # fence bump: from this instant a router still acting on the
+            # pre-notice epoch is stale — its submits/cancels/KV-fetches
+            # are rejected at the wire (the heartbeat gossips the new one)
+            self.epoch += 1
         if self._metrics:
             self._metrics.increment_counter("app_replica_reclamations_total")
         if self._logger:
@@ -1127,6 +1171,10 @@ class ServingEngine:
             locked = self._submit_mu.acquire(timeout=max(join_timeout, 1.0))
             try:
                 self._restarting = True
+                # fence bump under the same mutex: no submit can observe
+                # the new scheduler with the old epoch — a caller fenced
+                # on the pre-restart epoch is rejected from here on
+                self.epoch += 1
             finally:
                 if locked:
                     self._submit_mu.release()
@@ -1327,6 +1375,10 @@ class ServingEngine:
             "total_admitted": stats["total_admitted"],
             "kv_layout": self.config.kv_layout,
             "shed": self._shed.snapshot(),
+            # HA plane: the fence epoch rides the heartbeat so routers
+            # fence their per-attempt calls on the replica's current view
+            "epoch": self.epoch,
+            "dedup": self._dedup.stats(),
         }
         if self._running:
             details["heartbeat_age_s"] = round(self.heartbeat_age(), 3)
@@ -1400,6 +1452,8 @@ class ServingEngine:
         handoff_from: str | None = None,
         tenant: str | None = None,
         adapter_id: str | None = None,
+        idempotency_key: str | None = None,
+        fence_epoch: int | None = None,
     ) -> Any:
         """Thread-safe submit. Returns a concurrent Future resolving to
         GenerationResult. ``stream_cb(token_id, text_piece, done)`` fires per
@@ -1411,8 +1465,28 @@ class ServingEngine:
         ``trace_ctx`` is the caller's parent Span (the HTTP/gRPC server
         span or the router's attempt span): the request's lifecycle spans
         (queue → prefill/decode/detok) hang off it, and the trace id lands
-        in the request's ``/requestz`` timeline."""
+        in the request's ``/requestz`` timeline.
+
+        HA plane: ``idempotency_key`` makes the submit exactly-once — a
+        duplicate attaches to the live request's future (and replays the
+        emitted-frame suffix into its ``stream_cb``) or replays the stored
+        terminal; it never dispatches twice. ``fence_epoch`` is checked
+        against ``self.epoch`` BEFORE any other gate: a stale caller is
+        rejected (409) without touching scheduler state."""
         import concurrent.futures
+
+        # the fence is absolutely first: a zombie router acting on a
+        # pre-restart membership view must not observe queue depth, charge
+        # tenant budgets, or allocate a request id
+        self.check_fence(fence_epoch)
+        idem_key = str(idempotency_key) if idempotency_key else None
+        if idem_key:
+            # duplicate fast path BEFORE the draining/restarting/shed
+            # gates: attaching to (or replaying) work this replica already
+            # owns is not new work — a draining replica still honors it
+            entry = self._dedup.lookup(idem_key)
+            if entry is not None:
+                return self._attach_duplicate(entry, stream_cb)
 
         if self._draining:
             # retriable: the LB should route the retry to another replica
@@ -1538,6 +1612,16 @@ class ServingEngine:
             except UnknownAdapter:  # deregistered since the gate above
                 raise ErrorInvalidParam("adapter_id") from None
 
+        claim_entry: DedupEntry | None = None
+        if idem_key:
+            # the atomic claim, AFTER the admission gates a fresh request
+            # must pass: exactly one concurrent submit per key owns the
+            # dispatch; a racer that lost between the lookup above and
+            # here attaches to the owner instead
+            owner, claim_entry = self._dedup.claim(idem_key)
+            if not owner:
+                return self._attach_duplicate(claim_entry, stream_cb)
+
         future: Any = concurrent.futures.Future()
         future.request_id = rid
         req = _Request(
@@ -1545,6 +1629,15 @@ class ServingEngine:
             stop_ids={self.tokenizer.eos_id}, deadline=deadline,
         )
         req.priority = priority
+        if claim_entry is not None:
+            # every emission path (detok token frames, all done-frame
+            # settlement paths) flows through the bounded seq-numbered
+            # ring so a resume can replay the acked-but-unseen suffix;
+            # the original stream_cb still sees the plain 3-arg wire
+            req.idem_key = idem_key
+            req.replay = ReplayStream(self.config.stream_replay_tokens)
+            req.stream_cb = req.replay.wrap(stream_cb)
+            claim_entry.publish(rid, future, req.replay)
         req.prefill_only = bool(prefill_only)
         req.handoff_from = handoff_from
         req.tenant = tenant
@@ -1682,9 +1775,13 @@ class ServingEngine:
             if not future.done():
                 self.cancel(future.request_id)
 
-    def cancel(self, request_id: int) -> None:
+    def cancel(self, request_id: int, *, fence_epoch: int | None = None) -> None:
         """Mark a queued or running request canceled; a running one frees
-        its slot on the next step, a queued one resolves at admission."""
+        its slot on the next step, a queued one resolves at admission.
+        ``fence_epoch`` rejects a stale caller (409) before any state is
+        touched — a fenced zombie router must not cancel work a current
+        router legitimately owns."""
+        self.check_fence(fence_epoch)
         with self._count_lock:
             req = self._by_id.get(request_id)
         if req is not None:
@@ -1694,6 +1791,154 @@ class ServingEngine:
         except KeyError:
             pass
         self._wake.set()
+
+    # --------------------------------------------------- HA plane (resume)
+    def check_fence(self, fence_epoch: int | None) -> None:
+        """Reject a caller whose fence epoch is not this engine's current
+        one. The epoch bumps on warm_restart / begin_reclaim / announcer
+        re-register and gossips on the heartbeat; ``None`` (an unfenced
+        caller) always passes — fencing is the router tier's opt-in."""
+        if fence_epoch is not None and int(fence_epoch) != self.epoch:
+            raise ErrorStaleEpoch(
+                f"fence epoch {int(fence_epoch)} != engine epoch "
+                f"{self.epoch}; refresh membership"
+            )
+
+    def _attach_duplicate(self, entry: DedupEntry, stream_cb: Callable | None,
+                          last_seq: int = 0) -> Any:
+        """A duplicate idempotency-keyed submit: attach, never dispatch.
+
+        Live entry → the ORIGINAL future (exactly one terminal, one
+        ``_try_resolve`` win) with the unseen frame suffix replayed into
+        ``stream_cb``; terminal entry → a resolved future replaying the
+        stored result. The claim-to-publish window is closed by waiting
+        on ``entry.ready``."""
+        import concurrent.futures
+
+        # bounds only the owner's claim-to-publish window (microseconds
+        # of admission code); failure is a fast retriable 503
+        if not entry.ready.wait(timeout=5.0) or (
+            entry.future is None and not entry.terminal
+        ):
+            # the owner is still admitting (or its admission failed and
+            # the key was forgotten): retriable — the retry re-runs fresh
+            raise ErrorServiceUnavailable(
+                "idempotent twin still admitting; retry", retry_after=0.5
+            )
+        if entry.terminal:
+            fut: Any = concurrent.futures.Future()
+            fut.request_id = entry.rid
+            if stream_cb is not None:
+                self._replay_result(
+                    entry.result, last_seq,
+                    lambda _seq, tid, piece, done: stream_cb(tid, piece, done),
+                )
+            fut.set_result(entry.result)
+            return fut
+        if stream_cb is not None and entry.replay is not None:
+            try:
+                entry.replay.attach(
+                    last_seq,
+                    lambda _seq, tid, piece, done: stream_cb(tid, piece, done),
+                )
+            except ReplayGap:
+                raise ErrorEntityNotFound("replay window", entry.key) from None
+        return entry.future
+
+    def _replay_result(self, result: Any, last_seq: int,
+                       cb: Callable[[int, int, str, bool], None]) -> None:
+        """Replay a stored terminal's token frames past ``last_seq``.
+
+        Ring seq i+1 is provably token_ids[i]: the ring is fed by the
+        single detok worker in emission order, stop tokens are never
+        emitted as frames, and the terminal frame takes seq N+1 — so the
+        canonical token list reproduces the exact wire."""
+        token_ids = list(result.token_ids)
+        for i, tid in enumerate(token_ids):
+            seq = i + 1
+            if seq > last_seq:
+                cb(seq, tid, self.tokenizer.decode([tid]), False)
+        done_seq = len(token_ids) + 1
+        if done_seq > last_seq:
+            cb(done_seq, -1, "", True)
+
+    def resume(self, idempotency_key: str, *, last_seq: int = 0,
+               stream_cb: Callable[[int, int, str, bool], None] | None = None,
+               fence_epoch: int | None = None) -> Any:
+        """Re-attach to an idempotency-keyed request's token stream.
+
+        The resume wire (``Last-Event-ID`` re-attach): replays every
+        frame with ``seq > last_seq`` — token-identically, from the
+        bounded ring (live) or the stored terminal — then rides the
+        still-running generation. ``stream_cb`` here is the 4-arg frame
+        wire ``(seq, token_id, piece, done)`` so transports can stamp
+        ``id:`` lines without re-counting. Unknown key → 404 (nothing to
+        resume — the client must submit, which dedups safely anyway);
+        evicted suffix → 404 on the replay window (a token-identical
+        resume is impossible and the engine will not re-generate)."""
+        chaos.maybe_fail("stream.resume")
+        self.check_fence(fence_epoch)
+        key = str(idempotency_key)
+        entry = self._dedup.lookup(key)
+        if entry is None:
+            raise ErrorEntityNotFound("idempotency_key", key)
+        # bounds only the owner's claim-to-publish window; failure is a
+        # fast retriable 503
+        if not entry.ready.wait(timeout=5.0) or (
+            entry.future is None and not entry.terminal
+        ):
+            raise ErrorServiceUnavailable(
+                "request still admitting; retry", retry_after=0.5
+            )
+        import concurrent.futures
+
+        if entry.terminal:
+            if stream_cb is not None:
+                self._replay_result(entry.result, int(last_seq), stream_cb)
+            fut: Any = concurrent.futures.Future()
+            fut.request_id = entry.rid
+            fut.set_result(entry.result)
+            return fut
+        if stream_cb is not None:
+            try:
+                entry.replay.attach(int(last_seq), stream_cb)
+            except ReplayGap:
+                raise ErrorEntityNotFound("replay window", key) from None
+        return entry.future
+
+    def orphan(self, request_id: int, grace_s: float | None = None) -> None:
+        """A resumable (keyed) client vanished mid-stream: park the
+        generation for a bounded grace window instead of canceling.
+
+        A resume within the window re-attaches and rides on; if nobody
+        re-attaches (and no new attach superseded this orphaning), the
+        timer cancels the request exactly like an unkeyed disconnect.
+        Unkeyed requests don't come here — their transports cancel
+        directly."""
+        grace = grace_s if grace_s is not None else self.config.stream_orphan_grace_s
+        with self._count_lock:
+            req = self._by_id.get(request_id)
+        if req is None:
+            return
+        if req.replay is None or grace <= 0:
+            self.cancel(request_id)
+            return
+        attaches_at_orphan = req.replay.attaches
+
+        def _reap() -> None:
+            if req.future.done():
+                return
+            if req.replay.attaches > attaches_at_orphan:
+                return  # someone resumed; their disconnect re-orphans
+            self.cancel(request_id)
+
+        timer = threading.Timer(grace, _reap)
+        timer.daemon = True
+        timer.start()
+
+    def dedup_stats(self) -> dict[str, int]:
+        """Registry counters for /routerz-style introspection and tests."""
+        return self._dedup.stats()
 
     # ------------------------------------------------------------- the loop
     def _loop(self) -> None:
@@ -3616,6 +3861,15 @@ class ServingEngine:
         except Exception:
             return False  # the other settler won the race
         self._record_terminal(req, value, exc)
+        # HA plane: the settlement winner (and only the winner) flips the
+        # dedup registry. A successful result is retained for duplicate
+        # replay; an exception terminal forgets the key so a genuine
+        # client retry re-runs as a fresh request.
+        if req.idem_key is not None:
+            if exc is None and value is not None:
+                self._dedup.settle(req.idem_key, value)
+            else:
+                self._dedup.forget(req.idem_key)
         return True
 
     @staticmethod

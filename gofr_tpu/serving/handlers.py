@@ -50,6 +50,14 @@ class GenerateRequest:
     # without rewriting payloads.
     adapter_id: str = ""
     tenant: str = ""
+    # HA plane (docs/robustness.md "The HA plane"): the client's
+    # Idempotency-Key — a duplicate submit attaches to the live request
+    # or replays its terminal instead of dispatching twice (the header
+    # outranks the body field, same contract as tenancy); fence_epoch
+    # stamps the caller's view of the replica's fence epoch — stale
+    # callers are rejected 409 before any engine state is touched.
+    idempotency_key: str = ""
+    fence_epoch: int = 0
 
 
 def _shutdown_hook(engine: Any) -> Any:
@@ -130,7 +138,18 @@ def register_generation_routes(app: Any, engine: Any, prefix: str = "",
         streams, whatever the body's ``stream`` flag says — a router's
         HTTPReplica needs a surface whose FIRST byte is the request id
         frame and whose tokens arrive as they decode, so remote TTFT is
-        decoupled from completion time."""
+        decoupled from completion time.
+
+        Re-attach (docs/serving.md "Resumable streams"): a request with
+        BOTH ``Last-Event-ID`` and ``Idempotency-Key`` headers resumes
+        the keyed stream instead of submitting — the engine replays every
+        frame past the acked seq token-identically and the response rides
+        the still-running generation. No prompt needed (the original
+        submit owns it), so the branch runs before body validation."""
+        last_id = ctx.header("last-event-id")
+        idem = ctx.header("idempotency-key")
+        if last_id and idem and hasattr(engine, "resume"):
+            return _sse_resume_response(engine, ctx, idem, last_id)
         body = ctx.bind(GenerateRequest)
         kw = _request_kwargs(ctx, body)
         return _sse_response(engine, body.prompt, kw)
@@ -178,23 +197,31 @@ def _sse_response(engine: Any, prompt: str, kw: dict) -> WireResponse:
             # id frame FIRST (docs/serving.md wire format): the remote
             # cancel wire needs the request id before any token arrives —
             # a client that hedges/aborts pre-first-token must be able to
-            # name what it is canceling
+            # name what it is canceling. ``id:`` lines carry the frame
+            # sequence (id frame 0, tokens 1..N, terminal N+1) — the
+            # handler's local count provably matches the engine-side
+            # replay ring (same ordered single-worker detok stream), so a
+            # client's Last-Event-ID re-attach replays exactly the unseen
+            # suffix (docs/serving.md "Resumable streams")
             yield (
-                "data: " + json.dumps({"id": future.request_id}) + "\n\n"
+                "id: 0\ndata: "
+                + json.dumps({"id": future.request_id}) + "\n\n"
             ).encode()
+            seq = 0
             while True:
                 token_id, piece, done = await q.get()
                 if done:
                     break
+                seq += 1
                 payload = json.dumps({"token": token_id, "text": piece})
-                yield f"data: {payload}\n\n".encode()
+                yield f"id: {seq}\ndata: {payload}\n\n".encode()
             result = await asyncio.wrap_future(future)
             if result is not None:
                 # terminal event: finish_reason (stop/length/cancel/
                 # deadline_exceeded) + usage, so streaming clients learn WHY
                 # the stream ended, not just that it did
                 yield (
-                    "data: " + json.dumps({
+                    f"id: {seq + 1}\ndata: " + json.dumps({
                         "finish_reason": result.finish_reason,
                         "usage": {
                             "prompt_tokens": result.prompt_tokens,
@@ -210,7 +237,9 @@ def _sse_response(engine: Any, prompt: str, kw: dict) -> WireResponse:
             # now; a LATE typed error (queued-expiry 504, drain-deadline
             # 503) becomes a terminal error event instead of a torn
             # connection — admission errors never reach here, they raised
-            # from the eager submit above with a real status
+            # from the eager submit above with a real status. Error
+            # frames carry no id: the dedup entry is forgotten on an
+            # exception terminal, so there is nothing to resume past.
             yield (
                 "data: " + json.dumps({
                     "error": exc.message, "status": exc.status_code,
@@ -219,9 +248,101 @@ def _sse_response(engine: Any, prompt: str, kw: dict) -> WireResponse:
             yield b"data: [DONE]\n\n"
         finally:
             # client disconnected mid-stream (server aclose()s the
-            # generator): free the slot instead of decoding into the void
+            # generator): free the slot instead of decoding into the void.
+            # A KEYED stream is resumable — the disconnect may be a dying
+            # router whose survivor re-attaches — so it parks for the
+            # orphan-grace window instead of canceling outright.
             if not future.done():
-                engine.cancel(future.request_id)
+                if kw.get("idempotency_key") and hasattr(engine, "orphan"):
+                    engine.orphan(future.request_id)
+                else:
+                    engine.cancel(future.request_id)
+
+    return WireResponse(
+        headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        },
+        stream=gen(),
+    )
+
+
+def _sse_resume_response(engine: Any, ctx: Any, idem_key: str,
+                         last_raw: str) -> WireResponse:
+    """The ``Last-Event-ID`` re-attach wire: replays the keyed stream's
+    unseen suffix (token-identical, from the engine's bounded replay
+    ring or the stored terminal) and rides the live generation. Resume
+    errors (unknown key 404, evicted window 404, stale epoch 409) raise
+    BEFORE the head commits — real statuses clients can key on."""
+    try:
+        last_seq = int(last_raw)
+    except (TypeError, ValueError):
+        raise ErrorInvalidParam("Last-Event-ID") from None
+    fence_raw = ctx.header("x-fence-epoch")
+    fence_epoch = None
+    if fence_raw:
+        try:
+            fence_epoch = int(fence_raw)
+        except ValueError:
+            raise ErrorInvalidParam("X-Fence-Epoch") from None
+    loop = asyncio.get_running_loop()
+    q: asyncio.Queue = asyncio.Queue()
+
+    def cb(seq: int, token_id: int, piece: str, done: bool) -> None:
+        loop.call_soon_threadsafe(q.put_nowait, (seq, token_id, piece, done))
+
+    future = engine.resume(
+        idem_key, last_seq=last_seq, stream_cb=cb, fence_epoch=fence_epoch
+    )
+
+    async def gen():
+        try:
+            # re-attach head frame: names the request and echoes the seq
+            # the replay starts after; id stays at the client's own
+            # high-water mark so a naive tracker never regresses
+            yield (
+                f"id: {last_seq}\ndata: " + json.dumps({
+                    "id": future.request_id, "resumed": last_seq,
+                }) + "\n\n"
+            ).encode()
+            term_seq = last_seq
+            while True:
+                seq, token_id, piece, done = await q.get()
+                if done:
+                    term_seq = seq
+                    break
+                payload = json.dumps({"token": token_id, "text": piece})
+                yield f"id: {seq}\ndata: {payload}\n\n".encode()
+            result = await asyncio.wrap_future(future)
+            if result is not None:
+                yield (
+                    f"id: {term_seq}\ndata: " + json.dumps({
+                        "finish_reason": result.finish_reason,
+                        "usage": {
+                            "prompt_tokens": result.prompt_tokens,
+                            "completion_tokens": result.completion_tokens,
+                        },
+                    }) + "\n\n"
+                ).encode()
+            yield b"data: [DONE]\n\n"
+        except asyncio.CancelledError:
+            raise
+        except HTTPError as exc:
+            yield (
+                "data: " + json.dumps({
+                    "error": exc.message, "status": exc.status_code,
+                }) + "\n\n"
+            ).encode()
+            yield b"data: [DONE]\n\n"
+        finally:
+            # a resumed stream is keyed by construction: its disconnect
+            # parks for the grace window like the original stream's did
+            if not future.done():
+                orphan = getattr(engine, "orphan", None)
+                if orphan is not None:
+                    orphan(future.request_id)
+                else:
+                    engine.cancel(future.request_id)
 
     return WireResponse(
         headers={
@@ -256,6 +377,11 @@ def _validated_generate_kwargs(body: GenerateRequest) -> dict:
         kw["adapter_id"] = body.adapter_id
     if body.tenant:
         kw["tenant"] = body.tenant
+    # HA-plane flags ride only when set, same engine-double contract
+    if body.idempotency_key:
+        kw["idempotency_key"] = body.idempotency_key
+    if body.fence_epoch:
+        kw["fence_epoch"] = int(body.fence_epoch)
     return kw
 
 
@@ -270,6 +396,19 @@ def _request_kwargs(ctx: Any, body: GenerateRequest) -> dict:
     header_tenant = ctx.header("x-tenant-id")
     if header_tenant:
         kw["tenant"] = header_tenant
+    header_idem = ctx.header("idempotency-key")
+    if header_idem:
+        kw["idempotency_key"] = header_idem
+    # a gateway stamping the fence outranks the body's claim, same as
+    # tenancy; 0 means unfenced (epochs start at 1), same as the body
+    fence_raw = ctx.header("x-fence-epoch")
+    if fence_raw:
+        try:
+            fence = int(fence_raw)
+        except ValueError:
+            raise ErrorInvalidParam("X-Fence-Epoch") from None
+        if fence:
+            kw["fence_epoch"] = fence
     kw["deadline"] = deadline_from_ctx(ctx)
     # hang the engine's lifecycle spans off the request's server span
     # (which carries the inbound W3C traceparent when one was sent)
@@ -376,6 +515,13 @@ def register_kv_fetch_routes(app: Any, engine: Any,
 
     async def kv_fetch(ctx: Any):
         body = ctx.bind(dict) or {}
+        # HA plane: a fenced caller (zombie router on a pre-restart
+        # membership view) is rejected 409 before any cache is touched
+        fence = body.get("fence_epoch")
+        if fence is not None:
+            check = getattr(engine, "check_fence", None)
+            if check is not None:
+                check(int(fence))
         keys = body.get("keys")
         if not keys or not isinstance(keys, list):
             raise ErrorMissingParam("keys")

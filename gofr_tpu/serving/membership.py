@@ -108,6 +108,13 @@ class Heartbeat:
     # seq discipline the membership table already enforces. None when
     # the replica advertises nothing (no prefix cache wired).
     prefix_keys: list | None = None
+    # HA plane (docs/robustness.md "The HA plane"): the replica's fence
+    # epoch — monotonic, bumped on warm_restart / begin_reclaim /
+    # announcer re-register. Routers stamp it on every per-attempt call;
+    # the engine rejects a stale epoch at the wire (ErrorStaleEpoch),
+    # which fences a zombie router acting on a pre-restart view. 0 =
+    # unfenced (an engine predating the epoch, or a stub).
+    epoch: int = 0
 
     def to_json(self) -> bytes:
         return json.dumps(dataclasses.asdict(self)).encode()
@@ -136,6 +143,7 @@ class _ReplicaView:
         self.forced_down_reason: str | None = None  # breaker-open etc.
         self.preemptible = False
         self.reclaim_deadline_s: float | None = None
+        self.epoch = 0  # fence epoch as last gossiped (0 = unfenced)
 
     def effective_state(self, now: float, suspect_after: float,
                         down_after: float) -> str:
@@ -164,6 +172,8 @@ class _ReplicaView:
             "slots_free": self.slots_free,
             "kv_free_frac": round(self.kv_free_frac, 4),
         }
+        if self.epoch:
+            out["epoch"] = self.epoch
         if self.preemptible:
             out["preemptible"] = True
         if self.reclaim_deadline_s is not None:
@@ -238,6 +248,10 @@ class MembershipTable:
                 float(hb.reclaim_deadline_s)
                 if hb.reclaim_deadline_s is not None else None
             )
+            if hb.epoch > view.epoch:
+                # monotonic like seq: a redelivered pre-restart beat must
+                # never roll the fence back to an epoch the engine rejects
+                view.epoch = int(hb.epoch)
             if hb.state == UP and view.forced_down_reason is not None:
                 # a FRESH healthy announcement outranks a stale breaker
                 # verdict: the replica proved liveness after the breaker
@@ -321,6 +335,15 @@ class MembershipTable:
         pool = up if up else suspect
         pool.sort(key=lambda v: (v.queue_wait_s, -v.slots_free, v.replica_id))
         return [v.replica_id for v in pool]
+
+    def epoch_of(self, replica_id: str) -> int:
+        """The replica's fence epoch as last gossiped (0 = unknown or
+        unfenced). Routers stamp this on every per-attempt engine call;
+        an engine that restarted since returns ErrorStaleEpoch and the
+        router refreshes from the next beat."""
+        with self._mu:
+            view = self._replicas.get(replica_id)
+            return view.epoch if view is not None else 0
 
     def is_preemptible(self, replica_id: str) -> bool:
         """Whether the replica runs on reclaimable capacity (as last
@@ -451,6 +474,10 @@ class ReplicaAnnouncer:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.dropped_beats = 0  # partitioned (chaos) or failed publishes
+        # terminal beats (stop's final announcement) lost even after the
+        # bounded retry: the router waits out the SUSPECT timer instead
+        self.dropped_final_beats = 0
+        self._started_once = False  # a second start() is a re-register
 
     # -- heartbeat composition -------------------------------------------------
     def compose(self) -> Heartbeat:
@@ -517,6 +544,9 @@ class ReplicaAnnouncer:
             prefix_keys=prefix_keys,
             preemptible=preemptible,
             reclaim_deadline_s=reclaim_deadline,
+            # fence epoch gossips on every beat (0 for engines/stubs
+            # that predate the HA plane — unfenced)
+            epoch=int(getattr(self.engine, "epoch", 0) or 0),
         )
 
     def beat(self) -> bool:
@@ -543,6 +573,15 @@ class ReplicaAnnouncer:
         if self._thread is not None and self._thread.is_alive():
             return
         self._stop.clear()
+        if self._started_once:
+            # re-register: this announcer is re-joining the tier (a
+            # stop/start cycle a router may have observed as DOWN). Bump
+            # the engine's fence epoch so any caller still holding the
+            # pre-departure view is fenced — same rule as warm_restart.
+            epoch = getattr(self.engine, "epoch", None)
+            if isinstance(epoch, int):
+                self.engine.epoch = epoch + 1
+        self._started_once = True
         self.beat()  # announce immediately: the router learns of this
         # replica one beat sooner than the interval
         self._thread = threading.Thread(
@@ -559,11 +598,30 @@ class ReplicaAnnouncer:
         """Stop announcing. ``final_beat`` publishes the engine's current
         state one last time (DRAINING on a graceful drain, DOWN after a
         stop) so the router reacts immediately instead of waiting out the
-        suspect timer."""
+        suspect timer.
+
+        The terminal beat is the one beat with no successor to paper over
+        a dropped publish, so it gets ONE bounded, jittered retry (the
+        jitter is deterministic per replica — a fleet-wide drain must not
+        retry in lockstep against the broker that just dropped it). A
+        beat lost twice is counted in ``dropped_final_beats``: the router
+        falls back to its SUSPECT timer, which is the pre-existing
+        behavior — the retry only narrows the window, never blocks stop
+        beyond one interval."""
         self._stop.set()
         thread = self._thread
         if thread is not None:
             thread.join(timeout=2.0)
         self._thread = None
-        if final_beat:
-            self.beat()
+        if final_beat and not self.beat():
+            import zlib
+
+            jitter = (zlib.crc32(self.replica_id.encode()) % 50) / 1000.0
+            time.sleep(min(self.interval_s * 0.5, 0.1) + jitter)
+            if not self.beat():
+                self.dropped_final_beats += 1
+                if self._logger is not None:
+                    self._logger.warn(
+                        f"replica {self.replica_id}: terminal heartbeat "
+                        "lost twice; router will rely on its suspect timer"
+                    )

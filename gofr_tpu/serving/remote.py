@@ -42,12 +42,17 @@ from typing import Any, Callable
 from gofr_tpu import chaos
 from gofr_tpu.http.errors import (
     ErrorDeadlineExceeded,
+    ErrorEntityNotFound,
     ErrorServiceUnavailable,
+    ErrorStaleEpoch,
     ErrorTooManyRequests,
 )
 from gofr_tpu.service.options import retry_after_from_headers
 
-__all__ = ["iter_events", "run_stream", "error_from_status"]
+__all__ = [
+    "iter_events", "run_stream", "open_resume", "drain_resume",
+    "resume_stream", "error_from_status",
+]
 
 STREAM_PATH = "/generate/stream"
 CANCEL_PATH = "/generate/cancel"
@@ -69,6 +74,15 @@ def error_from_status(status: int, detail: str,
         )
     if status == 504:
         return ErrorDeadlineExceeded(detail)
+    if status == 409:
+        # the HA fence (docs/robustness.md "The HA plane"): this caller's
+        # view of the replica is stale — refresh membership, don't retry
+        return ErrorStaleEpoch(detail)
+    if status == 404:
+        # resume wire: unknown idempotency key or evicted replay window —
+        # nothing to re-attach to; the client falls back to a keyed
+        # submit (which dedups safely)
+        return ErrorEntityNotFound("resume", detail)
     return RuntimeError(detail)
 
 
@@ -83,12 +97,24 @@ def iter_events(resp: Any, deadline_abs: float | None = None) -> Any:
     caps per-read stalls, so without this gate an expired request keeps
     the remote decode — and this worker thread — running to the final
     frame. Checked between frames; the in-flight read still ends within
-    one socket timeout."""
+    one socket timeout.
+
+    Sequence numbers (docs/serving.md "Resumable streams"): an ``id:``
+    line preceding a frame is attached to the decoded event as ``seq`` —
+    the client's ``Last-Event-ID`` re-attach currency. Streams from
+    servers that predate sequencing simply yield events without it."""
+    last_id: int | None = None
     for line in resp.lines():
         if deadline_abs is not None and time.monotonic() > deadline_abs:
             raise ErrorDeadlineExceeded(
                 "remote stream exceeded the request deadline between frames"
             )
+        if line.startswith("id:"):
+            try:
+                last_id = int(line[3:].strip())
+            except ValueError:
+                last_id = None
+            continue
         if not line.startswith("data:"):
             continue  # SSE comments / keepalives
         payload = line[5:].strip()
@@ -102,6 +128,9 @@ def iter_events(resp: Any, deadline_abs: float | None = None) -> Any:
         except ValueError:
             continue
         if isinstance(event, dict):
+            if last_id is not None:
+                event.setdefault("seq", last_id)
+                last_id = None
             yield event
 
 
@@ -171,3 +200,101 @@ def run_stream(
         # router knows whether tokens already crossed
         raise ConnectionError("remote stream ended without a terminal frame")
     return terminal
+
+
+def open_resume(
+    svc: Any,
+    idempotency_key: str,
+    *,
+    last_seq: int = 0,
+    fence_epoch: int | None = None,
+    timeout: float | None = None,
+    path: str = STREAM_PATH,
+) -> Any:
+    """Open (only) a keyed re-attach: ``POST {path}`` with
+    ``Idempotency-Key`` + ``Last-Event-ID`` headers and no body. Raises
+    the typed head errors SYNCHRONOUSLY — 404 (unknown key / evicted
+    replay window), 409 (stale ``fence_epoch``), 503 — which is what
+    lets the router's resume walk classify a replica that never saw the
+    key and move to the next one, while the frame drain
+    (``drain_resume``) runs on a pool worker. Returns the open streaming
+    response (caller owns closing it)."""
+    headers = {
+        "Idempotency-Key": str(idempotency_key),
+        "Last-Event-ID": str(int(last_seq)),
+    }
+    if fence_epoch:
+        headers["X-Fence-Epoch"] = str(int(fence_epoch))
+    resp = svc.stream("POST", path, json={}, headers=headers, timeout=timeout)
+    if not resp.ok:
+        try:
+            detail = resp.read_body().decode("utf-8", "replace")[:200]
+        except Exception:
+            detail = ""
+        finally:
+            resp.close()
+        raise error_from_status(
+            resp.status_code,
+            f"remote resume: HTTP {resp.status_code} {detail}".strip(),
+            resp.headers,
+        )
+    return resp
+
+
+def drain_resume(
+    resp: Any,
+    *,
+    deadline_abs: float | None = None,
+    on_frame: Callable[[int, int, str], None] | None = None,
+) -> dict[str, Any]:
+    """Drive an open resume response to its terminal frame.
+    ``on_frame(seq, token_id, text)`` fires per replayed or live token
+    frame; returns the terminal event. Closes the response."""
+    terminal: dict[str, Any] | None = None
+    try:
+        for event in iter_events(resp, deadline_abs=deadline_abs):
+            if "error" in event:
+                raise error_from_status(
+                    int(event.get("status") or 0), str(event["error"])
+                )
+            if "finish_reason" in event:
+                terminal = event
+            elif "token" in event:
+                if on_frame is not None:
+                    on_frame(
+                        int(event.get("seq") or 0),
+                        int(event["token"]),
+                        str(event.get("text", "")),
+                    )
+    finally:
+        resp.close()
+    if terminal is None:
+        raise ConnectionError("remote resume ended without a terminal frame")
+    return terminal
+
+
+def resume_stream(
+    svc: Any,
+    idempotency_key: str,
+    *,
+    last_seq: int = 0,
+    fence_epoch: int | None = None,
+    timeout: float | None = None,
+    on_frame: Callable[[int, int, str], None] | None = None,
+    path: str = STREAM_PATH,
+) -> dict[str, Any]:
+    """Re-attach to a keyed remote stream (docs/serving.md "Resumable
+    streams"): ``open_resume`` + ``drain_resume`` on the caller thread —
+    the server replays every frame past ``last_seq`` token-identically
+    and rides the live generation. Typed raises: 404 (unknown key /
+    evicted replay window — fall back to a keyed submit, which dedups
+    safely), 409 (stale ``fence_epoch``), plus everything ``run_stream``
+    can."""
+    deadline_abs = (
+        time.monotonic() + timeout if timeout is not None else None
+    )
+    resp = open_resume(
+        svc, idempotency_key, last_seq=last_seq, fence_epoch=fence_epoch,
+        timeout=timeout, path=path,
+    )
+    return drain_resume(resp, deadline_abs=deadline_abs, on_frame=on_frame)

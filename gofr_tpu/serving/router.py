@@ -49,7 +49,9 @@ from gofr_tpu import chaos
 from gofr_tpu.chaos.injector import ChaosFault
 from gofr_tpu.http.errors import (
     ErrorDeadlineExceeded,
+    ErrorEntityNotFound,
     ErrorServiceUnavailable,
+    ErrorStaleEpoch,
     ErrorTooManyRequests,
 )
 from gofr_tpu.metrics.register import Histogram
@@ -70,6 +72,13 @@ RETRIABLE_ERRORS = (
     CircuitBreakerError,       # breaker open: the replica is gone
     ChaosFault,                # injected transient (chaos tier)
     ConnectionError,           # transport reset to a remote replica
+    # 409 stale fence: THIS router's view of the replica lagged a warm
+    # restart / reclaim / re-register (docs/robustness.md "The HA
+    # plane"). The engine wire must hard-reject (a zombie router cannot
+    # be allowed through), but for a merely-lagging router the right
+    # move is a re-route — each attempt re-stamps fence_epoch from the
+    # membership table, so the retry carries the refreshed fence.
+    ErrorStaleEpoch,
 )
 
 
@@ -213,6 +222,18 @@ class LocalReplica:
     def cancel(self, request_id: int) -> None:
         self.engine.cancel(request_id)
 
+    def resume(self, idempotency_key: str, *, last_seq: int = 0,
+               stream_cb: Any = None, fence_epoch: int | None = None,
+               deadline: float | None = None) -> Any:
+        """Re-attach to a keyed stream on this replica (docs/serving.md
+        "Resumable streams"); raises 404 when the engine never saw the
+        key (the router's resume walk tries the next replica)."""
+        del deadline  # in-process attach is immediate; no wire budget
+        return self.engine.resume(
+            idempotency_key, last_seq=last_seq, stream_cb=stream_cb,
+            fence_epoch=fence_epoch,
+        )
+
     def health_check(self) -> dict[str, Any]:
         return self.engine.health_check()
 
@@ -278,6 +299,12 @@ class HTTPReplica:
             payload["adapter_id"] = kw["adapter_id"]
         if kw.get("tenant"):
             payload["tenant"] = kw["tenant"]
+        # HA plane (docs/robustness.md "The HA plane"): the exactly-once
+        # key and the per-attempt membership fence ride the wire when set
+        if kw.get("idempotency_key"):
+            payload["idempotency_key"] = kw["idempotency_key"]
+        if kw.get("fence_epoch"):
+            payload["fence_epoch"] = int(kw["fence_epoch"])
         return payload
 
     def submit(self, prompt: str | list[int], *, deadline: float | None = None,
@@ -450,16 +477,94 @@ class HTTPReplica:
                 return
         self._post_cancel(remote_id)
 
+    def resume(self, idempotency_key: str, *, last_seq: int = 0,
+               stream_cb: Any = None, fence_epoch: int | None = None,
+               deadline: float | None = None) -> Any:
+        """Re-attach to a keyed stream on this remote replica
+        (docs/serving.md "Resumable streams"): the pool worker drives
+        ``resume_stream`` — ``Idempotency-Key`` + ``Last-Event-ID``
+        headers, suffix frames replayed token-identically, then the live
+        continuation. ``stream_cb`` is the 4-arg resumable wire
+        ``(seq, token_id, piece, done)``. The future resolves to a
+        GenerationResult-shaped view of the terminal whose ``token_ids``
+        hold the REPLAYED SUFFIX (the client already holds the acked
+        prefix). The head errors (404 unknown key, 409 stale fence, 503)
+        raise SYNCHRONOUSLY — the router's resume walk classifies them
+        and tries the next replica; only the frame drain runs on the
+        pool."""
+        from gofr_tpu.serving.remote import open_resume
+
+        with self._rid_mu:
+            self._next_rid += 1
+            rid = self._next_rid
+        resp = open_resume(
+            self._svc, idempotency_key, last_seq=int(last_seq),
+            fence_epoch=fence_epoch, timeout=deadline,
+        )
+        future: Any = concurrent.futures.Future()
+        future.request_id = rid
+        deadline_abs = (
+            time.monotonic() + deadline if deadline is not None else None
+        )
+        self._pool.submit(
+            self._run_resume, rid, future, resp, int(last_seq),
+            deadline_abs, stream_cb,
+        )
+        return future
+
+    def _run_resume(self, rid: int, future: Any, resp: Any, last_seq: int,
+                    deadline_abs: float | None, stream_cb: Any) -> None:
+        from gofr_tpu.serving.remote import drain_resume
+
+        state: dict[str, Any] = {"seq": last_seq, "ids": [], "pieces": []}
+
+        def on_frame(seq: int, token_id: int, text: str) -> None:
+            state["seq"] = max(state["seq"], seq)
+            state["ids"].append(token_id)
+            state["pieces"].append(text)
+            if stream_cb is not None:
+                stream_cb(seq, token_id, text, False)
+
+        try:
+            terminal = drain_resume(
+                resp, deadline_abs=deadline_abs, on_frame=on_frame,
+            )
+            data = dict(terminal)
+            data.setdefault("token_ids", list(state["ids"]))
+            data.setdefault("text", "".join(state["pieces"]))
+            if stream_cb is not None:
+                stream_cb(
+                    int(terminal.get("seq") or (state["seq"] + 1)),
+                    -1, "", True,
+                )
+            future.set_result(_RemoteResult(rid, data))
+        # gofrlint: disable=router-retry-untyped -- settles the future
+        # with the error (no retry happens here); a narrow catch would
+        # strand the client future forever on an unexpected failure
+        except BaseException as exc:
+            if isinstance(exc, OSError) and not isinstance(
+                exc, ConnectionError
+            ):
+                exc = ConnectionError(str(exc))
+            future.set_exception(exc)
+
     def fetch_kv(self, keys: list[str],
-                 timeout: float = 2.0) -> dict[str, tuple]:
+                 timeout: float = 2.0,
+                 fence_epoch: int | None = None) -> dict[str, tuple]:
         """Warm KV page migration, remote half (serving/prefix_index.py):
         fetch serialized prefix-cache slabs from this replica's
         ``/kv/fetch`` surface. Returns {key: (logits, k, v)} as HOST
         numpy arrays — the admitting engine uploads them asynchronously.
         Raises on transport failure; the migrator's fetch contract turns
-        any raise into a clean compute miss."""
+        any raise into a clean compute miss. ``fence_epoch`` rides the
+        payload when set: a fetch stamped against a replica that warm-
+        restarted since is rejected at the wire (409) instead of serving
+        slabs from a cache generation the caller never observed."""
+        payload: dict[str, Any] = {"keys": list(keys)}
+        if fence_epoch:
+            payload["fence_epoch"] = int(fence_epoch)
         resp = self._svc.post(
-            "/kv/fetch", json={"keys": list(keys)}, timeout=timeout,
+            "/kv/fetch", json=payload, timeout=timeout,
         )
         if not resp.ok:
             raise ConnectionError(
@@ -605,7 +710,18 @@ class Router:
         self.no_replica_total = 0
         self.handoffs_total = 0           # prefill→decode KV handoffs hinted
         self.handoff_degraded_total = 0   # handoffs degraded to re-prefill
+        self.last_resort_routes_total = 0  # SUSPECT-only pool routes
         self.routes_by_replica: dict[str, int] = {}
+        # HA plane (docs/robustness.md "The HA plane"): idempotency-key →
+        # replica-id fast path. Strictly an OPTIMIZATION — the replica-
+        # side DedupRegistry is the exactly-once authority, so this map
+        # may be stale, evicted, or empty (a freshly promoted standby
+        # router starts cold) without any correctness loss: a miss just
+        # means the duplicate walks the normal candidate order and the
+        # owning replica's registry attaches it. Bounded LRU; guarded by
+        # its own lock (touched on every keyed submit's hot path).
+        self._idem_mu = threading.Lock()
+        self._idem_routes: dict[str, str] = {}
 
     # -- provider pattern (lets the container own the router) ------------------
     def use_logger(self, logger: Any) -> None:
@@ -868,6 +984,9 @@ class Router:
                 "no routable replica (all draining, wedged, or down)",
                 retry_after=self.config.heartbeat_s,
             )
+        candidates = self._idem_fast_path(kw.get("idempotency_key"),
+                                          candidates)
+        self._note_last_resort(candidates)
         if spilled:
             with self._stats_mu:
                 self.spills_total += 1
@@ -991,6 +1110,124 @@ class Router:
                 self.routes_by_replica.get(replica_id, 0) + 1
             )
 
+    # -- HA plane (docs/robustness.md "The HA plane") ---------------------------
+    _IDEM_ROUTES_CAP = 4096
+
+    def _idem_fast_path(self, idempotency_key: Any,
+                        candidates: list[str]) -> list[str]:
+        """Reorder ``candidates`` so a keyed duplicate lands on the
+        replica that (this router believes) owns the key's live request —
+        one hop instead of a walk. The ``router.claim`` chaos seam sits
+        on the lookup: a fault here degrades to the UNORDERED walk, and
+        the replica-side registry still guarantees exactly-once (the
+        point exists precisely to prove the fast path is not
+        load-bearing)."""
+        if not idempotency_key:
+            return candidates
+        try:
+            chaos.maybe_fail("router.claim")
+        except ChaosFault:
+            return candidates
+        with self._idem_mu:
+            owner = self._idem_routes.get(str(idempotency_key))
+        if owner and owner in candidates:
+            return [owner] + [c for c in candidates if c != owner]
+        return candidates
+
+    def _record_idem_route(self, idempotency_key: Any,
+                           replica_id: str) -> None:
+        if not idempotency_key:
+            return
+        key = str(idempotency_key)
+        with self._idem_mu:
+            self._idem_routes.pop(key, None)
+            self._idem_routes[key] = replica_id
+            while len(self._idem_routes) > self._IDEM_ROUTES_CAP:
+                # FIFO-ish bound (dict preserves insertion order): the
+                # hint only matters for the key's in-flight window
+                self._idem_routes.pop(next(iter(self._idem_routes)))
+
+    def _attempt_kwargs(self, req: _RouterRequest,
+                        replica_id: str) -> dict[str, Any]:
+        """Per-attempt kwargs: the request's kw with ``fence_epoch``
+        re-stamped from THIS router's membership view of THIS replica.
+        Re-stamping per attempt (not per request) is what makes
+        ``ErrorStaleEpoch`` retriable at the router: the failover
+        attempt carries the refreshed fence, so a router that lagged a
+        warm restart self-heals in one re-route instead of surfacing
+        409 to the client. A replica whose heartbeat never carried an
+        epoch (older replica, pre-beat registration) is not fenced."""
+        kw = dict(req.kw)
+        epoch = self.membership.epoch_of(replica_id)
+        if epoch:
+            kw["fence_epoch"] = epoch
+        return kw
+
+    def _note_last_resort(self, candidates: list[str]) -> None:
+        """A route about to dispatch into a SUSPECT-only pool (no UP
+        candidate anywhere): best-effort routing, loud in metrics — the
+        operator's first signal that the tier is coasting on replicas
+        that stopped heartbeating (satellite of the HA plane; mirrors
+        health_check's DEGRADED)."""
+        if any(
+            self.membership.state_of(rid) == ms.UP for rid in candidates
+        ):
+            return
+        with self._stats_mu:
+            self.last_resort_routes_total += 1
+        if self._metrics is not None:
+            self._metrics.increment_counter(
+                "app_router_last_resort_routes_total"
+            )
+
+    def resume(self, idempotency_key: str, *, last_seq: int = 0,
+               stream_cb: Any = None, deadline: float | None = None) -> Any:
+        """Re-attach to a keyed stream SOMEWHERE in the tier: the
+        idempotency-route hint first, then every other routable replica
+        — each attempt fence-stamped from membership. This is the
+        survivor-router path after an active router dies mid-stream: the
+        generation is still running on its replica; only the router-side
+        subscription died with the router. 404 from a replica means
+        "never saw the key, or its replay window evicted" — keep
+        walking; when NO replica knows the key, the 404 propagates and
+        the client falls back to a keyed submit (which dedups safely).
+        ``stream_cb`` is the 4-arg resumable wire
+        ``(seq, token_id, piece, done)``."""
+        candidates = self.membership.candidates()
+        with self._idem_mu:
+            owner = self._idem_routes.get(str(idempotency_key))
+        if owner and owner in candidates:
+            candidates = [owner] + [c for c in candidates if c != owner]
+        if not candidates:
+            raise ErrorServiceUnavailable(
+                "no routable replica to resume on",
+                retry_after=self.config.heartbeat_s,
+            )
+        last_error: Exception | None = None
+        for replica_id in candidates:
+            with self._handles_mu:
+                handle = self._handles.get(replica_id)
+            if handle is None or not hasattr(handle, "resume"):
+                continue
+            epoch = self.membership.epoch_of(replica_id)
+            try:
+                future = handle.resume(
+                    idempotency_key, last_seq=last_seq,
+                    stream_cb=stream_cb, fence_epoch=epoch or None,
+                    deadline=deadline,
+                )
+            except ErrorEntityNotFound as exc:
+                last_error = exc
+                continue
+            except RETRIABLE_ERRORS as exc:
+                last_error = exc
+                continue
+            self._record_idem_route(idempotency_key, replica_id)
+            return future
+        raise last_error if last_error is not None else ErrorEntityNotFound(
+            "idempotency_key", str(idempotency_key)
+        )
+
     def _prefill_attempt(self, req: _RouterRequest, replica_id: str) -> Any:
         """Admit the prefill phase on one prefill replica. Raises the
         replica's admission error (the caller's candidate walk decides);
@@ -1003,6 +1240,15 @@ class Router:
                 if k in ("temperature", "top_k", "top_p", "priority",
                          "adapter_id", "tenant")
             }
+            # the fence rides the prefill phase too — a prefill stamped
+            # against a warm-restarted replica must not feed a handoff
+            # hint pointing at a cache generation that no longer exists.
+            # The idempotency key deliberately does NOT: the prefill is
+            # an internal phase, keying it would dedup against the real
+            # generation.
+            epoch = self.membership.epoch_of(replica_id)
+            if epoch:
+                kw["fence_epoch"] = epoch
             prefill_fut = handle.submit(
                 req.prompt, deadline=remaining, prefill_only=True,
                 max_new_tokens=1,
@@ -1104,6 +1350,8 @@ class Router:
             # is ALSO the only decode candidate may serve (tried only
             # covers this request's prefill walk, not failures)
             ordered = [c for c in candidates if c not in tried] or candidates
+            if ordered:
+                self._note_last_resort(ordered)
             last_error: Exception = ErrorServiceUnavailable(
                 "no routable decode replica", retry_after=self.config.heartbeat_s,
             )
@@ -1138,7 +1386,7 @@ class Router:
             replica_future = handle.submit(
                 req.prompt, deadline=remaining, stream_cb=cb,
                 trace_ctx=span if span is not None else req.trace_ctx,
-                **req.kw,
+                **self._attempt_kwargs(req, replica_id),
             )
             submitted = True
         finally:
@@ -1154,6 +1402,7 @@ class Router:
             if span is not None:
                 req.spans[replica_id] = span
         self._count_route(replica_id)
+        self._record_idem_route(req.kw.get("idempotency_key"), replica_id)
         if req.canceled:
             # a cancel that landed in the async gap before this attempt
             # registered (the disaggregated decode phase runs off the
@@ -1515,6 +1764,7 @@ class Router:
                 "no_replica_total": self.no_replica_total,
                 "handoffs_total": self.handoffs_total,
                 "handoff_degraded_total": self.handoff_degraded_total,
+                "last_resort_routes_total": self.last_resort_routes_total,
                 "routes_by_replica": dict(self.routes_by_replica),
             }
 

@@ -1,0 +1,667 @@
+"""The HA-plane chaos tier (``make chaos``, docs/robustness.md "The HA
+plane"): exactly-once under control-plane failure.
+
+Fixed-seed scenarios over REAL tiny-llama engines fronted by TWO
+routers sharing one pubsub heartbeat stream (independent consumer
+groups via ``InMemoryBroker.group_view`` — each router observes every
+beat), driving the three acceptance archetypes:
+
+- **router-crash mid-stream**: the active router dies while a keyed
+  generation is streaming; the client re-attaches on the SURVIVOR with
+  its acked ``last_seq`` and receives the unseen suffix
+  token-identically (the generation itself never stopped — only the
+  router-side subscription died);
+- **duplicate keyed submits** (same router, twin routers, and after a
+  crash): every duplicate attaches to the live request or replays its
+  terminal — exactly one admission, ``terminal_marks == 1``;
+- **stale-epoch fencing**: a zombie router acting on a pre-restart
+  membership view is rejected at the engine wire (409) without
+  touching scheduler state.
+
+Chaos points exercised here: ``router.claim`` (the router's
+idempotency fast-path — a fault degrades to the unordered candidate
+walk, never to a wrong answer) and ``stream.resume`` (keyed re-attach
+admission — a fault is retriable and the next attempt lands).
+
+Seeds are FIXED (101/202/303, the chaos-tier convention): a red run
+reproduces with ``pytest tests/test_ha.py -k <seed>``. Add seeds,
+never rotate them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from gofr_tpu import chaos
+from gofr_tpu.chaos.injector import ChaosInjector
+from gofr_tpu.datasource.pubsub import InMemoryBroker
+from gofr_tpu.http.errors import (
+    ErrorEntityNotFound,
+    ErrorStaleEpoch,
+    ErrorTooManyRequests,
+)
+from gofr_tpu.serving.membership import ReplicaAnnouncer
+from gofr_tpu.serving.router import (
+    RETRIABLE_ERRORS,
+    LocalReplica,
+    Router,
+    RouterConfig,
+)
+from gofr_tpu.testutil.replica import StubReplicaEngine
+
+CHAOS_SEEDS = (101, 202, 303)
+HEARTBEAT_S = 0.03
+PROMPT = "resume me exactly once "
+MAX_NEW = 24
+
+
+# -- real-engine HA tier -------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def model():
+    import jax
+
+    from gofr_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _mk_engine(cfg, params):
+    from gofr_tpu.serving import ByteTokenizer, EngineConfig, ServingEngine
+
+    return ServingEngine(
+        cfg, params,
+        EngineConfig(
+            max_slots=6, max_seq_len=128, prefill_buckets=(16,),
+            max_queue=64, prefill_chunk_tokens=16,
+        ),
+        ByteTokenizer(),
+    )
+
+
+class _HATier:
+    """Two real engines + announcers + TWO routers over ONE heartbeat
+    stream. ``router_b`` rides ``broker.group_view``: same topics, its
+    own consumer offsets — the production multi-router shape."""
+
+    def __init__(self, cfg, params, n_replicas: int = 2) -> None:
+        self.broker = InMemoryBroker(consumer_group="router-a")
+        self.engines = [_mk_engine(cfg, params) for _ in range(n_replicas)]
+        rcfg = RouterConfig(
+            heartbeat_s=HEARTBEAT_S,
+            suspect_after_s=6 * HEARTBEAT_S,
+            down_after_s=40 * HEARTBEAT_S,
+            max_failovers=3,
+        )
+        self.router_a = Router(rcfg, broker=self.broker)
+        self.router_b = Router(
+            rcfg, broker=self.broker.group_view("router-b")
+        )
+        self.routers = [self.router_a, self.router_b]
+        self.announcers = []
+        for i, eng in enumerate(self.engines):
+            rid = f"rep-{i}"
+            for router in self.routers:
+                router.add_replica(LocalReplica(rid, eng))
+            self.announcers.append(
+                ReplicaAnnouncer(rid, eng, self.broker,
+                                 interval_s=HEARTBEAT_S)
+            )
+
+    def start(self) -> None:
+        for eng in self.engines:
+            eng.start()
+        for router in self.routers:
+            router.start()
+        for announcer in self.announcers:
+            announcer.start()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if all(
+                len(r.membership.candidates()) == len(self.engines)
+                for r in self.routers
+            ):
+                return
+            time.sleep(0.005)
+        raise AssertionError("HA tier never became fully routable")
+
+    def stop(self) -> None:
+        for announcer in self.announcers:
+            announcer.stop(final_beat=False)
+        for router in self.routers:
+            router.stop()
+        for eng in self.engines:
+            eng.stop()
+
+    def owner_engine(self, request_id: int):
+        """The one engine whose flight recorder holds this request."""
+        owners = [
+            eng for eng in self.engines
+            if eng.timeline.get(request_id) is not None
+        ]
+        assert len(owners) == 1, (
+            f"request {request_id} owned by {len(owners)} engines"
+        )
+        return owners[0]
+
+    def admitted(self) -> int:
+        return sum(
+            e.health_check()["details"]["total_admitted"]
+            for e in self.engines
+        )
+
+
+def _resume_with_retry(router, key, *, last_seq, stream_cb, attempts=20):
+    """The documented client loop: a faulted/404 resume is retried — the
+    key IS held by some replica, so a bounded walk converges once the
+    chaos budget is spent."""
+    last: Exception | None = None
+    for _ in range(attempts):
+        try:
+            return router.resume(key, last_seq=last_seq,
+                                 stream_cb=stream_cb)
+        except (ErrorEntityNotFound, *RETRIABLE_ERRORS) as exc:
+            last = exc
+            time.sleep(0.05)
+    raise AssertionError(f"resume never converged: {last!r}")
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_router_crash_mid_stream_resume_token_identical(seed, model):
+    """THE HA acceptance: kill the active router mid-stream; the client
+    re-attaches by key + ``last_seq`` on the survivor and the replayed +
+    live suffix is token-identical to an uninterrupted control run, with
+    dense sequence numbers and exactly one terminal."""
+    cfg, params = model
+    tier = _HATier(cfg, params)
+    tier.start()
+    try:
+        control = tier.router_a.submit(
+            PROMPT, max_new_tokens=MAX_NEW, temperature=0.0,
+        ).result(timeout=300)
+        assert len(control.token_ids) == MAX_NEW
+
+        key = f"ha-crash-{seed}"
+        frames: list[tuple[int, str, bool]] = []
+        saw_enough = threading.Event()
+
+        def client_cb(token_id: int, piece: str, done: bool) -> None:
+            if not done:
+                frames.append((token_id, piece, done))
+                if len(frames) >= 4:
+                    saw_enough.set()
+
+        with chaos.active(ChaosInjector(
+            seed, {"router.claim": 0.5, "stream.resume": 0.5},
+            max_faults=3,
+        )):
+            fut = tier.router_a.submit(
+                PROMPT, max_new_tokens=MAX_NEW, temperature=0.0,
+                idempotency_key=key, stream_cb=client_cb,
+            )
+            assert saw_enough.wait(timeout=300), "stream never started"
+            acked = 4  # what the client had acked when the router died
+            tier.router_a.stop()  # the active router crashes
+
+            resumed: list[tuple[int, int, str, bool]] = []
+            fut2 = _resume_with_retry(
+                tier.router_b, key, last_seq=acked,
+                stream_cb=lambda s, t, p, d: resumed.append((s, t, p, d)),
+            )
+            result = fut2.result(timeout=300)
+
+        # the generation itself never re-ran: same tokens as the control
+        assert result.token_ids == control.token_ids
+        # the resumed wire replays exactly the unseen suffix, densely
+        # sequence-numbered, terminal last
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and (
+            not resumed or not resumed[-1][3]
+        ):
+            time.sleep(0.01)  # live frames drain through the ring
+        assert resumed and resumed[-1][3] is True
+        seqs = [f[0] for f in resumed]
+        assert seqs == list(range(acked + 1, acked + 1 + len(resumed)))
+        suffix_ids = [f[1] for f in resumed if not f[3]]
+        assert suffix_ids == control.token_ids[acked:]
+        # exactly one terminal on exactly one engine
+        owner = tier.owner_engine(result.request_id)
+        tl = owner.timeline.get(result.request_id)
+        assert tl is not None and tl.terminal_marks == 1
+        # the original future (the dead router's claim) is the SAME
+        # settlement — no parallel generation was spawned
+        assert fut.result(timeout=5).token_ids == control.token_ids
+    finally:
+        tier.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_duplicate_keyed_submits_exactly_one_terminal(seed, model):
+    """Split-brain: TWO routers each serve a submit carrying the same
+    idempotency key, concurrently — prefix affinity lands both on the
+    same replica, whose registry (the authority) admits exactly once.
+    After the active router crashes, a re-submit of the same key on the
+    survivor replays the stored terminal without re-admitting. The
+    ``router.claim`` chaos point fires through both submits: a faulted
+    fast path degrades to the cold walk, never to a second admission."""
+    cfg, params = model
+    tier = _HATier(cfg, params)
+    tier.start()
+    try:
+        key = f"ha-dup-{seed}"
+        admitted_before = tier.admitted()
+        results: dict[str, object] = {}
+        errors: list[BaseException] = []
+
+        def submit_on(name: str, router) -> None:
+            try:
+                results[name] = router.submit(
+                    PROMPT, max_new_tokens=MAX_NEW, temperature=0.0,
+                    idempotency_key=key,
+                ).result(timeout=300)
+            except BaseException as exc:  # noqa: BLE001 - surfaced below
+                errors.append(exc)
+
+        with chaos.active(ChaosInjector(
+            seed, {"router.claim": 0.5}, max_faults=4,
+        )):
+            threads = [
+                threading.Thread(target=submit_on, args=(name, router))
+                for name, router in (("a", tier.router_a),
+                                     ("b", tier.router_b))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+        assert not errors, errors
+        assert results["a"].token_ids == results["b"].token_ids
+        # the split-brain proof: one admission across the WHOLE tier
+        assert tier.admitted() - admitted_before == 1
+        owner = tier.owner_engine(results["a"].request_id)
+        tl = owner.timeline.get(results["a"].request_id)
+        assert tl is not None and tl.terminal_marks == 1
+        stats = owner.dedup_stats()
+        assert stats["hits_live"] + stats["hits_terminal"] >= 1
+
+        # the active router crashes; a duplicate on the survivor replays
+        # the terminal — still zero new admissions
+        tier.router_a.stop()
+        replayed = tier.router_b.submit(
+            PROMPT, max_new_tokens=MAX_NEW, temperature=0.0,
+            idempotency_key=key,
+        ).result(timeout=60)
+        assert replayed.token_ids == results["a"].token_ids
+        assert tier.admitted() - admitted_before == 1
+        assert tl.terminal_marks == 1
+    finally:
+        tier.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_stale_epoch_rejected_at_engine_wire(model):
+    """A zombie router acting on a pre-restart membership view is fenced
+    at the engine wire: 409, scheduler state untouched — no admission,
+    no request id burned, no dedup entry created. The router-level
+    contract is the complement: ``ErrorStaleEpoch`` IS retriable there,
+    because the router re-stamps the fence from fresh membership on
+    every attempt."""
+    cfg, params = model
+    eng = _mk_engine(cfg, params)
+    eng.start()
+    try:
+        assert eng.epoch == 1
+        pre_epoch = eng.epoch
+        # sanity: a correctly-fenced submit is admitted
+        eng.submit(PROMPT, max_new_tokens=4, temperature=0.0,
+                   fence_epoch=pre_epoch).result(timeout=300)
+        assert eng.warm_restart(join_timeout=30.0)
+        assert eng.epoch == pre_epoch + 1
+
+        before = eng.health_check()["details"]["total_admitted"]
+        with pytest.raises(ErrorStaleEpoch) as ei:
+            eng.submit(PROMPT, max_new_tokens=4, temperature=0.0,
+                       fence_epoch=pre_epoch,
+                       idempotency_key="zombie-claim")
+        assert "refresh membership" in str(ei.value)
+        assert ei.value.status_code == 409
+        # fenced BEFORE any gate: nothing admitted, no dedup entry
+        assert eng.health_check()["details"]["total_admitted"] == before
+        stats = eng.dedup_stats()
+        assert stats["live"] == 0 and stats["terminal"] == 0
+        # the resume wire is fenced identically
+        with pytest.raises(ErrorStaleEpoch):
+            eng.resume("zombie-claim", last_seq=0, fence_epoch=pre_epoch)
+        # router contract: the fence rejection fails over, not fails
+        assert issubclass(ErrorStaleEpoch, RETRIABLE_ERRORS)
+    finally:
+        eng.stop()
+
+
+# -- satellite coverage: shed Retry-After, last-resort routes, final beat ------
+
+
+class _SheddingStub(StubReplicaEngine):
+    """A replica whose admission control is saturated: 429 + Retry-After
+    until the test flips ``shedding`` off."""
+
+    def __init__(self, *args, retry_after_s: float = 0.15, **kw) -> None:
+        super().__init__(*args, **kw)
+        self.shedding = True
+        self.retry_after_s = retry_after_s
+        self.sheds = 0
+
+    def submit(self, prompt, **kw):
+        if self.shedding:
+            self.sheds += 1
+            raise ErrorTooManyRequests(
+                "batch queue saturated; back off",
+                retry_after=self.retry_after_s,
+            )
+        return super().submit(prompt, **kw)
+
+
+class _StubTier:
+    """One router over stub replicas with real announcer heartbeats."""
+
+    def __init__(self, stubs, *, down_after_beats: int = 50) -> None:
+        self.broker = InMemoryBroker(consumer_group="router")
+        self.stubs = stubs
+        self.announcers = [
+            ReplicaAnnouncer(s.replica_id, s, self.broker,
+                             interval_s=HEARTBEAT_S)
+            for s in stubs
+        ]
+        self.router = Router(
+            RouterConfig(
+                heartbeat_s=HEARTBEAT_S,
+                suspect_after_s=6 * HEARTBEAT_S,
+                down_after_s=down_after_beats * HEARTBEAT_S,
+                max_failovers=3,
+            ),
+            broker=self.broker,
+        )
+        for stub in stubs:
+            self.router.add_replica(LocalReplica(stub.replica_id, stub))
+
+    def start(self) -> None:
+        self.router.start()
+        for announcer in self.announcers:
+            announcer.start()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if len(self.router.membership.candidates()) == len(self.stubs):
+                return
+            time.sleep(0.005)
+        raise AssertionError("stub tier never became routable")
+
+    def stop(self) -> None:
+        for announcer in self.announcers:
+            announcer.stop(final_beat=False)
+        self.router.stop()
+
+
+def test_full_tier_shed_retry_honors_retry_after():
+    """Every replica sheds (429 + Retry-After): the router's candidate
+    walk surfaces the typed 429 with its backoff hint intact, and a
+    client that honors the hint lands its retry cleanly."""
+    stubs = [_SheddingStub(f"shed-{i}", tokens=3) for i in range(2)]
+    tier = _StubTier(stubs)
+    tier.start()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(ErrorTooManyRequests) as ei:
+            tier.router.submit("hello shed tier")
+        exc = ei.value
+        assert isinstance(exc, RETRIABLE_ERRORS)
+        assert exc.retry_after and exc.retry_after > 0
+        # the walk tried the WHOLE tier before surfacing the shed
+        assert sum(s.sheds for s in stubs) >= 2
+        # the honoring client: wait out the hint, then retry
+        for stub in stubs:
+            stub.shedding = False
+        time.sleep(exc.retry_after)
+        result = tier.router.submit("hello shed tier").result(timeout=10)
+        assert result.finish_reason == "length"
+        assert time.monotonic() - t0 >= exc.retry_after
+    finally:
+        tier.stop()
+
+
+def test_last_resort_routes_counted_on_suspect_only_tier():
+    """When no replica anywhere is UP, the router still routes (SUSPECT
+    is last resort) but counts it: ``last_resort_routes_total`` is the
+    coasting-tier signal docs/robustness.md promises operators."""
+    stubs = [StubReplicaEngine(f"lr-{i}", tokens=2) for i in range(2)]
+    tier = _StubTier(stubs, down_after_beats=120)
+    tier.start()
+    try:
+        assert tier.router.last_resort_routes_total == 0
+        for announcer in tier.announcers:
+            announcer.stop(final_beat=False)  # beats go silent
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            states = [
+                v["state"]
+                for v in tier.router.membership.snapshot().values()
+            ]
+            if states and all(s == "SUSPECT" for s in states):
+                break
+            time.sleep(0.01)
+        result = tier.router.submit("last resort").result(timeout=10)
+        assert result.finish_reason == "length"
+        assert tier.router.last_resort_routes_total >= 1
+        assert (
+            tier.router._counters()["last_resort_routes_total"]
+            == tier.router.last_resort_routes_total
+        )
+    finally:
+        tier.stop()
+
+
+class _FlakyPublisher:
+    """Publish wrapper that fails the first ``fail_n`` calls."""
+
+    def __init__(self, inner, fail_n: int) -> None:
+        self._inner = inner
+        self.fail_n = fail_n
+        self.calls = 0
+
+    def publish(self, topic: str, value) -> None:
+        self.calls += 1
+        if self.calls <= self.fail_n:
+            raise ConnectionError("broker hiccup")
+        self._inner.publish(topic, value)
+
+
+def test_final_beat_retried_once_then_counted_dropped():
+    """The terminal heartbeat gets ONE bounded jittered retry (it has no
+    successor to paper over a drop); lost twice, it is counted in
+    ``dropped_final_beats`` and the router falls back to its suspect
+    timer."""
+    broker = InMemoryBroker(consumer_group="router")
+    stub = StubReplicaEngine("fb-1", tokens=2)
+    ann = ReplicaAnnouncer("fb-1", stub, broker, interval_s=0.02)
+    ann.start()
+    time.sleep(0.05)
+    flaky = _FlakyPublisher(broker, fail_n=1)
+    ann.publisher = flaky
+    before = flaky.calls
+    ann.stop(final_beat=True)  # first final beat drops, the retry lands
+    assert flaky.calls - before == 2
+    assert ann.dropped_final_beats == 0
+
+    stub2 = StubReplicaEngine("fb-2", tokens=2)
+    ann2 = ReplicaAnnouncer("fb-2", stub2, broker, interval_s=0.02)
+    ann2.start()
+    time.sleep(0.05)
+    ann2.publisher = _FlakyPublisher(broker, fail_n=10_000)
+    ann2.stop(final_beat=True)
+    assert ann2.dropped_final_beats == 1
+
+
+# -- remote wire: cancel-early × seq frames, Last-Event-ID over HTTP -----------
+
+
+@pytest.fixture(scope="module")
+def http_replica(model):
+    """One real engine behind a real HTTP app + an HTTPReplica handle,
+    warmed so jit compiles don't masquerade as stream latency."""
+    import urllib.request
+
+    import gofr_tpu
+    from gofr_tpu.config import MapConfig
+    from gofr_tpu.serving.handlers import register_generation_routes
+    from gofr_tpu.serving.router import HTTPReplica
+    from gofr_tpu.testutil import new_server_configs
+
+    cfg, params = model
+    eng = _mk_engine(cfg, params)
+    ports = new_server_configs(set_env=False)
+    config = MapConfig(
+        {"HTTP_PORT": str(ports.http_port),
+         "GRPC_PORT": str(ports.grpc_port),
+         "METRICS_PORT": str(ports.metrics_port),
+         "APP_NAME": "ha-wire", "LOG_LEVEL": "ERROR"},
+        use_env=False,
+    )
+    app = gofr_tpu.App(config)
+    register_generation_routes(app, eng)
+    thread = threading.Thread(target=app.run, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{ports.http_port}"
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        try:
+            urllib.request.urlopen(base + "/.well-known/alive", timeout=1)
+            break
+        except OSError:
+            time.sleep(0.05)
+    replica = HTTPReplica("A", base)
+    replica.submit("warm here now", max_new_tokens=8,
+                   temperature=0.0).result(timeout=300)
+    yield replica, eng
+    replica.close()
+    app.stop()
+    eng.stop()
+    thread.join(timeout=15)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_cancel_early_parks_and_fires_on_seq_framed_stream(http_replica):
+    """A cancel racing the stream's id frame parks in ``_cancel_early``
+    and fires the moment the frame lands — unchanged now that every
+    frame carries an ``id:`` sequence line: the wire's frame parsing
+    (id/token/done) and the cancel parking must compose."""
+    replica, eng = http_replica
+    got: list = []
+    fut = replica.submit(
+        "cancel target xy", max_new_tokens=200, temperature=0.0,
+        stream_cb=lambda t, p, d: got.append((t, d)),
+    )
+    replica.cancel(fut.request_id)  # before the id frame can have landed
+    result = fut.result(timeout=300)
+    # the engine retired the row at a block sync instead of running the
+    # full 200 tokens; the terminal frame still closed the stream
+    assert result.completion_tokens < 200
+    assert got and got[-1][1] is True
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_last_event_id_reattach_over_http_wire(http_replica):
+    """The full resumable wire, over real HTTP: a keyed streamed
+    generation, then a ``Last-Event-ID`` re-attach replaying the unseen
+    suffix with dense ``id:`` sequence numbers, token-identical to what
+    the first connection observed."""
+    replica, eng = http_replica
+    key = "ha-wire-resume"
+    first: list[tuple[int, str, bool]] = []
+    fut = replica.submit(
+        "stream over the wire", max_new_tokens=12, temperature=0.0,
+        idempotency_key=key,
+        stream_cb=lambda t, p, d: first.append((t, p, d)),
+    )
+    result = fut.result(timeout=300)
+    assert len(result.token_ids) == 12
+
+    acked = 5  # the client acked 5 frames before its connection died
+    resumed: list[tuple[int, int, str, bool]] = []
+    fut2 = replica.resume(
+        key, last_seq=acked,
+        stream_cb=lambda s, t, p, d: resumed.append((s, t, p, d)),
+    )
+    fut2.result(timeout=60)
+    assert resumed and resumed[-1][3] is True
+    seqs = [f[0] for f in resumed]
+    assert seqs == list(range(acked + 1, acked + 1 + len(resumed)))
+    assert [f[1] for f in resumed if not f[3]] == result.token_ids[acked:]
+    # exactly one terminal on the engine despite two wire attachments
+    # (result.request_id is the ROUTER-side id; the engine's own id for
+    # this key lives in its dedup registry)
+    engine_rid = eng._dedup.lookup(key).rid
+    tl = eng.timeline.get(engine_rid)
+    assert tl is not None and tl.terminal_marks == 1
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_fence_epoch_header_honored_on_submit_wire(http_replica):
+    """``X-Fence-Epoch`` fences plain ``/generate`` submits, not just
+    the resume path: a gateway stamping the fence outranks the body
+    (the tenancy contract), a stale header is a 409 before any
+    admission, and the current epoch passes."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    replica, eng = http_replica
+    base = replica.address
+
+    def post(body: dict, headers: dict) -> tuple[int, dict]:
+        req = urllib.request.Request(
+            base + "/generate", method="POST",
+            data=_json.dumps(body).encode(),
+            headers={"Content-Type": "application/json", **headers},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                return resp.status, _json.loads(resp.read())
+        except urllib.error.HTTPError as exc:
+            return exc.code, _json.loads(exc.read())
+
+    admitted = eng.health_check()["details"]["total_admitted"]
+    stale = eng.epoch + 7
+    status, payload = post(
+        {"prompt": "fence me", "max_tokens": 4, "temperature": 0.0},
+        {"X-Fence-Epoch": str(stale)},
+    )
+    assert status == 409, payload
+    assert "epoch" in payload["error"]["message"]
+    # the header outranks a current-epoch body claim — the gateway wins
+    status, payload = post(
+        {"prompt": "fence me", "max_tokens": 4, "temperature": 0.0,
+         "fence_epoch": eng.epoch},
+        {"X-Fence-Epoch": str(stale)},
+    )
+    assert status == 409, payload
+    # rejected before any scheduler state: nothing was admitted
+    assert eng.health_check()["details"]["total_admitted"] == admitted
+    status, payload = post(
+        {"prompt": "fence me", "max_tokens": 4, "temperature": 0.0},
+        {"X-Fence-Epoch": str(eng.epoch)},
+    )
+    assert status == 201, payload
+    assert payload["data"]["finish_reason"] == "length"
