@@ -15,7 +15,12 @@ what lets two routers share one replica fleet without double-serving:
     whose ``last_seq`` fell behind the window gets ``ReplayGap`` — a
     token-identical resume is impossible and the engine reports a typed
     error instead of silently re-generating (the PR 7 rule: a request
-    that streamed tokens is NEVER re-run).
+    that streamed tokens is NEVER re-run). A submit-path duplicate that
+    hits the gap instead ``subscribe()``s live (truncated stream, full
+    result via the shared future) — a keyed retry must dedup safely,
+    never hard-error. ``subscribers`` counts every live attachment
+    (owner + duplicates + resumes); the orphan-grace reaper cancels
+    only when it reaches zero.
 
 ``DedupRegistry``
     A bounded, thread-safe ``Idempotency-Key -> entry`` map. Live
@@ -70,6 +75,22 @@ class ReplayStream:
         self._done_seq: int | None = None
         self._subs: list[FrameSub] = []
         self.attaches = 0  # re-attach generation counter (orphan-grace reaper reads it)
+        # live attached connections: the owner plus every duplicate/resume
+        # attachment. Incremented on wrap/attach/subscribe, decremented by
+        # ``release()`` when a transport reports its client gone — the
+        # orphan-grace reaper must not cancel while ANY of them remains
+        # (one client's disconnect must never kill another client's
+        # in-flight generation). Drift can only be upward (an attachment
+        # that completes normally never releases), which errs toward
+        # not canceling.
+        self.subscribers = 0
+        # the authoritative record of what the stream actually emitted:
+        # every token frame's piece, in seq order (seq i+1 -> pieces[i]).
+        # Bounded by the request's own max_new_tokens — the same order of
+        # memory as the final text the terminal LRU already retains — so
+        # a terminal replay is TEXT-identical to the original stream, not
+        # merely token-identical.
+        self.pieces: list[str] = []
 
     def wrap(self, cb: Callable[[int, str, bool], None] | None) -> Callable[[int, str, bool], None]:
         """Return a 3-arg ``stream_cb`` that stamps, stores, and fans out.
@@ -77,8 +98,12 @@ class ReplayStream:
         Installed as the request's ``stream_cb`` so ALL engine emission
         paths (detok token frames and every done-frame settlement path)
         flow through the ring; the original client callback, when given,
-        still sees the plain ``(token_id, piece, done)`` wire.
+        still sees the plain ``(token_id, piece, done)`` wire. The owner
+        counts as one live subscriber from here (streaming or awaiting
+        the future) until its transport ``release()``s it.
         """
+        with self._mu:
+            self.subscribers += 1
 
         def fanout(token_id: int, piece: str, done: bool) -> None:
             with self._mu:
@@ -91,6 +116,7 @@ class ReplayStream:
                 else:
                     seq = self._next_seq
                     self._frames.append((seq, token_id, piece))
+                    self.pieces.append(piece)
                 self._next_seq += 1
                 subs = list(self._subs)
             for sub in subs:
@@ -125,6 +151,7 @@ class ReplayStream:
                     f"frames {last_seq + 1}..{oldest - 1} were evicted from the replay window"
                 )
             self.attaches += 1
+            self.subscribers += 1
             for seq, token_id, piece in self._frames:
                 if seq > last_seq:
                     sub(seq, token_id, piece, False)
@@ -133,6 +160,37 @@ class ReplayStream:
                     sub(self._done_seq, -1, "", True)
             else:
                 self._subs.append(sub)
+
+    def subscribe(self, sub: FrameSub) -> int:
+        """Attach live with NO replay: the subscriber accepts a truncated
+        stream starting at the next emitted frame.
+
+        The submit-path fallback for a duplicate whose suffix fell out of
+        the bounded window (a token-identical replay is impossible, but
+        the keyed-submit contract is "dedup safely", never a hard error):
+        the caller's future still resolves with the FULL result; only the
+        stream is truncated. Returns the seq BEFORE the first frame the
+        subscriber will receive, so transports can stamp true ``id:``
+        lines. A finished stream delivers just its terminal frame.
+        """
+        with self._mu:
+            self.attaches += 1
+            self.subscribers += 1
+            if self._done:
+                done_seq = self._done_seq if self._done_seq is not None else self._next_seq
+                sub(done_seq, -1, "", True)
+                return done_seq - 1
+            self._subs.append(sub)
+            return self._next_seq - 1
+
+    def release(self) -> int:
+        """One attached transport's client is gone (disconnect → orphan):
+        drop its live-subscriber count. Returns the remaining count the
+        orphan-grace reaper gates on. Floored at zero — an unbalanced
+        release must not go negative and steal another client's slot."""
+        with self._mu:
+            self.subscribers = max(0, self.subscribers - 1)
+            return self.subscribers
 
     def detach(self, sub: FrameSub) -> None:
         with self._mu:

@@ -1622,62 +1622,63 @@ class ServingEngine:
             if not owner:
                 return self._attach_duplicate(claim_entry, stream_cb)
 
-        future: Any = concurrent.futures.Future()
-        future.request_id = rid
-        req = _Request(
-            rid, prompt_ids, max_new, temperature, top_k, top_p, stream_cb, future,
-            stop_ids={self.tokenizer.eos_id}, deadline=deadline,
-        )
-        req.priority = priority
-        if claim_entry is not None:
-            # every emission path (detok token frames, all done-frame
-            # settlement paths) flows through the bounded seq-numbered
-            # ring so a resume can replay the acked-but-unseen suffix;
-            # the original stream_cb still sees the plain 3-arg wire
-            req.idem_key = idem_key
-            req.replay = ReplayStream(self.config.stream_replay_tokens)
-            req.stream_cb = req.replay.wrap(stream_cb)
-            claim_entry.publish(rid, future, req.replay)
-        req.prefill_only = bool(prefill_only)
-        req.handoff_from = handoff_from
-        req.tenant = tenant
-        req.adapter_id = adapter_id or None
-        # flight-recorder timeline + the queue span, BEFORE any admission
-        # gate that can still reject: a shed/stopped request leaves a
-        # terminal timeline too (the chaos tier audits exactly-one-
-        # terminal over every accepted request id)
-        tl = self.timeline.begin(rid, prompt_tokens=len(prompt_ids))
-        tl.tenant = tenant
-        req.timeline = tl
-        req.trace_ctx = trace_ctx
-        if self._tracer is not None:
-            qspan = self._tracer.start_span(
-                "engine.queue", parent=trace_ctx, kind="internal",
-                activate=False,
-            )
-            qspan.set_attribute("request.id", rid)
-            qspan.set_attribute("tokens.prompt", len(prompt_ids))
-            if tenant:
-                qspan.set_attribute("tenant", tenant)
-            if adapter_id:
-                qspan.set_attribute("lora.adapter", adapter_id)
-            tl.open_span("queue", qspan)
-        elif trace_ctx is not None:
-            tl.trace_id = trace_ctx.trace_id
-        # registration + enqueue are ATOMIC w.r.t. warm_restart (same
-        # mutex): either the restart's sweep sees this request and
-        # requeues/settles it, or this section observes _restarting and
-        # fails retriable BEFORE touching the scheduler the restart is
-        # about to replace. Without the mutex a submit could register
-        # after the sweep yet enqueue into the old (about-to-be-leaked)
-        # scheduler — stranding a deadline-less future forever — or
-        # enqueue the same rid into the rebuilt scheduler a second time.
-        # _restarting cannot flip while this section holds the mutex:
-        # warm_restart flips it under the same lock.
-        # bounded acquire: if another submit is wedged INSIDE a hung
-        # scheduler call while holding the mutex, fail fast and retriable
-        # instead of piling every client thread up behind it forever
+        req: _Request | None = None
         try:
+            future: Any = concurrent.futures.Future()
+            future.request_id = rid
+            req = _Request(
+                rid, prompt_ids, max_new, temperature, top_k, top_p, stream_cb, future,
+                stop_ids={self.tokenizer.eos_id}, deadline=deadline,
+            )
+            req.priority = priority
+            if claim_entry is not None:
+                # every emission path (detok token frames, all done-frame
+                # settlement paths) flows through the bounded seq-numbered
+                # ring so a resume can replay the acked-but-unseen suffix;
+                # the original stream_cb still sees the plain 3-arg wire
+                req.idem_key = idem_key
+                req.replay = ReplayStream(self.config.stream_replay_tokens)
+                req.stream_cb = req.replay.wrap(stream_cb)
+                claim_entry.publish(rid, future, req.replay)
+            req.prefill_only = bool(prefill_only)
+            req.handoff_from = handoff_from
+            req.tenant = tenant
+            req.adapter_id = adapter_id or None
+            # flight-recorder timeline + the queue span, BEFORE any admission
+            # gate that can still reject: a shed/stopped request leaves a
+            # terminal timeline too (the chaos tier audits exactly-one-
+            # terminal over every accepted request id)
+            tl = self.timeline.begin(rid, prompt_tokens=len(prompt_ids))
+            tl.tenant = tenant
+            req.timeline = tl
+            req.trace_ctx = trace_ctx
+            if self._tracer is not None:
+                qspan = self._tracer.start_span(
+                    "engine.queue", parent=trace_ctx, kind="internal",
+                    activate=False,
+                )
+                qspan.set_attribute("request.id", rid)
+                qspan.set_attribute("tokens.prompt", len(prompt_ids))
+                if tenant:
+                    qspan.set_attribute("tenant", tenant)
+                if adapter_id:
+                    qspan.set_attribute("lora.adapter", adapter_id)
+                tl.open_span("queue", qspan)
+            elif trace_ctx is not None:
+                tl.trace_id = trace_ctx.trace_id
+            # registration + enqueue are ATOMIC w.r.t. warm_restart (same
+            # mutex): either the restart's sweep sees this request and
+            # requeues/settles it, or this section observes _restarting and
+            # fails retriable BEFORE touching the scheduler the restart is
+            # about to replace. Without the mutex a submit could register
+            # after the sweep yet enqueue into the old (about-to-be-leaked)
+            # scheduler — stranding a deadline-less future forever — or
+            # enqueue the same rid into the rebuilt scheduler a second time.
+            # _restarting cannot flip while this section holds the mutex:
+            # warm_restart flips it under the same lock.
+            # bounded acquire: if another submit is wedged INSIDE a hung
+            # scheduler call while holding the mutex, fail fast and retriable
+            # instead of piling every client thread up behind it forever
             # gofrlint: disable=deadline-dropped -- deliberate constant: bounds a wedged-scheduler pile-up with a fast retriable 503; the request's own deadline is enforced by expired-while-queued
             if not self._submit_mu.acquire(timeout=5.0):
                 raise ErrorServiceUnavailable(
@@ -1731,9 +1732,20 @@ class ServingEngine:
             # the caller gets the raise, but the accepted request id still
             # owes a terminal timeline — settle the (discarded) future
             # through the same gate every other path uses. _try_resolve is
-            # exactly-once, so a stop/restart sweep that already settled
-            # this registration cannot double-mark the terminal.
-            self._try_resolve(req, exc=exc)
+            # exactly-once (a stop/restart sweep that already settled this
+            # registration cannot double-mark the terminal) AND the one
+            # place a keyed failure forgets its dedup entry — the try
+            # opens right at the claim-to-publish window, so a failure
+            # ANYWHERE after the claim (request construction, timeline
+            # begin, tracer spans, the scheduler section) cannot strand a
+            # live entry with a never-resolving future that every later
+            # duplicate of this key would attach to and hang on.
+            if req is not None:
+                self._try_resolve(req, exc=exc)
+            if claim_entry is not None and (req is None or req.idem_key is None):
+                # failed before the key was wired onto the request:
+                # forget directly so the next submit re-runs fresh
+                self._dedup.forget(idem_key)
             raise
         self._observe_queue(depth + 1)  # this request just joined the queue
         self._wake.set()
@@ -1811,8 +1823,14 @@ class ServingEngine:
         Live entry → the ORIGINAL future (exactly one terminal, one
         ``_try_resolve`` win) with the unseen frame suffix replayed into
         ``stream_cb``; terminal entry → a resolved future replaying the
-        stored result. The claim-to-publish window is closed by waiting
-        on ``entry.ready``."""
+        stored result. A live generation whose suffix fell out of the
+        bounded replay window attaches WITHOUT replay — truncated stream,
+        full result via the future — because the keyed-submit contract is
+        "a retry dedups safely", never a hard error; the 404 on an
+        evicted window belongs to the explicit ``Last-Event-ID`` resume
+        wire only (``resume``), where the client asked for a
+        token-identical suffix by name. The claim-to-publish window is
+        closed by waiting on ``entry.ready``."""
         import concurrent.futures
 
         # bounds only the owner's claim-to-publish window (microseconds
@@ -1830,34 +1848,75 @@ class ServingEngine:
             fut.request_id = entry.rid
             if stream_cb is not None:
                 self._replay_result(
-                    entry.result, last_seq,
+                    entry, last_seq,
                     lambda _seq, tid, piece, done: stream_cb(tid, piece, done),
                 )
             fut.set_result(entry.result)
             return fut
         if stream_cb is not None and entry.replay is not None:
+
+            def wire(_seq: int, tid: int, piece: str, done: bool) -> None:
+                stream_cb(tid, piece, done)
+
             try:
-                entry.replay.attach(
-                    last_seq,
-                    lambda _seq, tid, piece, done: stream_cb(tid, piece, done),
-                )
+                entry.replay.attach(last_seq, wire)
             except ReplayGap:
-                raise ErrorEntityNotFound("replay window", entry.key) from None
+                # truncated live attach: frames from NOW on flow to this
+                # client, and the shared future still resolves with the
+                # FULL result. The mirror future carries the attach point
+                # (``stream_base_seq``) so the SSE transport can stamp
+                # TRUE engine sequence numbers on the truncated stream —
+                # a later Last-Event-ID from this client then names real
+                # frames, preserving exactly-once wire delivery. A fresh
+                # mirror (not the shared owner future) keeps the
+                # attribute per-attachment: concurrent gap-attaches at
+                # different ring positions must not clobber each other.
+                base = entry.replay.subscribe(wire)
+                owner_future = entry.future
+                fut = concurrent.futures.Future()
+                fut.request_id = entry.rid
+                fut.stream_base_seq = base
+
+                def _mirror(src: Any) -> None:
+                    try:
+                        src_exc = src.exception()
+                        if src_exc is not None:
+                            fut.set_exception(src_exc)
+                        else:
+                            fut.set_result(src.result())
+                    except Exception:
+                        pass  # mirror already settled / owner canceled
+
+                owner_future.add_done_callback(_mirror)
+                return fut
         return entry.future
 
-    def _replay_result(self, result: Any, last_seq: int,
+    def _replay_result(self, entry: DedupEntry, last_seq: int,
                        cb: Callable[[int, int, str, bool], None]) -> None:
         """Replay a stored terminal's token frames past ``last_seq``.
 
         Ring seq i+1 is provably token_ids[i]: the ring is fed by the
         single detok worker in emission order, stop tokens are never
-        emitted as frames, and the terminal frame takes seq N+1 — so the
-        canonical token list reproduces the exact wire."""
+        emitted as frames, and the terminal frame takes seq N+1. Pieces
+        come from the entry's ``ReplayStream``, which retained every
+        emitted piece — the replay is TEXT-identical to the original
+        stream, not merely token-identical (a per-token re-decode can
+        differ from incremental detok on multi-token unicode/byte
+        sequences). The re-decode survives only as a defensive fallback
+        for entries with no retained pieces (injected doubles)."""
+        result = entry.result
         token_ids = list(result.token_ids)
+        pieces: list[str] | None = None
+        if entry.replay is not None and len(entry.replay.pieces) == len(token_ids):
+            pieces = list(entry.replay.pieces)
         for i, tid in enumerate(token_ids):
             seq = i + 1
             if seq > last_seq:
-                cb(seq, tid, self.tokenizer.decode([tid]), False)
+                piece = (
+                    pieces[i] if pieces is not None
+                    else self.tokenizer.decode([tid])
+                )
+                cb(seq, tid, piece, False)
         done_seq = len(token_ids) + 1
         if done_seq > last_seq:
             cb(done_seq, -1, "", True)
@@ -1894,7 +1953,7 @@ class ServingEngine:
 
         if entry.terminal:
             if stream_cb is not None:
-                self._replay_result(entry.result, int(last_seq), stream_cb)
+                self._replay_result(entry, int(last_seq), stream_cb)
             fut: Any = concurrent.futures.Future()
             fut.request_id = entry.rid
             fut.set_result(entry.result)
@@ -1907,20 +1966,31 @@ class ServingEngine:
         return entry.future
 
     def orphan(self, request_id: int, grace_s: float | None = None) -> None:
-        """A resumable (keyed) client vanished mid-stream: park the
-        generation for a bounded grace window instead of canceling.
+        """ONE resumable (keyed) client vanished mid-stream: release its
+        subscription and, if it was the last one, park the generation for
+        a bounded grace window instead of canceling.
 
-        A resume within the window re-attaches and rides on; if nobody
-        re-attaches (and no new attach superseded this orphaning), the
-        timer cancels the request exactly like an unkeyed disconnect.
-        Unkeyed requests don't come here — their transports cancel
-        directly."""
+        A keyed request can have several live attachments at once — the
+        owner's stream plus duplicate/resume attachments through any
+        router — and one client's disconnect must never kill another
+        client's in-flight generation: the reaper stands down while ANY
+        subscriber remains attached. A resume within the window
+        re-attaches and rides on; if nobody is attached when the timer
+        fires (and no newer attach superseded this orphaning), it cancels
+        the request exactly like an unkeyed disconnect. Unkeyed requests
+        don't come here — their transports cancel directly."""
         grace = grace_s if grace_s is not None else self.config.stream_orphan_grace_s
         with self._count_lock:
             req = self._by_id.get(request_id)
         if req is None:
             return
-        if req.replay is None or grace <= 0:
+        if req.replay is None:
+            self.cancel(request_id)
+            return
+        remaining = req.replay.release()
+        if remaining > 0:
+            return  # another client still rides this generation
+        if grace <= 0:
             self.cancel(request_id)
             return
         attaches_at_orphan = req.replay.attaches
@@ -1930,6 +2000,8 @@ class ServingEngine:
                 return
             if req.replay.attaches > attaches_at_orphan:
                 return  # someone resumed; their disconnect re-orphans
+            if req.replay.subscribers > 0:
+                return  # a client re-attached and is still connected
             self.cancel(request_id)
 
         timer = threading.Timer(grace, _reap)

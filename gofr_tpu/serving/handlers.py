@@ -202,12 +202,20 @@ def _sse_response(engine: Any, prompt: str, kw: dict) -> WireResponse:
             # handler's local count provably matches the engine-side
             # replay ring (same ordered single-worker detok stream), so a
             # client's Last-Event-ID re-attach replays exactly the unseen
-            # suffix (docs/serving.md "Resumable streams")
+            # suffix (docs/serving.md "Resumable streams"). A keyed
+            # duplicate that attached PAST the replay window starts at the
+            # engine's true sequence (``stream_base_seq``), announced in
+            # the head frame as ``attached_at`` — its ``id:`` lines still
+            # name real engine frames, so a later Last-Event-ID resumes
+            # correctly even on a truncated stream.
+            base = getattr(future, "stream_base_seq", 0)
+            head = {"id": future.request_id}
+            if base:
+                head["attached_at"] = base
             yield (
-                "id: 0\ndata: "
-                + json.dumps({"id": future.request_id}) + "\n\n"
+                f"id: {base}\ndata: " + json.dumps(head) + "\n\n"
             ).encode()
-            seq = 0
+            seq = base
             while True:
                 token_id, piece, done = await q.get()
                 if done:
@@ -519,9 +527,15 @@ def register_kv_fetch_routes(app: Any, engine: Any,
         # membership view) is rejected 409 before any cache is touched
         fence = body.get("fence_epoch")
         if fence is not None:
+            try:
+                fence = int(fence)
+            except (TypeError, ValueError):
+                # malformed fence is the CALLER's bug: a typed 400, not
+                # an uncaught ValueError surfacing as a 500
+                raise ErrorInvalidParam("fence_epoch") from None
             check = getattr(engine, "check_fence", None)
             if check is not None:
-                check(int(fence))
+                check(fence)
         keys = body.get("keys")
         if not keys or not isinstance(keys, list):
             raise ErrorMissingParam("keys")

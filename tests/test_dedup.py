@@ -1,0 +1,212 @@
+"""Unit tier for the HA-plane dedup/replay primitives
+(``gofr_tpu/serving/dedup.py``, docs/robustness.md "The HA plane").
+
+Pins the three review-hardened contracts at the primitive level, where
+they are deterministic (the seeded end-to-end scenarios live in
+tests/test_ha.py):
+
+- **subscriber leases**: every live attachment (owner, duplicate,
+  resume) holds one lease, released on ITS OWN disconnect — the
+  orphan-grace reaper gates on the count, so one client's disconnect
+  can never cancel another client's in-flight generation;
+- **truncated live-subscribe**: a submit-path duplicate whose suffix
+  fell out of the bounded replay window attaches live with NO replay
+  instead of hard-erroring, and learns the true engine sequence it
+  attached at;
+- **text-identical terminal replay**: a stored terminal replays the
+  ORIGINAL emitted pieces retained on the entry's ``ReplayStream``,
+  never a per-token re-decode that could differ on multi-token
+  unicode/byte sequences.
+"""
+
+from __future__ import annotations
+
+import types
+
+import pytest
+
+from gofr_tpu.serving.dedup import (
+    DedupEntry,
+    DedupRegistry,
+    ReplayGap,
+    ReplayStream,
+)
+
+
+def _feed(ring: ReplayStream, pieces: list[str], *, done: bool = False):
+    """Drive the owner wire: token frames (ids 100, 101, ...) and
+    optionally the terminal frame."""
+    cb = ring.wrap(None)
+    for i, piece in enumerate(pieces):
+        cb(100 + i, piece, False)
+    if done:
+        cb(-1, "", True)
+    return cb
+
+
+# -- subscriber leases ---------------------------------------------------------
+
+
+def test_wrap_counts_the_owner_as_a_live_subscriber():
+    ring = ReplayStream(8)
+    assert ring.subscribers == 0
+    ring.wrap(None)  # non-streaming owner still holds the lease
+    assert ring.subscribers == 1
+
+
+def test_attach_and_subscribe_take_leases_release_drops_them():
+    ring = ReplayStream(8)
+    _feed(ring, ["a", "b"])
+    assert ring.subscribers == 1  # the owner
+    ring.attach(0, lambda *a: None)  # a duplicate's full replay-attach
+    assert ring.subscribers == 2
+    ring.subscribe(lambda *a: None)  # a truncated attach
+    assert ring.subscribers == 3
+    # each disconnect releases exactly its own lease, floored at zero
+    assert ring.release() == 2
+    assert ring.release() == 1
+    assert ring.release() == 0
+    assert ring.release() == 0
+
+
+def test_duplicate_release_leaves_owner_lease_intact():
+    """The high-severity review scenario, at the primitive: owner
+    streaming, duplicate attaches then disconnects — the owner's lease
+    survives, so the reaper (which gates on ``subscribers > 0``) stands
+    down."""
+    ring = ReplayStream(8)
+    _feed(ring, ["a", "b", "c"])
+    ring.attach(0, lambda *a: None)
+    assert ring.release() == 1  # the duplicate leaves; the OWNER remains
+    assert ring.subscribers == 1
+
+
+def test_replay_gap_raises_before_taking_a_lease():
+    ring = ReplayStream(2)
+    _feed(ring, ["a", "b", "c", "d"])  # window holds only c, d
+    with pytest.raises(ReplayGap):
+        ring.attach(0, lambda *a: None)
+    assert ring.subscribers == 1  # only the owner; the failed attach took nothing
+    assert ring.attaches == 0
+
+
+# -- truncated live-subscribe --------------------------------------------------
+
+
+def test_subscribe_skips_replay_and_reports_true_base_seq():
+    ring = ReplayStream(2)
+    cb = _feed(ring, ["a", "b", "c", "d"])  # seqs 1..4 emitted, window = 3..4
+    got: list[tuple[int, int, str, bool]] = []
+    base = ring.subscribe(lambda s, t, p, d: got.append((s, t, p, d)))
+    assert base == 4  # frames 1..4 are NOT delivered — truncated by contract
+    assert got == []
+    cb(104, "e", False)
+    cb(-1, "", True)
+    # the live suffix arrives with true engine sequence numbers
+    assert got == [(5, 104, "e", False), (6, -1, "", True)]
+
+
+def test_subscribe_on_finished_stream_delivers_only_the_terminal():
+    ring = ReplayStream(4)
+    _feed(ring, ["a", "b"], done=True)
+    got: list[tuple[int, int, str, bool]] = []
+    base = ring.subscribe(lambda s, t, p, d: got.append((s, t, p, d)))
+    assert got == [(3, -1, "", True)]
+    assert base == 2  # seq before the one frame the subscriber received
+
+
+def test_done_frame_is_idempotent_across_settlement_paths():
+    ring = ReplayStream(4)
+    got: list[tuple[int, str, bool]] = []
+    cb = ring.wrap(lambda t, p, d: got.append((t, p, d)))
+    cb(100, "a", False)
+    cb(-1, "", True)
+    cb(-1, "", True)  # second settlement path: recorded once in the ring
+    assert ring.last_seq == 2
+    replayed: list[tuple[int, int, str, bool]] = []
+    ring.attach(0, lambda s, t, p, d: replayed.append((s, t, p, d)))
+    assert replayed == [(1, 100, "a", False), (2, -1, "", True)]
+
+
+# -- retained pieces / text-identical terminal replay --------------------------
+
+
+def test_ring_retains_every_emitted_piece_beyond_the_window():
+    ring = ReplayStream(2)
+    _feed(ring, ["th", "e", " cat"], done=True)
+    # the bounded ring evicted "th", the piece record did not
+    assert ring.pieces == ["th", "e", " cat"]
+
+
+class _RedecodingTokenizer:
+    """A tokenizer whose per-token decode does NOT reproduce the
+    incremental pieces (the multi-token unicode/byte case)."""
+
+    def decode(self, ids):
+        return "<redecoded>"
+
+
+def _terminal_entry(pieces: list[str] | None, token_ids: list[int]) -> DedupEntry:
+    entry = DedupEntry("k")
+    entry.rid = 7
+    entry.terminal = True
+    entry.result = types.SimpleNamespace(token_ids=token_ids)
+    if pieces is not None:
+        entry.replay = ReplayStream(2)
+        _feed(entry.replay, pieces, done=True)
+    return entry
+
+
+def test_terminal_replay_is_text_identical_to_the_original_stream():
+    from gofr_tpu.serving.engine import ServingEngine
+
+    entry = _terminal_entry(["th", "e", " cat"], [100, 101, 102])
+    fake = types.SimpleNamespace(tokenizer=_RedecodingTokenizer())
+    frames: list[tuple[int, int, str, bool]] = []
+    ServingEngine._replay_result(
+        fake, entry, 0, lambda s, t, p, d: frames.append((s, t, p, d))
+    )
+    # the ORIGINAL pieces, not the re-decode — and dense seqs + terminal
+    assert frames == [
+        (1, 100, "th", False),
+        (2, 101, "e", False),
+        (3, 102, " cat", False),
+        (4, -1, "", True),
+    ]
+    # a mid-stream resume replays exactly the unseen suffix
+    tail: list[tuple[int, int, str, bool]] = []
+    ServingEngine._replay_result(
+        fake, entry, 2, lambda s, t, p, d: tail.append((s, t, p, d))
+    )
+    assert tail == [(3, 102, " cat", False), (4, -1, "", True)]
+
+
+def test_terminal_replay_falls_back_to_decode_without_retained_pieces():
+    from gofr_tpu.serving.engine import ServingEngine
+
+    entry = _terminal_entry(None, [100, 101])  # no ReplayStream on the entry
+    fake = types.SimpleNamespace(tokenizer=_RedecodingTokenizer())
+    frames: list[tuple[int, int, str, bool]] = []
+    ServingEngine._replay_result(
+        fake, entry, 0, lambda s, t, p, d: frames.append((s, t, p, d))
+    )
+    assert [f[2] for f in frames[:-1]] == ["<redecoded>", "<redecoded>"]
+    assert frames[-1] == (3, -1, "", True)
+
+
+# -- registry claim-window hygiene ---------------------------------------------
+
+
+def test_forget_wakes_waiting_duplicates_with_a_dead_entry():
+    reg = DedupRegistry(4)
+    owner, entry = reg.claim("k")
+    assert owner
+    dup_owner, dup_entry = reg.claim("k")
+    assert not dup_owner and dup_entry is entry
+    assert not entry.ready.is_set()
+    reg.forget("k")  # failed admission: the key must re-run fresh
+    assert entry.ready.is_set()  # waiting duplicates wake...
+    assert entry.future is None and not entry.terminal  # ...and see a dead entry
+    assert reg.stats()["live"] == 0
+    fresh_owner, fresh_entry = reg.claim("k")
+    assert fresh_owner and fresh_entry is not entry

@@ -349,6 +349,183 @@ def test_stale_epoch_rejected_at_engine_wire(model):
         eng.stop()
 
 
+# -- review-hardened contracts: leases, gap fallback, claim window -------------
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_duplicate_disconnect_does_not_cancel_owner_stream(model):
+    """One client's disconnect must never kill another client's
+    in-flight generation: a duplicate keyed attach that drops (its
+    transport orphans the request) leaves the owner's live stream
+    untouched — the reaper stands down while any subscriber lease
+    remains, and the generation runs to its own terminal."""
+    cfg, params = model
+    eng = _mk_engine(cfg, params)
+    eng.start()
+    try:
+        key = "dup-disconnect"
+        frames: list[tuple[int, str, bool]] = []
+        rolling = threading.Event()
+
+        def owner_cb(token_id: int, piece: str, done: bool) -> None:
+            frames.append((token_id, piece, done))
+            if len(frames) >= 3:
+                rolling.set()
+
+        fut = eng.submit(
+            PROMPT, max_new_tokens=80, temperature=0.0,
+            idempotency_key=key, stream_cb=owner_cb,
+        )
+        assert rolling.wait(timeout=300), "owner stream never started"
+        # a duplicate keyed submit attaches to the SAME future...
+        dup = eng.submit(
+            PROMPT, max_new_tokens=80, temperature=0.0,
+            idempotency_key=key, stream_cb=lambda t, p, d: None,
+        )
+        assert dup is fut
+        # ...and then its client vanishes: the transport orphans with a
+        # grace far shorter than the remaining generation
+        eng.orphan(fut.request_id, grace_s=0.02)
+        time.sleep(0.2)  # the reaper window passes while the owner rides
+        result = fut.result(timeout=300)
+        assert result.finish_reason == "length"
+        assert len(result.token_ids) == 80
+    finally:
+        eng.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_duplicate_submit_past_replay_window_attaches_truncated(model):
+    """A keyed retry of a long-running generation whose emitted suffix
+    fell out of the bounded replay window must still dedup — truncated
+    live attach carrying the true engine base seq — never a hard 404
+    that would break the 'fall back to a keyed submit' contract."""
+    from gofr_tpu.serving import ByteTokenizer, EngineConfig, ServingEngine
+
+    cfg, params = model
+    eng = ServingEngine(
+        cfg, params,
+        EngineConfig(
+            max_slots=4, max_seq_len=128, prefill_buckets=(16,),
+            max_queue=16, prefill_chunk_tokens=16,
+            stream_replay_tokens=4,  # a window the stream quickly outruns
+        ),
+        ByteTokenizer(),
+    )
+    eng.start()
+    try:
+        key = "gap-dup"
+        emitted = threading.Event()
+        count = [0]
+
+        def owner_cb(token_id: int, piece: str, done: bool) -> None:
+            if not done:
+                count[0] += 1
+                if count[0] >= 8:  # well past the 4-frame window
+                    emitted.set()
+
+        fut = eng.submit(
+            PROMPT, max_new_tokens=100, temperature=0.0,
+            idempotency_key=key, stream_cb=owner_cb,
+        )
+        assert emitted.wait(timeout=300), "owner stream never outran the window"
+        dup_frames: list[tuple[int, str, bool]] = []
+        fut2 = eng.submit(
+            PROMPT, max_new_tokens=100, temperature=0.0,
+            idempotency_key=key,
+            stream_cb=lambda t, p, d: dup_frames.append((t, p, d)),
+        )
+        result = fut.result(timeout=300)
+        result2 = fut2.result(timeout=300)
+        # the duplicate rode the SAME generation to the same full result
+        assert result2.token_ids == result.token_ids
+        assert len(result.token_ids) == 100
+        base = getattr(fut2, "stream_base_seq", 0)
+        assert base >= 4, "gap attach should report the true engine base seq"
+        # truncated stream: exactly the live suffix past the attach point,
+        # terminated by a done frame
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and (
+            not dup_frames or not dup_frames[-1][2]
+        ):
+            time.sleep(0.01)
+        assert dup_frames and dup_frames[-1][2] is True
+        dup_tokens = [t for t, _p, d in dup_frames if not d]
+        assert dup_tokens == result.token_ids[base:]
+    finally:
+        eng.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_failed_admission_after_claim_forgets_key(model):
+    """A failure anywhere in the claim-to-enqueue window (here: the
+    flight recorder's begin) must forget the dedup entry — otherwise the
+    key stays live forever with a never-resolving future and every later
+    duplicate hangs on it."""
+    cfg, params = model
+    eng = _mk_engine(cfg, params)
+    eng.start()
+    try:
+        key = "claim-window"
+
+        class _Boom(RuntimeError):
+            pass
+
+        original_begin = eng.timeline.begin
+
+        def boom(*args, **kwargs):
+            raise _Boom("injected flight-recorder failure")
+
+        eng.timeline.begin = boom
+        try:
+            with pytest.raises(_Boom):
+                eng.submit(PROMPT, max_new_tokens=4, temperature=0.0,
+                           idempotency_key=key)
+        finally:
+            eng.timeline.begin = original_begin
+        stats = eng.dedup_stats()
+        assert stats["live"] == 0 and stats["terminal"] == 0
+        # the key re-runs FRESH — no attach-and-hang on a dead entry
+        result = eng.submit(
+            PROMPT, max_new_tokens=4, temperature=0.0, idempotency_key=key,
+        ).result(timeout=300)
+        assert result.finish_reason == "length"
+    finally:
+        eng.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_kv_fetch_rejects_malformed_fence_epoch(http_replica):
+    """A non-numeric ``fence_epoch`` in the KV-fetch body is the
+    caller's bug: a typed 400, never an uncaught ValueError 500."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    replica, eng = http_replica
+
+    def post(body: dict) -> int:
+        req = urllib.request.Request(
+            replica.address + "/kv/fetch", method="POST",
+            data=_json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status
+        except urllib.error.HTTPError as exc:
+            return exc.code
+
+    assert post({"fence_epoch": "not-a-number", "keys": ["k"]}) == 400
+    # a well-formed current-epoch fence still passes the route (POSTs
+    # answer 201 on this wire)
+    assert post({"fence_epoch": eng.epoch, "keys": ["k"]}) == 201
+
+
 # -- satellite coverage: shed Retry-After, last-resort routes, final beat ------
 
 
